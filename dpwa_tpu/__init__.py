@@ -1,0 +1,30 @@
+"""dpwa_tpu — TPU-native gossip (pairwise-averaging) training framework.
+
+A brand-new, TPU-first framework with the capabilities of the reference
+``zenghanfu/dpwa`` (decentralized asynchronous data-parallel SGD via
+gossip-style pairwise averaging; see SURVEY.md).  Where the reference moves
+flattened CPU parameter vectors between processes over raw TCP sockets
+(reference layout: ``dpwa/conn.py``, ``dpwa/adapters/pytorch.py`` — mount was
+empty this round, citations per SURVEY.md §0/§2), this framework keeps every
+replica in HBM as JAX arrays and executes each gossip round as a pairing
+permutation fed to ``jax.lax.ppermute`` inside ``shard_map``, with the
+``x ← (1−α)·x + α·x_peer`` merge fused into the same XLA program.
+
+Public API (mirrors the reference's surface):
+
+- :func:`dpwa_tpu.config.load_config` — reference-compatible YAML config
+  (``nodes:`` peer list → device-mesh axis).
+- :class:`dpwa_tpu.adapters.jax_adapter.DpwaJaxAdapter` — the
+  ``Dpwa.update()``-style training adapter (SPMD / ICI fast path).
+- :class:`dpwa_tpu.adapters.tcp_adapter.DpwaTcpAdapter` — per-process
+  CPU/TCP adapter with the reference's exact semantics (parity + baseline).
+- :mod:`dpwa_tpu.parallel.schedules` — ring / random-pair / hierarchical
+  gossip pairing schedules.
+- :mod:`dpwa_tpu.interpolation` — constant / clock-weighted / loss-weighted
+  merge-coefficient strategies.
+"""
+
+from dpwa_tpu.config import DpwaConfig, load_config  # noqa: F401
+from dpwa_tpu.interpolation import make_interpolation  # noqa: F401
+
+__version__ = "0.1.0"

@@ -1,0 +1,176 @@
+"""Reference-compatible YAML configuration.
+
+The reference (zenghanfu/dpwa) is driven by a YAML file whose ``nodes:`` list
+enumerates the peer topology as ``{name, host, port}`` entries, plus protocol
+knobs (fetch probability, socket timeout) and an interpolation spec
+(SURVEY.md §2 "Config system"; reference file ``dpwa/config.py`` — mount empty,
+reconstructed per SURVEY.md §0).  Contract preserved here (BASELINE.json:5):
+**the same YAML file drives either transport** — the TCP transport uses
+``host``/``port`` per node, while the ICI transport reinterprets the length of
+``nodes:`` as the size of a device-mesh axis and ignores host/port.
+
+Schema::
+
+    nodes:
+      - {name: node0, host: 127.0.0.1, port: 45000}
+      - {name: node1, host: 127.0.0.1, port: 45001}
+    protocol:
+      schedule: ring            # ring | random | hierarchical
+      fetch_probability: 1.0    # per-step chance a pair actually exchanges
+      timeout_ms: 500           # TCP transport only: fetch timeout
+      seed: 0                   # schedule / participation RNG seed
+      pool_size: 16             # random schedule: # static pairings compiled
+      group_size: 0             # hierarchical: peers per host group (0 = auto)
+      inter_period: 4           # hierarchical: cross-group exchange cadence
+    interpolation:
+      type: constant            # constant | clock | loss
+      factor: 0.5               # constant alpha (0.5 == (local+remote)/2)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Mapping, Sequence
+
+import yaml
+
+
+@dataclasses.dataclass(frozen=True)
+class NodeSpec:
+    """One ``nodes:`` entry: a peer's identity and (TCP-only) address."""
+
+    name: str
+    host: str = "127.0.0.1"
+    port: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class ProtocolConfig:
+    schedule: str = "ring"
+    fetch_probability: float = 1.0
+    timeout_ms: int = 500
+    seed: int = 0
+    pool_size: int = 16
+    group_size: int = 0
+    inter_period: int = 4
+
+    def __post_init__(self) -> None:
+        if not 0.0 <= self.fetch_probability <= 1.0:
+            raise ValueError(
+                f"fetch_probability must be in [0, 1], got {self.fetch_probability}"
+            )
+        if self.schedule not in ("ring", "random", "hierarchical"):
+            raise ValueError(f"unknown schedule {self.schedule!r}")
+
+
+@dataclasses.dataclass(frozen=True)
+class InterpolationConfig:
+    type: str = "constant"
+    factor: float = 0.5
+
+    def __post_init__(self) -> None:
+        if self.type not in ("constant", "clock", "loss"):
+            raise ValueError(f"unknown interpolation type {self.type!r}")
+        if not 0.0 <= self.factor <= 1.0:
+            raise ValueError(f"factor must be in [0, 1], got {self.factor}")
+
+
+@dataclasses.dataclass(frozen=True)
+class DpwaConfig:
+    nodes: tuple[NodeSpec, ...]
+    protocol: ProtocolConfig = ProtocolConfig()
+    interpolation: InterpolationConfig = InterpolationConfig()
+
+    @property
+    def n_peers(self) -> int:
+        """Length of ``nodes:`` — the size of the gossip mesh axis."""
+        return len(self.nodes)
+
+    @property
+    def node_names(self) -> tuple[str, ...]:
+        return tuple(n.name for n in self.nodes)
+
+    def node_index(self, name: str) -> int:
+        """Position of ``name`` in ``nodes:`` — this process/device's peer id."""
+        try:
+            return self.node_names.index(name)
+        except ValueError:
+            raise KeyError(
+                f"node {name!r} not in config (have {self.node_names})"
+            ) from None
+
+    def node(self, name: str) -> NodeSpec:
+        return self.nodes[self.node_index(name)]
+
+
+def _build_nodes(raw: Sequence[Any]) -> tuple[NodeSpec, ...]:
+    nodes = []
+    for i, entry in enumerate(raw):
+        if isinstance(entry, str):
+            # Shorthand: a bare name (ICI transport needs no address).
+            nodes.append(NodeSpec(name=entry))
+        elif isinstance(entry, Mapping):
+            nodes.append(
+                NodeSpec(
+                    name=str(entry.get("name", f"node{i}")),
+                    host=str(entry.get("host", "127.0.0.1")),
+                    port=int(entry.get("port", 0)),
+                )
+            )
+        else:
+            raise TypeError(f"bad nodes[{i}] entry: {entry!r}")
+    names = [n.name for n in nodes]
+    if len(set(names)) != len(names):
+        raise ValueError(f"duplicate node names in config: {names}")
+    if not nodes:
+        raise ValueError("config must list at least one node")
+    return tuple(nodes)
+
+
+def config_from_dict(raw: Mapping[str, Any]) -> DpwaConfig:
+    """Build a :class:`DpwaConfig` from a parsed-YAML mapping."""
+    if "nodes" not in raw:
+        raise ValueError("config is missing the required 'nodes:' list")
+    proto = dict(raw.get("protocol") or {})
+    interp = dict(raw.get("interpolation") or {})
+    return DpwaConfig(
+        nodes=_build_nodes(raw["nodes"]),
+        protocol=ProtocolConfig(**proto),
+        interpolation=InterpolationConfig(**interp),
+    )
+
+
+def load_config(path: str) -> DpwaConfig:
+    """Load the reference-style YAML config file."""
+    with open(path, "r", encoding="utf-8") as f:
+        raw = yaml.safe_load(f)
+    if not isinstance(raw, Mapping):
+        raise ValueError(f"config file {path} did not parse to a mapping")
+    return config_from_dict(raw)
+
+
+def make_local_config(
+    n_peers: int,
+    *,
+    schedule: str = "ring",
+    fetch_probability: float = 1.0,
+    interpolation: str = "constant",
+    factor: float = 0.5,
+    seed: int = 0,
+    base_port: int = 45000,
+    **protocol_kwargs: Any,
+) -> DpwaConfig:
+    """Programmatic config for tests/benchmarks: n local peers on 127.0.0.1."""
+    return DpwaConfig(
+        nodes=tuple(
+            NodeSpec(name=f"node{i}", host="127.0.0.1", port=base_port + i)
+            for i in range(n_peers)
+        ),
+        protocol=ProtocolConfig(
+            schedule=schedule,
+            fetch_probability=fetch_probability,
+            seed=seed,
+            **protocol_kwargs,
+        ),
+        interpolation=InterpolationConfig(type=interpolation, factor=factor),
+    )

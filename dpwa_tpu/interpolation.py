@@ -1,0 +1,91 @@
+"""Merge-coefficient (α) strategies for ``x ← (1−α)·x + α·x_peer``.
+
+The reference ships pluggable interpolation strategies selected from the YAML
+config (SURVEY.md §2 "Interpolation strategies"; reference file
+``dpwa/interpolation.py`` — mount empty, reconstructed):
+
+- **constant** — fixed α; α = 0.5 is the ``(local+remote)/2`` merge named in
+  the north-star (BASELINE.json:5).
+- **clock-weighted** — weight by relative training progress: a peer that has
+  seen more data is trusted more.
+- **loss-weighted** — trust the lower-loss peer more.
+
+All strategies here are pure jittable functions of the local and remote
+``(clock, loss)`` metadata pair, so the same code computes α inside the fused
+ICI exchange (traced) and on the host for the TCP transport (concrete).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, NamedTuple
+
+import jax.numpy as jnp
+
+from dpwa_tpu.config import InterpolationConfig
+
+_EPS = 1e-8
+
+
+class PeerMeta(NamedTuple):
+    """Per-peer scalars that ride along with every exchange.
+
+    ``clock`` counts training progress (steps; the reference exchanged a
+    sample/step counter with each payload, SURVEY.md §2).  ``loss`` is the
+    most recent training loss passed to ``update(loss)``.
+    """
+
+    clock: jnp.ndarray  # float32 scalar
+    loss: jnp.ndarray  # float32 scalar
+
+    @staticmethod
+    def zeros() -> "PeerMeta":
+        return PeerMeta(jnp.float32(0.0), jnp.float32(0.0))
+
+
+# An interpolation maps (local_meta, remote_meta) -> alpha in [0, 1].
+Interpolation = Callable[[PeerMeta, PeerMeta], jnp.ndarray]
+
+
+def constant(factor: float) -> Interpolation:
+    def alpha(local: PeerMeta, remote: PeerMeta) -> jnp.ndarray:
+        del local, remote
+        return jnp.float32(factor)
+
+    return alpha
+
+
+def clock_weighted(factor: float = 1.0) -> Interpolation:
+    """α = factor · remote_clock / (local_clock + remote_clock).
+
+    A fresh peer (clock 0) contributes nothing; two equally-trained peers
+    average symmetrically (α = factor/2)."""
+
+    def alpha(local: PeerMeta, remote: PeerMeta) -> jnp.ndarray:
+        total = local.clock + remote.clock
+        return jnp.float32(factor) * remote.clock / jnp.maximum(total, _EPS)
+
+    return alpha
+
+
+def loss_weighted(factor: float = 1.0) -> Interpolation:
+    """α = factor · local_loss / (local_loss + remote_loss).
+
+    The higher my loss relative to the peer's, the more of the peer I take;
+    a peer whose loss is much lower than mine dominates the merge."""
+
+    def alpha(local: PeerMeta, remote: PeerMeta) -> jnp.ndarray:
+        total = local.loss + remote.loss
+        return jnp.float32(factor) * local.loss / jnp.maximum(total, _EPS)
+
+    return alpha
+
+
+def make_interpolation(config: InterpolationConfig) -> Interpolation:
+    """Factory from the YAML ``interpolation:`` section."""
+    if config.type == "constant":
+        return constant(config.factor)
+    if config.type == "clock":
+        return clock_weighted(config.factor)
+    if config.type == "loss":
+        return loss_weighted(config.factor)
+    raise ValueError(f"unknown interpolation type {config.type!r}")

@@ -1,0 +1,1 @@
+from dpwa_tpu.parallel.schedules import build_schedule  # noqa: F401

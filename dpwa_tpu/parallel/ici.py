@@ -1,0 +1,194 @@
+"""ICI gossip transport: ``ppermute`` + fused merge inside ``shard_map``.
+
+This replaces the reference's hot path end to end (SURVEY.md §3.2): where the
+reference flattens params to numpy, pickles them through a TCP socket to a
+peer's Rx thread, and merges on the CPU (reference ``dpwa/conn.py`` +
+``dpwa/adapters/pytorch.py`` — mount empty), here every replica lives in HBM
+as the per-device shard of a peer-stacked pytree and one jitted SPMD program
+does, per step:
+
+1. select the pairing in effect (``lax.switch`` over a small pool of static
+   involutions — compiled once, step-indexed on device),
+2. exchange parameters AND (clock, loss) metadata with the partner via
+   ``lax.ppermute`` over ICI,
+3. compute α from both sides' metadata (interpolation strategy) and the
+   per-pair participation draw (emulating the reference's probabilistic
+   fetch; SURVEY.md §7 design stance),
+4. merge ``x ← (1−α)·x + α·x_peer`` — fused by XLA into the same program.
+
+No host round-trips, no serialization, no copies: the "wire format" is the
+collective itself.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+from dpwa_tpu.config import DpwaConfig
+from dpwa_tpu.interpolation import Interpolation, PeerMeta, make_interpolation
+from dpwa_tpu.parallel import schedules
+from dpwa_tpu.parallel.mesh import PEER_AXIS, make_mesh
+from dpwa_tpu.parallel.schedules import Schedule, participation_draw
+
+PyTree = Any
+
+
+class ExchangeInfo(NamedTuple):
+    """Per-peer diagnostics from one gossip round (stacked over peers)."""
+
+    partner: jnp.ndarray  # int32[n] — pairing in effect this step
+    alpha: jnp.ndarray  # float32[n] — merge coefficient actually applied
+    participated: jnp.ndarray  # bool[n]
+
+
+def _perm_pairs(perm) -> Tuple[Tuple[int, int], ...]:
+    """ppermute (source, dest) pairs so device i receives from perm[i]."""
+    return tuple((int(perm[i]), int(i)) for i in range(len(perm)))
+
+
+def gossip_exchange_local(
+    params: PyTree,
+    meta: PeerMeta,
+    step: jnp.ndarray,
+    *,
+    schedule: Schedule,
+    interp: Interpolation,
+    axis_name: str = PEER_AXIS,
+):
+    """The per-device gossip body. Call INSIDE shard_map/pjit over
+    ``axis_name``; ``params`` leaves and ``meta`` scalars are this device's
+    local (unstacked) values.
+
+    Returns (merged_params, (partner, alpha, participated)) for this device.
+    """
+    me = lax.axis_index(axis_name)
+    pool = jnp.asarray(schedule.pool)  # [K, n] baked-in constant
+    branch = jnp.mod(jnp.asarray(step, jnp.int32), schedule.pool_size)
+    partner = pool[branch, me]
+
+    def make_branch(perm):
+        pairs = _perm_pairs(perm)
+
+        def apply(operand):
+            return jax.tree.map(
+                lambda v: lax.ppermute(v, axis_name, perm=pairs), operand
+            )
+
+        return apply
+
+    remote_params, remote_meta = lax.switch(
+        branch,
+        [make_branch(p) for p in schedule.pool],
+        (params, meta),
+    )
+
+    pair_id = jnp.minimum(me, partner)
+    if schedule.fetch_probability >= 1.0:
+        drawn = jnp.bool_(True)
+    else:
+        drawn = participation_draw(
+            schedule.seed, step, pair_id, schedule.fetch_probability
+        )
+    participated = jnp.logical_and(drawn, partner != me)
+    alpha = jnp.where(participated, interp(meta, remote_meta), 0.0)
+    alpha = alpha.astype(jnp.float32)
+
+    def merge(x, y):
+        a = alpha.astype(jnp.promote_types(x.dtype, jnp.float32))
+        return ((1.0 - a) * x.astype(a.dtype) + a * y.astype(a.dtype)).astype(
+            x.dtype
+        )
+
+    merged = jax.tree.map(merge, params, remote_params)
+    return merged, (partner, alpha, participated)
+
+
+class IciTransport:
+    """On-device gossip over a ``peers`` mesh axis.
+
+    Drop-in peer of :class:`dpwa_tpu.parallel.tcp.TcpTransport` behind the
+    same exchange semantics (SURVEY.md §7 transports plugin interface), but
+    SPMD: one process owns all replicas as a peer-stacked, peer-sharded
+    pytree and :meth:`exchange` advances every replica's gossip round in a
+    single XLA program.
+    """
+
+    def __init__(
+        self,
+        config: DpwaConfig,
+        mesh: Optional[Mesh] = None,
+        axis_name: str = PEER_AXIS,
+    ):
+        self.config = config
+        self.schedule = schedules.build_schedule(config)
+        self.interp = make_interpolation(config.interpolation)
+        self.axis_name = axis_name
+        self.mesh = mesh if mesh is not None else make_mesh(config, axis_name=axis_name)
+        (axis_size,) = (self.mesh.shape[axis_name],)
+        if axis_size != config.n_peers:
+            raise ValueError(
+                f"mesh axis {axis_name!r} has size {axis_size} but config "
+                f"names {config.n_peers} peers"
+            )
+        self._exchange = self._build_exchange()
+
+    def _build_exchange(self):
+        schedule, interp, axis = self.schedule, self.interp, self.axis_name
+
+        def body(params, meta, step):
+            # shard_map hands us a leading peer axis of local size 1;
+            # strip it so interpolation sees true scalars, then restore.
+            params1 = jax.tree.map(lambda v: v[0], params)
+            meta1 = jax.tree.map(lambda v: v[0], meta)
+            merged, (partner, alpha, part) = gossip_exchange_local(
+                params1,
+                meta1,
+                step,
+                schedule=schedule,
+                interp=interp,
+                axis_name=axis,
+            )
+            merged = jax.tree.map(lambda v: v[None], merged)
+            return merged, (
+                partner[None],
+                alpha[None],
+                part[None],
+            )
+
+        mapped = shard_map(
+            body,
+            mesh=self.mesh,
+            in_specs=(P(self.axis_name), P(self.axis_name), P()),
+            out_specs=(
+                P(self.axis_name),
+                (P(self.axis_name), P(self.axis_name), P(self.axis_name)),
+            ),
+            check_rep=False,
+        )
+
+        @jax.jit
+        def exchange(params, meta, step):
+            merged, (partner, alpha, part) = mapped(params, meta, step)
+            return merged, ExchangeInfo(partner, alpha, part)
+
+        return exchange
+
+    def exchange(
+        self, params: PyTree, meta: PeerMeta, step
+    ) -> Tuple[PyTree, ExchangeInfo]:
+        """One gossip round over every replica.
+
+        Args:
+          params: pytree whose leaves are peer-stacked ``[n_peers, ...]``
+            arrays (ideally already sharded with :func:`peer_sharding`).
+          meta: :class:`PeerMeta` of ``[n_peers]`` float32 arrays.
+          step: int — selects the pairing and the participation draw.
+        """
+        return self._exchange(params, meta, jnp.asarray(step, jnp.int32))

@@ -1,0 +1,209 @@
+"""Gossip pairing schedules.
+
+The reference's ``RumorProtocol`` decides, each step, whether to exchange and
+with whom: a random peer pulled with some probability (SURVEY.md §2/§3.2 —
+reference had this in/near ``dpwa/conn.py``; mount empty).  In the SPMD
+re-design that per-process random choice becomes a **deterministic per-step
+pairing permutation** shared by all devices, with the probabilistic part
+emulated by a per-pair participation mask (α forced to 0 when a pair "would
+not have gossiped" — SURVEY.md §7 design stance).
+
+Every pairing is an **involution** (perm[perm[i]] == i): ``ppermute`` is
+one-directional, and a pairwise average needs both members to receive each
+other, so schedules emit perfect matchings (odd one out pairs with itself and
+is masked).  SURVEY.md §7 hard part #2.
+
+Compile-once design: a schedule materializes a small **pool** of static
+pairings at init (ring: 2; random: ``pool_size`` matchings; hierarchical: its
+period).  The jitted exchange selects a pool entry with ``lax.switch`` indexed
+by a traced function of ``step`` — no per-step recompilation, no host
+round-trip in the hot loop.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dpwa_tpu.config import DpwaConfig
+
+
+def participation_draw(seed, step, pair_id, fetch_probability):
+    """One Bernoulli per (step, pair), shared by both members of the pair.
+
+    Defined once in terms of ``jax.random`` (counter-based threefry) so the
+    host-side TCP transport and the in-jit ICI transport draw **identical**
+    streams from the same (seed, step, pair) — this is what makes the
+    TCP-vs-ICI parity test (SURVEY.md §4) bit-comparable.  All of ``step`` and
+    ``pair_id`` may be traced.
+    """
+    key = jax.random.fold_in(
+        jax.random.fold_in(jax.random.key(seed), jnp.asarray(step, jnp.int32)),
+        jnp.asarray(pair_id, jnp.int32),
+    )
+    return jax.random.uniform(key) < fetch_probability
+
+
+def is_involution(perm: np.ndarray) -> bool:
+    """True iff perm is a valid pairing: perm[perm[i]] == i for all i."""
+    idx = np.arange(len(perm))
+    return bool(np.all(perm[perm] == idx))
+
+
+def _ring_even(n: int) -> np.ndarray:
+    """Pair (0,1),(2,3),...  Last element self-pairs when n is odd."""
+    perm = np.arange(n)
+    for i in range(0, n - 1, 2):
+        perm[i], perm[i + 1] = i + 1, i
+    return perm
+
+
+def _ring_odd(n: int) -> np.ndarray:
+    """Pair (1,2),(3,4),... and close the ring with (n-1, 0) when n is even."""
+    perm = np.arange(n)
+    for i in range(1, n - 1, 2):
+        perm[i], perm[i + 1] = i + 1, i
+    if n % 2 == 0 and n > 2:
+        perm[n - 1], perm[0] = 0, n - 1
+    return perm
+
+
+def _random_matching(n: int, rng: np.random.Generator) -> np.ndarray:
+    """A uniform random perfect matching (odd one out self-pairs)."""
+    order = rng.permutation(n)
+    perm = np.arange(n)
+    for i in range(0, n - 1, 2):
+        a, b = order[i], order[i + 1]
+        perm[a], perm[b] = b, a
+    return perm
+
+
+def _hierarchical_pool(
+    n: int, group_size: int, inter_period: int
+) -> np.ndarray:
+    """Two-level pool: intra-group ring pairings, with every
+    ``inter_period``-th slot exchanging across groups instead.
+
+    Intra slots alternate the two ring phases *within each group*; the inter
+    slot pairs peer ``i`` of group ``g`` with peer ``i`` of a partner group
+    (groups themselves ring-paired, phase rotating so all group pairs are
+    visited).  This is the intra-host-ICI / inter-host-DCN split of
+    BASELINE.json:10 (config 4, hierarchical averaging).
+    """
+    if n % group_size != 0:
+        raise ValueError(f"n_peers {n} not divisible by group_size {group_size}")
+    n_groups = n // group_size
+    pool = []
+    inter_phase = 0
+    for slot in range(inter_period):
+        if slot == inter_period - 1 and n_groups > 1:
+            # Inter-group slot: ring-pair the groups, alternating phase.
+            gperm = (_ring_even if inter_phase % 2 == 0 else _ring_odd)(n_groups)
+            inter_phase += 1
+            perm = np.arange(n)
+            for g in range(n_groups):
+                pg = gperm[g]
+                for i in range(group_size):
+                    perm[g * group_size + i] = pg * group_size + i
+            pool.append(perm)
+        else:
+            # Intra-group slot: ring phase alternates by slot.
+            base = (_ring_even if slot % 2 == 0 else _ring_odd)(group_size)
+            perm = np.concatenate(
+                [base + g * group_size for g in range(n_groups)]
+            )
+            pool.append(perm)
+    return np.stack(pool)
+
+
+@dataclasses.dataclass(frozen=True)
+class Schedule:
+    """A compiled-pool gossip schedule.
+
+    Attributes:
+      pool: [K, n] int32 — K static involution pairings.
+      n_peers: mesh-axis size (length of the YAML ``nodes:`` list).
+      fetch_probability: per-step chance that a pair actually exchanges;
+        emulates the reference's probabilistic pull (masked, not skipped).
+      seed: RNG seed for the participation draws (and the random pool).
+    """
+
+    pool: np.ndarray
+    n_peers: int
+    fetch_probability: float
+    seed: int
+    name: str
+
+    @property
+    def pool_size(self) -> int:
+        return len(self.pool)
+
+    def branch(self, step: int) -> int:
+        """Host-side pool index for ``step`` (the jit path computes the same
+        thing as ``step % pool_size`` on-device)."""
+        return int(step) % self.pool_size
+
+    def pairing(self, step: int) -> np.ndarray:
+        """The pairing permutation in effect at ``step`` (host-side view,
+        used by the TCP transport and by tests)."""
+        return self.pool[self.branch(step)]
+
+    def partner(self, step: int, i: int) -> int:
+        return int(self.pairing(step)[i])
+
+    def participates(self, step: int, i: int) -> bool:
+        """Host-side participation draw — the same threefry stream the jit
+        path uses (see :func:`participation_draw`)."""
+        p = self.partner(step, i)
+        if p == i:
+            return False
+        if self.fetch_probability >= 1.0:
+            return True
+        return bool(
+            participation_draw(
+                self.seed, step, min(i, p), self.fetch_probability
+            )
+        )
+
+
+def build_schedule(config: DpwaConfig) -> Schedule:
+    """Materialize the pairing pool described by ``config.protocol``."""
+    proto = config.protocol
+    n = config.n_peers
+    if n == 1:
+        pool = np.zeros((1, 1), dtype=np.int64)
+    elif proto.schedule == "ring":
+        pool = np.stack([_ring_even(n), _ring_odd(n)])
+    elif proto.schedule == "random":
+        rng = np.random.default_rng(proto.seed)
+        pool = np.stack(
+            [_random_matching(n, rng) for _ in range(max(1, proto.pool_size))]
+        )
+    elif proto.schedule == "hierarchical":
+        group = proto.group_size or _auto_group_size(n)
+        pool = _hierarchical_pool(n, group, max(2, proto.inter_period))
+    else:  # pragma: no cover - config validates earlier
+        raise ValueError(proto.schedule)
+    pool = pool.astype(np.int32)
+    for k, perm in enumerate(pool):
+        if not is_involution(perm):
+            raise AssertionError(f"schedule produced non-involution at slot {k}")
+    return Schedule(
+        pool=pool,
+        n_peers=n,
+        fetch_probability=proto.fetch_probability,
+        seed=proto.seed,
+        name=proto.schedule,
+    )
+
+
+def _auto_group_size(n: int) -> int:
+    """Default hierarchical group: 4 peers per group when divisible (one
+    v4 host's worth of chips), else the largest divisor ≤ sqrt-ish."""
+    for g in (4, 8, 2):
+        if n % g == 0 and n // g > 1:
+            return g
+    return n

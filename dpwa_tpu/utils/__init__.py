@@ -1,0 +1,6 @@
+from dpwa_tpu.utils.pytree import (  # noqa: F401
+    ravel,
+    subset_ravel,
+    partition,
+    combine,
+)

@@ -1,0 +1,115 @@
+"""Pytree flatten/unflatten and parameter-subset selection.
+
+The reference flattens ``model.parameters()`` into one contiguous numpy vector
+before every exchange (SURVEY.md §3.2 — reference ``dpwa/adapters/pytorch.py``,
+mount empty).  Here the equivalents are built on ``jax.flatten_util``:
+
+- :func:`ravel` — whole-pytree flatten, used by the TCP wire format and the
+  bandwidth benchmark.  The ICI fast path deliberately does **not** ravel:
+  ``ppermute`` runs per-leaf inside one jitted program and XLA fuses the merge,
+  so there is no copy to amortize.
+- :func:`subset_ravel` / :func:`partition` — select a subset of leaves by
+  path predicate.  This powers subset-pytree gossip (BASELINE.json:11 —
+  Llama-3-8B LoRA fine-tune where only LoRA adapter weights enter the
+  exchange and base weights never move).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import flatten_util
+
+PyTree = Any
+PathPredicate = Callable[[str], bool]
+
+
+def ravel(tree: PyTree) -> Tuple[jnp.ndarray, Callable[[jnp.ndarray], PyTree]]:
+    """Flatten a pytree to one 1-D vector; returns (vector, unravel_fn)."""
+    flat, unravel = flatten_util.ravel_pytree(tree)
+    return flat, unravel
+
+
+def _path_str(path: Tuple[Any, ...]) -> str:
+    """Render a jax key-path as 'a/b/0/c' for predicate matching."""
+    parts = []
+    for k in path:
+        if isinstance(k, jax.tree_util.DictKey):
+            parts.append(str(k.key))
+        elif isinstance(k, jax.tree_util.SequenceKey):
+            parts.append(str(k.idx))
+        elif isinstance(k, jax.tree_util.GetAttrKey):
+            parts.append(str(k.name))
+        else:
+            parts.append(str(k))
+    return "/".join(parts)
+
+
+def partition(tree: PyTree, pred: PathPredicate) -> Tuple[PyTree, PyTree]:
+    """Split ``tree`` into (selected, rest) by path predicate.
+
+    Both outputs keep the full tree structure; non-matching leaves are
+    ``None`` in ``selected`` and vice versa, so :func:`combine` can zip them
+    back together losslessly.
+    """
+    paths_leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
+    treedef = jax.tree_util.tree_structure(tree)
+    sel_leaves = []
+    rest_leaves = []
+    for path, leaf in paths_leaves:
+        if pred(_path_str(path)):
+            sel_leaves.append(leaf)
+            rest_leaves.append(None)
+        else:
+            sel_leaves.append(None)
+            rest_leaves.append(leaf)
+    selected = jax.tree_util.tree_unflatten(treedef, sel_leaves)
+    rest = jax.tree_util.tree_unflatten(treedef, rest_leaves)
+    return selected, rest
+
+
+def combine(selected: PyTree, rest: PyTree) -> PyTree:
+    """Inverse of :func:`partition`: overlay two complementary trees."""
+    sel_leaves, treedef = jax.tree_util.tree_flatten(
+        selected, is_leaf=lambda x: x is None
+    )
+    rest_leaves = jax.tree_util.tree_flatten(rest, is_leaf=lambda x: x is None)[0]
+    merged = []
+    for a, b in zip(sel_leaves, rest_leaves):
+        if (a is None) == (b is None):
+            raise ValueError("partition trees are not complementary")
+        merged.append(a if a is not None else b)
+    return jax.tree_util.tree_unflatten(treedef, merged)
+
+
+def subset_ravel(
+    tree: PyTree, pred: PathPredicate
+) -> Tuple[jnp.ndarray, Callable[[jnp.ndarray], PyTree]]:
+    """Ravel only the leaves whose path matches ``pred``.
+
+    Returns (vector, restore_fn) where ``restore_fn(vec)`` rebuilds the FULL
+    tree with updated selected leaves and untouched rest leaves — the
+    LoRA-only exchange: base weights never enter the wire/collective.
+    """
+    selected, rest = partition(tree, pred)
+    sel_leaves, sel_def = jax.tree_util.tree_flatten(selected)
+    if not sel_leaves:
+        raise ValueError("subset predicate matched no leaves")
+    flat, unravel_sel = flatten_util.ravel_pytree(sel_leaves)
+
+    def restore(vec: jnp.ndarray) -> PyTree:
+        new_leaves = unravel_sel(vec)
+        new_selected = jax.tree_util.tree_unflatten(sel_def, new_leaves)
+        return combine(new_selected, rest)
+
+    return flat, restore
+
+
+def tree_size_bytes(tree: PyTree) -> int:
+    """Total payload bytes of a pytree — the per-exchange wire/ICI volume."""
+    return sum(
+        leaf.size * leaf.dtype.itemsize
+        for leaf in jax.tree_util.tree_leaves(tree)
+    )

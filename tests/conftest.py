@@ -1,0 +1,24 @@
+"""Force an 8-device virtual CPU mesh before jax initializes.
+
+The dev box has a single real chip; multi-peer gossip is exercised the way
+SURVEY.md §4 prescribes — ``--xla_force_host_platform_device_count`` gives N
+JAX devices on CPU, and ``ppermute``/``shard_map`` behave identically to a
+real slice (minus the bandwidth)."""
+
+import os
+
+# The dev image pre-imports jax (sitecustomize) with JAX_PLATFORMS pointed at
+# the real-chip tunnel, so plain env setdefault is too late.  XLA_FLAGS is
+# still read at first backend init, and jax.config can repoint the platform
+# as long as no backend has been created yet.
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")

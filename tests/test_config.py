@@ -1,0 +1,88 @@
+import textwrap
+
+import pytest
+
+from dpwa_tpu.config import (
+    DpwaConfig,
+    InterpolationConfig,
+    ProtocolConfig,
+    config_from_dict,
+    load_config,
+    make_local_config,
+)
+
+
+def test_load_reference_style_yaml(tmp_path):
+    # The schema the reference's examples use: nodes with name/host/port
+    # (SURVEY.md §2 "Config system").
+    cfg_file = tmp_path / "nodes.yaml"
+    cfg_file.write_text(
+        textwrap.dedent(
+            """
+            nodes:
+              - {name: worker0, host: 127.0.0.1, port: 45000}
+              - {name: worker1, host: 127.0.0.1, port: 45001}
+              - {name: worker2, host: 10.0.0.3, port: 45000}
+            protocol:
+              schedule: random
+              fetch_probability: 0.7
+              timeout_ms: 250
+              seed: 3
+            interpolation:
+              type: loss
+              factor: 0.9
+            """
+        )
+    )
+    cfg = load_config(str(cfg_file))
+    assert cfg.n_peers == 3
+    assert cfg.node_names == ("worker0", "worker1", "worker2")
+    assert cfg.node_index("worker2") == 2
+    assert cfg.node("worker2").host == "10.0.0.3"
+    assert cfg.protocol.fetch_probability == 0.7
+    assert cfg.protocol.timeout_ms == 250
+    assert cfg.interpolation.type == "loss"
+    assert cfg.interpolation.factor == 0.9
+
+
+def test_bare_name_nodes():
+    cfg = config_from_dict({"nodes": ["a", "b"]})
+    assert cfg.n_peers == 2
+    assert cfg.nodes[0].port == 0
+
+
+def test_defaults():
+    cfg = config_from_dict({"nodes": ["a", "b"]})
+    assert cfg.protocol.schedule == "ring"
+    assert cfg.interpolation.type == "constant"
+    assert cfg.interpolation.factor == 0.5  # (local+remote)/2
+
+
+@pytest.mark.parametrize(
+    "bad",
+    [
+        {"nodes": []},
+        {"nodes": ["a", "a"]},
+        {},
+        {"nodes": ["a"], "protocol": {"schedule": "nope"}},
+        {"nodes": ["a"], "protocol": {"fetch_probability": 1.5}},
+        {"nodes": ["a"], "interpolation": {"type": "nope"}},
+        {"nodes": ["a"], "interpolation": {"factor": -0.1}},
+    ],
+)
+def test_validation(bad):
+    with pytest.raises((ValueError, KeyError)):
+        config_from_dict(bad)
+
+
+def test_unknown_node_lookup():
+    cfg = make_local_config(2)
+    with pytest.raises(KeyError):
+        cfg.node_index("missing")
+
+
+def test_make_local_config():
+    cfg = make_local_config(4, schedule="random", factor=0.25)
+    assert cfg.n_peers == 4
+    assert cfg.nodes[3].port == 45003
+    assert cfg.interpolation.factor == 0.25

@@ -1,0 +1,170 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dpwa_tpu.config import make_local_config
+from dpwa_tpu.interpolation import PeerMeta
+from dpwa_tpu.parallel.ici import IciTransport
+from dpwa_tpu.parallel.mesh import make_mesh, peer_sharding
+
+
+def stacked_params(n, d=16, key=0):
+    k = jax.random.key(key)
+    return {
+        "w": jax.random.normal(k, (n, d)),
+        "b": jnp.arange(float(n))[:, None] * jnp.ones((n, 4)),
+    }
+
+
+def stacked_meta(n, clocks=None, losses=None):
+    return PeerMeta(
+        jnp.asarray(clocks if clocks is not None else np.ones(n), jnp.float32),
+        jnp.asarray(losses if losses is not None else np.ones(n), jnp.float32),
+    )
+
+
+def make_transport(n=8, **cfg_kwargs):
+    cfg = make_local_config(n, **cfg_kwargs)
+    mesh = make_mesh(cfg)
+    return IciTransport(cfg, mesh=mesh), mesh
+
+
+def test_constant_half_merge_matches_manual_pairing():
+    n = 8
+    t, mesh = make_transport(n, schedule="ring", factor=0.5)
+    params = stacked_params(n)
+    meta = stacked_meta(n)
+    merged, info = t.exchange(params, meta, step=0)
+    perm = t.schedule.pairing(0)
+    np.testing.assert_array_equal(np.asarray(info.partner), perm)
+    for leaf_name in ("w", "b"):
+        want = 0.5 * np.asarray(params[leaf_name]) + 0.5 * np.asarray(
+            params[leaf_name]
+        )[perm]
+        np.testing.assert_allclose(
+            np.asarray(merged[leaf_name]), want, rtol=1e-6
+        )
+    assert np.all(np.asarray(info.alpha) == 0.5)
+
+
+def test_pairwise_merge_preserves_global_mean():
+    # Pairwise averaging is doubly stochastic: the mean over peers is
+    # invariant — the core conservation law of gossip SGD.
+    n = 8
+    t, _ = make_transport(n, schedule="random", pool_size=4)
+    params = stacked_params(n, d=32)
+    meta = stacked_meta(n)
+    cur = params
+    for step in range(6):
+        cur, _ = t.exchange(cur, meta, step)
+    np.testing.assert_allclose(
+        np.asarray(cur["w"]).mean(axis=0),
+        np.asarray(params["w"]).mean(axis=0),
+        rtol=1e-5,
+        atol=1e-6,
+    )
+
+
+def test_repeated_gossip_converges_to_consensus():
+    # With alpha=0.5 and a ring schedule, replicas contract toward the
+    # global mean (gossip consensus).
+    n = 8
+    t, _ = make_transport(n, schedule="ring")
+    params = stacked_params(n, d=8)
+    meta = stacked_meta(n)
+    cur = params
+    for step in range(40):
+        cur, _ = t.exchange(cur, meta, step)
+    w = np.asarray(cur["w"])
+    spread = np.abs(w - w.mean(axis=0)).max()
+    assert spread < 1e-3
+
+
+def test_clock_weighted_fresh_peer_takes_everything():
+    n = 2
+    t, _ = make_transport(n, schedule="ring", interpolation="clock", factor=1.0)
+    params = {"w": jnp.stack([jnp.zeros(4), jnp.ones(4)])}
+    # Peer 0 is fresh (clock 0), peer 1 has trained 10 steps.
+    meta = stacked_meta(n, clocks=[0.0, 10.0])
+    merged, info = t.exchange(params, meta, step=0)
+    alpha = np.asarray(info.alpha)
+    assert alpha[0] == pytest.approx(1.0)  # fresh node adopts peer fully
+    assert alpha[1] == pytest.approx(0.0)  # trained node ignores fresh one
+    np.testing.assert_allclose(np.asarray(merged["w"][0]), np.ones(4))
+    np.testing.assert_allclose(np.asarray(merged["w"][1]), np.ones(4))
+
+
+def test_participation_masking_zeroes_alpha():
+    n = 8
+    t, _ = make_transport(n, schedule="ring", fetch_probability=0.4, seed=7)
+    params = stacked_params(n)
+    meta = stacked_meta(n)
+    saw_skip = saw_merge = False
+    for step in range(10):
+        merged, info = t.exchange(params, meta, step)
+        alpha = np.asarray(info.alpha)
+        part = np.asarray(info.participated)
+        # In-jit draws must equal the host-side schedule view (this is the
+        # hook the TCP-parity test relies on).
+        want = np.array([t.schedule.participates(step, i) for i in range(n)])
+        np.testing.assert_array_equal(part, want)
+        np.testing.assert_array_equal(alpha != 0.0, want)
+        # Non-participants' params must be bit-identical.
+        for i in range(n):
+            if not part[i]:
+                np.testing.assert_array_equal(
+                    np.asarray(merged["w"][i]), np.asarray(params["w"][i])
+                )
+        saw_skip |= bool((~part).any())
+        saw_merge |= bool(part.any())
+    assert saw_skip and saw_merge
+
+
+def test_odd_peer_count_self_pair_is_noop():
+    n = 5
+    t, _ = make_transport(n, schedule="ring")
+    params = stacked_params(n)
+    meta = stacked_meta(n)
+    merged, info = t.exchange(params, meta, step=0)
+    perm = t.schedule.pairing(0)
+    (me,) = [i for i in range(n) if perm[i] == i]
+    assert not np.asarray(info.participated)[me]
+    np.testing.assert_array_equal(
+        np.asarray(merged["w"][me]), np.asarray(params["w"][me])
+    )
+
+
+def test_exchange_is_jit_cached_across_steps():
+    # One compilation serves all steps: pairing selection is on-device
+    # (lax.switch over the static pool), not a per-step recompile.
+    n = 8
+    t, _ = make_transport(n, schedule="random", pool_size=8)
+    params = stacked_params(n)
+    meta = stacked_meta(n)
+    t.exchange(params, meta, 0)
+    compiles_before = t._exchange._cache_size()
+    for step in range(1, 9):
+        t.exchange(params, meta, step)
+    assert t._exchange._cache_size() == compiles_before == 1
+
+
+def test_sharded_inputs_accepted():
+    n = 8
+    cfg = make_local_config(n)
+    mesh = make_mesh(cfg)
+    t = IciTransport(cfg, mesh=mesh)
+    sh = peer_sharding(mesh)
+    params = jax.tree.map(
+        lambda v: jax.device_put(v, sh), stacked_params(n)
+    )
+    meta = jax.tree.map(lambda v: jax.device_put(v, sh), stacked_meta(n))
+    merged, _ = t.exchange(params, meta, 3)
+    assert merged["w"].sharding.spec == sh.spec
+
+
+def test_mesh_size_mismatch_raises():
+    cfg4 = make_local_config(4)
+    mesh8 = make_mesh(make_local_config(8))
+    with pytest.raises(ValueError):
+        IciTransport(cfg4, mesh=mesh8)

@@ -1,0 +1,64 @@
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dpwa_tpu.config import InterpolationConfig
+from dpwa_tpu.interpolation import (
+    PeerMeta,
+    clock_weighted,
+    constant,
+    loss_weighted,
+    make_interpolation,
+)
+
+
+def meta(clock, loss):
+    return PeerMeta(jnp.float32(clock), jnp.float32(loss))
+
+
+def test_constant_is_reference_half_merge():
+    # alpha = 0.5 realises the (local+remote)/2 merge of BASELINE.json:5.
+    a = constant(0.5)(meta(1, 2.0), meta(99, 0.1))
+    assert float(a) == 0.5
+
+
+def test_clock_weighted():
+    f = clock_weighted()
+    # Equal progress → symmetric average.
+    assert float(f(meta(10, 0), meta(10, 0))) == pytest.approx(0.5)
+    # Fresh peer contributes nothing.
+    assert float(f(meta(10, 0), meta(0, 0))) == pytest.approx(0.0)
+    # I am fresh → take (almost) everything from the trained peer.
+    assert float(f(meta(0, 0), meta(10, 0))) == pytest.approx(1.0)
+    # Factor scales.
+    assert float(clock_weighted(0.5)(meta(5, 0), meta(5, 0))) == pytest.approx(
+        0.25
+    )
+
+
+def test_loss_weighted():
+    f = loss_weighted()
+    assert float(f(meta(0, 1.0), meta(0, 1.0))) == pytest.approx(0.5)
+    # My loss much higher → trust the peer.
+    assert float(f(meta(0, 10.0), meta(0, 0.1))) == pytest.approx(
+        10.0 / 10.1, rel=1e-5
+    )
+    # Peer much worse → barely move.
+    assert float(f(meta(0, 0.1), meta(0, 10.0))) == pytest.approx(
+        0.1 / 10.1, rel=1e-4
+    )
+
+
+def test_zero_denominators_are_safe():
+    assert np.isfinite(float(clock_weighted()(meta(0, 0), meta(0, 0))))
+    assert np.isfinite(float(loss_weighted()(meta(0, 0), meta(0, 0))))
+
+
+@pytest.mark.parametrize(
+    "kind,expected",
+    [("constant", 0.3), ("clock", 0.15), ("loss", 0.15)],
+)
+def test_factory(kind, expected):
+    f = make_interpolation(InterpolationConfig(type=kind, factor=0.3))
+    a = float(f(meta(5, 1.0), meta(5, 1.0)))
+    assert a == pytest.approx(expected, rel=1e-5)

@@ -1,0 +1,58 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dpwa_tpu.utils.pytree import (
+    combine,
+    partition,
+    ravel,
+    subset_ravel,
+    tree_size_bytes,
+)
+
+
+def _tree():
+    return {
+        "dense": {"kernel": jnp.arange(6.0).reshape(2, 3), "bias": jnp.ones(3)},
+        "lora_a": jnp.full((2, 2), 2.0),
+        "lora_b": jnp.full((2, 2), 3.0),
+    }
+
+
+def test_ravel_roundtrip():
+    tree = _tree()
+    flat, unravel = ravel(tree)
+    assert flat.ndim == 1
+    assert flat.size == 6 + 3 + 4 + 4
+    back = unravel(flat)
+    jax.tree.map(np.testing.assert_array_equal, back, tree)
+
+
+def test_partition_combine_roundtrip():
+    tree = _tree()
+    sel, rest = partition(tree, lambda p: "lora" in p)
+    assert sel["dense"]["kernel"] is None
+    assert rest["lora_a"] is None
+    back = combine(sel, rest)
+    jax.tree.map(np.testing.assert_array_equal, back, tree)
+
+
+def test_subset_ravel_only_touches_selected():
+    tree = _tree()
+    flat, restore = subset_ravel(tree, lambda p: "lora" in p)
+    assert flat.size == 8  # only the two 2x2 lora leaves
+    new = restore(flat * 10.0)
+    np.testing.assert_array_equal(new["lora_a"], np.full((2, 2), 20.0))
+    np.testing.assert_array_equal(new["lora_b"], np.full((2, 2), 30.0))
+    # Base weights bit-identical — never entered the exchange.
+    np.testing.assert_array_equal(new["dense"]["kernel"], tree["dense"]["kernel"])
+
+
+def test_subset_ravel_empty_match():
+    with pytest.raises(ValueError):
+        subset_ravel(_tree(), lambda p: False)
+
+
+def test_tree_size_bytes():
+    assert tree_size_bytes(_tree()) == (6 + 3 + 4 + 4) * 4

@@ -1,0 +1,111 @@
+import numpy as np
+import pytest
+
+from dpwa_tpu.config import make_local_config
+from dpwa_tpu.parallel.schedules import (
+    Schedule,
+    build_schedule,
+    is_involution,
+    participation_draw,
+)
+
+
+@pytest.mark.parametrize("schedule", ["ring", "random", "hierarchical"])
+@pytest.mark.parametrize("n", [2, 3, 4, 7, 8, 16])
+def test_all_pairings_are_involutions(schedule, n):
+    if schedule == "hierarchical" and n in (3, 7):
+        pytest.skip("hierarchical needs divisible group size")
+    cfg = make_local_config(n, schedule=schedule)
+    sched = build_schedule(cfg)
+    assert sched.pool.shape[1] == n
+    for perm in sched.pool:
+        assert is_involution(perm)
+
+
+def test_ring_alternates_and_covers_neighbors():
+    sched = build_schedule(make_local_config(8, schedule="ring"))
+    assert sched.pool_size == 2
+    # Even phase: (0,1)(2,3)(4,5)(6,7); odd phase: (1,2)(3,4)(5,6)(7,0).
+    np.testing.assert_array_equal(sched.pairing(0), [1, 0, 3, 2, 5, 4, 7, 6])
+    np.testing.assert_array_equal(sched.pairing(1), [7, 2, 1, 4, 3, 6, 5, 0])
+    # Over two steps every peer meets both ring neighbors.
+    partners = {(i, sched.partner(s, i)) for s in (0, 1) for i in range(8)}
+    for i in range(8):
+        assert (i, (i + 1) % 8) in partners or ((i + 1) % 8, i) in partners
+
+
+def test_ring_odd_n_self_pairs_masked():
+    sched = build_schedule(make_local_config(3, schedule="ring"))
+    for step in range(2):
+        perm = sched.pairing(step)
+        selfs = [i for i in range(3) if perm[i] == i]
+        assert len(selfs) == 1  # odd one out
+        i = selfs[0]
+        assert not sched.participates(step, i)  # self-pairs never merge
+
+
+def test_random_pool_is_diverse_and_deterministic():
+    cfg = make_local_config(16, schedule="random", pool_size=16, seed=5)
+    a = build_schedule(cfg)
+    b = build_schedule(cfg)
+    np.testing.assert_array_equal(a.pool, b.pool)  # seed-deterministic
+    distinct = {tuple(p) for p in a.pool}
+    assert len(distinct) > 8  # actually random matchings, not one repeated
+
+
+def test_random_matching_has_no_fixed_points_even_n():
+    sched = build_schedule(make_local_config(8, schedule="random", pool_size=32))
+    for perm in sched.pool:
+        assert np.all(perm != np.arange(8))
+
+
+def test_hierarchical_structure():
+    cfg = make_local_config(
+        16, schedule="hierarchical", group_size=4, inter_period=4
+    )
+    sched = build_schedule(cfg)
+    assert sched.pool_size == 4
+    groups = np.arange(16) // 4
+    # Slots 0..2 stay within a group (intra-host / ICI)...
+    for slot in range(3):
+        perm = sched.pool[slot]
+        assert np.all(groups[perm] == groups)
+    # ...slot 3 crosses groups (inter-host / DCN) for every peer.
+    perm = sched.pool[3]
+    assert np.all(groups[perm] != groups)
+
+
+def test_hierarchical_rejects_indivisible():
+    cfg = make_local_config(6, schedule="hierarchical", group_size=4)
+    with pytest.raises(ValueError):
+        build_schedule(cfg)
+
+
+def test_participation_draw_matches_host_and_is_pair_symmetric():
+    cfg = make_local_config(
+        8, schedule="ring", fetch_probability=0.5, seed=11
+    )
+    sched = build_schedule(cfg)
+    rate = []
+    for step in range(40):
+        for i in range(8):
+            j = sched.partner(step, i)
+            # Both members of a pair draw the same verdict.
+            assert sched.participates(step, i) == sched.participates(step, j)
+            rate.append(sched.participates(step, i))
+    rate = np.mean(rate)
+    assert 0.3 < rate < 0.7  # Bernoulli(0.5) per pair
+
+
+def test_participation_draw_is_jax_host_consistent():
+    # The in-jit path and the host path are the same function; sanity-check
+    # determinism across calls.
+    a = bool(participation_draw(3, 7, 2, 0.5))
+    b = bool(participation_draw(3, 7, 2, 0.5))
+    assert a == b
+
+
+def test_single_peer_schedule():
+    sched = build_schedule(make_local_config(1))
+    assert sched.pairing(0)[0] == 0
+    assert not sched.participates(0, 0)
