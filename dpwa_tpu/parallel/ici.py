@@ -170,7 +170,6 @@ class IciTransport:
                 P(self.axis_name),
                 (P(self.axis_name), P(self.axis_name), P(self.axis_name)),
             ),
-            check_rep=False,
         )
 
         @jax.jit
