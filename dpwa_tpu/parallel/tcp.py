@@ -1,0 +1,217 @@
+"""TCP gossip transport — the reference-equivalent CPU path.
+
+Reproduces the reference's transport semantics (SURVEY.md §2 "TCP transport",
+§3.2/§3.3 call stacks; reference file ``dpwa/conn.py`` — mount empty,
+reconstructed): every worker process runs an **Rx thread** that serves the
+node's most recently *published* flattened parameter vector (plus clock/loss
+metadata) to any peer that connects; the training thread, once per step,
+publishes its own vector, picks a partner, connects, fetches the partner's
+blob with a timeout, and merges on the CPU.  A fetch that times out is simply
+skipped — training continues (the reference's implicit elasticity,
+SURVEY.md §5 "Failure detection").
+
+Differences from the reference, on purpose:
+
+- **No pickle.**  The wire format is a fixed ``struct`` header + raw
+  little-endian float bytes — deserializing untrusted peers with pickle is an
+  RCE; a framed binary format is also faster.
+- **Deterministic rendezvous.**  Peer selection delegates to the same
+  :mod:`~dpwa_tpu.parallel.schedules` pool the ICI transport compiles in, and
+  participation uses the identical threefry draw — so with a lock-step driver
+  the TCP and ICI paths produce bit-comparable merges (SURVEY.md §4 parity).
+  Set ``schedule: random`` + ``fetch_probability < 1`` and run free-running
+  processes to recover the reference's fully asynchronous behavior.
+
+This path exists for capability parity (true multi-process elasticity on
+non-TPU hosts) and as the baseline the ICI path is benchmarked against
+(BASELINE.json:5 — ≥50× averaging throughput target).
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+from dpwa_tpu.config import DpwaConfig
+from dpwa_tpu.interpolation import PeerMeta, make_interpolation
+from dpwa_tpu.parallel.schedules import Schedule, build_schedule
+
+# Wire format: request is the 5-byte magic; response is
+#   header: magic(4s) version(B) dtype(B) clock(d) loss(d) nbytes(Q)
+#   then nbytes of raw little-endian vector data.
+_REQ = b"DPWA?"
+_MAGIC = b"DPWA"
+_HDR = struct.Struct("<4sBBddQ")
+_DTYPES = {0: np.dtype("<f4"), 1: np.dtype("<f8"), 2: np.dtype("<u2")}
+_DTYPE_CODES = {v: k for k, v in _DTYPES.items()}
+_MAX_BLOB = 1 << 34  # 16 GiB sanity bound on advertised payload size
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(min(1 << 20, n - len(buf)))
+        if not chunk:
+            raise ConnectionError("peer closed mid-message")
+        buf += chunk
+    return bytes(buf)
+
+
+class PeerServer:
+    """The Rx thread: serves this node's latest published blob.
+
+    Mirrors the reference's always-on listener (SURVEY.md §3.3): the training
+    thread and the Rx thread share only the publish buffer, guarded by a
+    lock."""
+
+    def __init__(self, host: str, port: int):
+        self._lock = threading.Lock()
+        self._payload: Optional[bytes] = None  # pre-framed header+data
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self.port = self._sock.getsockname()[1]  # resolves port=0 to real port
+        self._sock.listen(16)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._serve, name=f"dpwa-rx:{self.port}", daemon=True
+        )
+        self._thread.start()
+
+    def publish(self, vec: np.ndarray, clock: float, loss: float) -> None:
+        vec = np.ascontiguousarray(vec)
+        dtype = vec.dtype.newbyteorder("<")
+        code = _DTYPE_CODES.get(np.dtype(dtype))
+        if code is None:
+            vec = vec.astype("<f4")
+            code = _DTYPE_CODES[np.dtype("<f4")]
+        data = vec.tobytes()
+        header = _HDR.pack(_MAGIC, 1, code, float(clock), float(loss), len(data))
+        with self._lock:
+            self._payload = header + data
+
+    def _serve(self) -> None:
+        self._sock.settimeout(0.2)
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            try:
+                conn.settimeout(5.0)
+                req = _recv_exact(conn, len(_REQ))
+                if req != _REQ:
+                    continue
+                with self._lock:
+                    payload = self._payload
+                if payload is not None:
+                    conn.sendall(payload)
+            except OSError:
+                pass
+            finally:
+                conn.close()
+
+    def close(self) -> None:
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        self._thread.join(timeout=2.0)
+
+
+def fetch_blob(
+    host: str, port: int, timeout_ms: int
+) -> Optional[Tuple[np.ndarray, float, float]]:
+    """Connect to a peer's Rx thread and pull its latest blob.
+
+    Returns None on timeout / refused connection / malformed reply — the
+    caller skips the merge and keeps training, like the reference."""
+    try:
+        with socket.create_connection(
+            (host, port), timeout=timeout_ms / 1000.0
+        ) as sock:
+            sock.settimeout(timeout_ms / 1000.0)
+            sock.sendall(_REQ)
+            raw = _recv_exact(sock, _HDR.size)
+            magic, version, code, clock, loss, nbytes = _HDR.unpack(raw)
+            if magic != _MAGIC or version != 1 or code not in _DTYPES:
+                return None
+            if nbytes > _MAX_BLOB:
+                return None
+            data = _recv_exact(sock, nbytes)
+            vec = np.frombuffer(data, dtype=_DTYPES[code]).copy()
+            return vec, clock, loss
+    except (OSError, ConnectionError):
+        return None
+
+
+class TcpTransport:
+    """Per-process gossip transport with the reference's update semantics.
+
+    One instance per worker process; ``name`` selects this node's entry in
+    the shared YAML ``nodes:`` list (exactly the reference's CLI contract,
+    SURVEY.md §3.1)."""
+
+    def __init__(self, config: DpwaConfig, name: str):
+        self.config = config
+        self.me = config.node_index(name)
+        self.schedule: Schedule = build_schedule(config)
+        self.interp = make_interpolation(config.interpolation)
+        spec = config.nodes[self.me]
+        self.server = PeerServer(spec.host, spec.port)
+        self._ports = {
+            i: (n.host, n.port) for i, n in enumerate(config.nodes)
+        }
+
+    @property
+    def port(self) -> int:
+        return self.server.port
+
+    def set_peer_port(self, index: int, port: int) -> None:
+        """Tests use OS-assigned ports (port 0); let the driver rewire."""
+        host, _ = self._ports[index]
+        self._ports[index] = (host, port)
+
+    def publish(self, vec: np.ndarray, clock: float, loss: float) -> None:
+        self.server.publish(vec, clock, loss)
+
+    def fetch(
+        self, peer_index: int, timeout_ms: Optional[int] = None
+    ) -> Optional[Tuple[np.ndarray, float, float]]:
+        host, port = self._ports[peer_index]
+        if timeout_ms is None:
+            timeout_ms = self.config.protocol.timeout_ms
+        return fetch_blob(host, port, timeout_ms)
+
+    def exchange(
+        self, vec: np.ndarray, clock: float, loss: float, step: int
+    ) -> Tuple[np.ndarray, float, int]:
+        """One full gossip round: publish, pick partner, fetch, merge.
+
+        Returns (merged_vector, alpha_applied, partner).  alpha == 0.0 means
+        the round was skipped (self-pair, masked, or fetch timeout)."""
+        self.publish(vec, clock, loss)
+        partner = self.schedule.partner(step, self.me)
+        if partner == self.me or not self.schedule.participates(step, self.me):
+            return vec, 0.0, partner
+        got = self.fetch(partner)
+        if got is None:
+            return vec, 0.0, partner  # dead/slow peer: skip, keep training
+        remote_vec, remote_clock, remote_loss = got
+        local = PeerMeta(np.float32(clock), np.float32(loss))
+        remote = PeerMeta(np.float32(remote_clock), np.float32(remote_loss))
+        alpha = float(self.interp(local, remote))
+        merged = (1.0 - alpha) * vec.astype(np.float32) + alpha * remote_vec.astype(
+            np.float32
+        )
+        return merged.astype(vec.dtype), alpha, partner
+
+    def close(self) -> None:
+        self.server.close()

@@ -1,0 +1,92 @@
+"""TCP-vs-ICI parity: same config/seed/schedule => same merged parameters.
+
+SURVEY.md §4: given the same seed and schedule, the reference-equivalent
+CPU/TCP path and the on-device ICI path must produce bit-comparable
+(fp-tolerant) merged parameters.  Driven lock-step (every peer publishes
+before any fetches), which is exactly the synchronous semantics the SPMD
+program executes natively."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dpwa_tpu.config import make_local_config
+from dpwa_tpu.interpolation import PeerMeta
+from dpwa_tpu.parallel.ici import IciTransport
+from dpwa_tpu.parallel.mesh import make_mesh
+from dpwa_tpu.parallel.tcp import TcpTransport
+
+
+def run_tcp(cfg, vecs, clocks, losses, n_steps):
+    n = cfg.n_peers
+    ts = []
+    base = make_local_config(n)  # placeholder, replaced below
+    ts = [TcpTransport(cfg, cfg.nodes[i].name) for i in range(n)]
+    for t in ts:
+        for i, other in enumerate(ts):
+            t.set_peer_port(i, other.port)
+    try:
+        cur = [v.copy() for v in vecs]
+        for step in range(n_steps):
+            # Barrier 1: everyone publishes current state.
+            for i, t in enumerate(ts):
+                t.publish(cur[i], clocks[i], losses[i])
+            # Barrier 2: everyone exchanges against published state.
+            nxt = []
+            for i, t in enumerate(ts):
+                merged, _, _ = t.exchange(cur[i], clocks[i], losses[i], step)
+                nxt.append(merged)
+            cur = nxt
+        return np.stack(cur)
+    finally:
+        for t in ts:
+            t.close()
+
+
+def run_ici(cfg, vecs, clocks, losses, n_steps):
+    mesh = make_mesh(cfg)
+    t = IciTransport(cfg, mesh=mesh)
+    params = {"v": jnp.asarray(np.stack(vecs))}
+    meta = PeerMeta(
+        jnp.asarray(clocks, jnp.float32), jnp.asarray(losses, jnp.float32)
+    )
+    for step in range(n_steps):
+        params, _ = t.exchange(params, meta, step)
+    return np.asarray(params["v"])
+
+
+@pytest.mark.parametrize("schedule", ["ring", "random"])
+@pytest.mark.parametrize("interpolation", ["constant", "clock", "loss"])
+def test_tcp_ici_parity(schedule, interpolation):
+    n, d, steps = 4, 257, 5
+    cfg = make_local_config(
+        n,
+        base_port=0,
+        schedule=schedule,
+        interpolation=interpolation,
+        factor=0.5 if interpolation == "constant" else 1.0,
+        seed=13,
+        pool_size=4,
+    )
+    rng = np.random.default_rng(0)
+    vecs = [rng.standard_normal(d).astype(np.float32) for _ in range(n)]
+    clocks = [float(i + 1) for i in range(n)]
+    losses = [0.5 + 0.1 * i for i in range(n)]
+
+    tcp_out = run_tcp(cfg, vecs, clocks, losses, steps)
+    ici_out = run_ici(cfg, vecs, clocks, losses, steps)
+    np.testing.assert_allclose(tcp_out, ici_out, rtol=1e-5, atol=1e-6)
+
+
+def test_tcp_ici_parity_with_participation_mask():
+    n, d, steps = 4, 64, 8
+    cfg = make_local_config(
+        n, base_port=0, schedule="ring", fetch_probability=0.5, seed=21
+    )
+    rng = np.random.default_rng(1)
+    vecs = [rng.standard_normal(d).astype(np.float32) for _ in range(n)]
+    clocks = [1.0] * n
+    losses = [1.0] * n
+    tcp_out = run_tcp(cfg, vecs, clocks, losses, steps)
+    ici_out = run_ici(cfg, vecs, clocks, losses, steps)
+    np.testing.assert_allclose(tcp_out, ici_out, rtol=1e-5, atol=1e-6)

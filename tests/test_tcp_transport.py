@@ -1,0 +1,163 @@
+"""TCP transport tests: framing, Rx thread, timeouts, lock-step exchange."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from dpwa_tpu.config import make_local_config
+from dpwa_tpu.parallel.tcp import PeerServer, TcpTransport, fetch_blob
+
+
+def make_ring(n, **cfg_kwargs):
+    """n transports on OS-assigned ports, all wired to each other."""
+    cfg = make_local_config(n, base_port=0, **cfg_kwargs)
+    ts = [TcpTransport(cfg, f"node{i}") for i in range(n)]
+    for t in ts:
+        for i, other in enumerate(ts):
+            t.set_peer_port(i, other.port)
+    return ts
+
+
+def close_all(ts):
+    for t in ts:
+        t.close()
+
+
+def test_publish_fetch_roundtrip():
+    server = PeerServer("127.0.0.1", 0)
+    try:
+        vec = np.arange(1000, dtype=np.float32)
+        server.publish(vec, clock=7.0, loss=0.25)
+        got = fetch_blob("127.0.0.1", server.port, timeout_ms=2000)
+        assert got is not None
+        out, clock, loss = got
+        np.testing.assert_array_equal(out, vec)
+        assert clock == 7.0 and loss == 0.25
+    finally:
+        server.close()
+
+
+def test_fetch_before_publish_returns_none_payload_safely():
+    server = PeerServer("127.0.0.1", 0)
+    try:
+        # Nothing published yet: the Rx thread sends nothing and the client
+        # times out cleanly instead of crashing.
+        got = fetch_blob("127.0.0.1", server.port, timeout_ms=200)
+        assert got is None
+    finally:
+        server.close()
+
+
+def test_fetch_dead_peer_times_out():
+    # Nothing listening on this port.
+    got = fetch_blob("127.0.0.1", 1, timeout_ms=200)
+    assert got is None
+
+
+def test_publish_overwrites():
+    server = PeerServer("127.0.0.1", 0)
+    try:
+        server.publish(np.zeros(4, np.float32), 0, 0)
+        server.publish(np.ones(4, np.float32), 1, 0)
+        out, clock, _ = fetch_blob("127.0.0.1", server.port, 2000)
+        np.testing.assert_array_equal(out, np.ones(4, np.float32))
+        assert clock == 1.0
+    finally:
+        server.close()
+
+
+def test_float64_and_bf16_roundtrip():
+    server = PeerServer("127.0.0.1", 0)
+    try:
+        vec = np.linspace(0, 1, 17, dtype=np.float64)
+        server.publish(vec, 0, 0)
+        out, _, _ = fetch_blob("127.0.0.1", server.port, 2000)
+        assert out.dtype == np.float64
+        np.testing.assert_array_equal(out, vec)
+    finally:
+        server.close()
+
+
+def test_two_peer_lockstep_exchange_is_half_merge():
+    ts = make_ring(2, factor=0.5)
+    try:
+        v0 = np.zeros(64, np.float32)
+        v1 = np.ones(64, np.float32)
+        # Lock-step: both publish before either fetches (barrier), so both
+        # merge against pre-merge state — the ICI semantics.
+        ts[0].publish(v0, 1, 0.5)
+        ts[1].publish(v1, 1, 0.5)
+        m0, a0, p0 = ts[0].exchange(v0, 1, 0.5, step=0)
+        m1, a1, p1 = ts[1].exchange(v1, 1, 0.5, step=0)
+        assert (p0, p1) == (1, 0)
+        assert a0 == a1 == 0.5
+        np.testing.assert_allclose(m0, np.full(64, 0.5))
+        np.testing.assert_allclose(m1, np.full(64, 0.5))
+    finally:
+        close_all(ts)
+
+
+def test_exchange_skips_when_masked():
+    ts = make_ring(2, fetch_probability=0.0)
+    try:
+        v = np.ones(8, np.float32)
+        merged, alpha, _ = ts[0].exchange(v, 1, 0, step=0)
+        assert alpha == 0.0
+        np.testing.assert_array_equal(merged, v)
+    finally:
+        close_all(ts)
+
+
+def test_exchange_survives_dead_partner():
+    ts = make_ring(2)
+    try:
+        ts[1].close()  # partner dies
+        cfg_timeout_vec = np.ones(8, np.float32)
+        merged, alpha, partner = ts[0].exchange(cfg_timeout_vec, 1, 0, step=0)
+        assert partner == 1 and alpha == 0.0
+        np.testing.assert_array_equal(merged, cfg_timeout_vec)
+    finally:
+        ts[0].close()
+
+
+def test_four_peer_ring_concurrent_exchange():
+    ts = make_ring(4, schedule="ring")
+    try:
+        vecs = [np.full(32, float(i), np.float32) for i in range(4)]
+        for t, v in zip(ts, vecs):
+            t.publish(v, 1, 1)
+        results = [None] * 4
+        # Free-running threads, like the reference's N processes.
+        def run(i):
+            results[i] = ts[i].exchange(vecs[i], 1, 1, step=0)
+
+        threads = [threading.Thread(target=run, args=(i,)) for i in range(4)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        # Step 0 ring pairing: (0,1) and (2,3); constant alpha = 0.5.
+        np.testing.assert_allclose(results[0][0], np.full(32, 0.5))
+        np.testing.assert_allclose(results[1][0], np.full(32, 0.5))
+        np.testing.assert_allclose(results[2][0], np.full(32, 2.5))
+        np.testing.assert_allclose(results[3][0], np.full(32, 2.5))
+    finally:
+        close_all(ts)
+
+
+def test_clock_weighted_over_tcp():
+    ts = make_ring(2, interpolation="clock", factor=1.0)
+    try:
+        v0 = np.zeros(8, np.float32)
+        v1 = np.ones(8, np.float32)
+        ts[0].publish(v0, 0.0, 0)   # fresh
+        ts[1].publish(v1, 10.0, 0)  # trained
+        m0, a0, _ = ts[0].exchange(v0, 0.0, 0, step=0)
+        m1, a1, _ = ts[1].exchange(v1, 10.0, 0, step=0)
+        assert a0 == pytest.approx(1.0)
+        assert a1 == pytest.approx(0.0)
+        np.testing.assert_allclose(m0, v1)
+        np.testing.assert_allclose(m1, v1)
+    finally:
+        close_all(ts)
