@@ -1,0 +1,2 @@
+from dpwa_tpu.adapters.jax_adapter import DpwaJaxAdapter  # noqa: F401
+from dpwa_tpu.adapters.tcp_adapter import DpwaTcpAdapter  # noqa: F401

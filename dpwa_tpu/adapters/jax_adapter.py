@@ -1,0 +1,104 @@
+"""``DpwaJaxAdapter`` — the ``Dpwa.update()`` API over the ICI transport.
+
+The adapter named by the north-star (BASELINE.json:5): the reference's
+training contract — construct with (model/params, config), then call
+``update(loss)`` once per training step (SURVEY.md §2 "PyTorch adapter",
+reference ``dpwa/adapters/pytorch.py``) — re-expressed for SPMD: one adapter
+instance owns ALL replicas as a peer-stacked, peer-sharded pytree in HBM, and
+each ``update`` advances every replica's gossip round in one XLA program."""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dpwa_tpu.config import DpwaConfig, load_config
+from dpwa_tpu.interpolation import PeerMeta
+from dpwa_tpu.parallel.ici import ExchangeInfo, IciTransport
+from dpwa_tpu.parallel.mesh import peer_sharding
+from dpwa_tpu.train import stack_params
+
+PyTree = Any
+
+
+class DpwaJaxAdapter:
+    """Stateful gossip adapter over the on-device transport.
+
+    Args:
+      params: either a single-replica pytree (replicated to every peer, the
+        reference's warm-start behavior) or an already peer-stacked pytree
+        whose leaves lead with ``n_peers``.
+      config: a :class:`DpwaConfig` or a path to the reference-style YAML.
+      mesh: optional pre-built mesh (defaults to one over visible devices).
+
+    Usage (mirrors the reference's loop)::
+
+        adapter = DpwaJaxAdapter(params, "nodes.yaml")
+        for batch in stream:
+            params, losses = my_train_step(adapter.params, batch)
+            adapter.update(losses, params)   # gossip round, in place
+    """
+
+    def __init__(
+        self,
+        params: PyTree,
+        config: Union[DpwaConfig, str],
+        mesh=None,
+        stacked: Optional[bool] = None,
+    ):
+        if isinstance(config, str):
+            config = load_config(config)
+        self.config = config
+        self.transport = IciTransport(config, mesh=mesh)
+        n = config.n_peers
+        if stacked is None:
+            leaves = jax.tree.leaves(params)
+            stacked = bool(leaves) and all(
+                leaf.ndim >= 1 and leaf.shape[0] == n for leaf in leaves
+            )
+        if not stacked:
+            params = stack_params(params, n)
+        sh = peer_sharding(self.transport.mesh, self.transport.axis_name)
+        self._params = jax.tree.map(lambda v: jax.device_put(v, sh), params)
+        self._clock = jnp.zeros(n, jnp.float32)
+        self._step = 0
+        self.last_info: Optional[ExchangeInfo] = None
+
+    @property
+    def params(self) -> PyTree:
+        return self._params
+
+    @property
+    def step(self) -> int:
+        return self._step
+
+    @property
+    def clock(self) -> jnp.ndarray:
+        return self._clock
+
+    def update(
+        self, loss: Union[float, jnp.ndarray, np.ndarray], params: PyTree = None
+    ) -> PyTree:
+        """One gossip round — the reference's per-step ``update(loss)``.
+
+        ``loss`` may be a scalar (same on every peer) or a per-peer [n]
+        vector; it feeds the loss-weighted interpolation and rides along
+        with the exchange as metadata."""
+        if params is not None:
+            self._params = params
+        n = self.config.n_peers
+        losses = jnp.broadcast_to(
+            jnp.asarray(loss, jnp.float32).reshape(-1), (n,)
+        ) if np.ndim(loss) == 0 or np.shape(loss) == () else jnp.asarray(
+            loss, jnp.float32
+        )
+        self._clock = self._clock + 1.0
+        meta = PeerMeta(self._clock, losses)
+        self._params, self.last_info = self.transport.exchange(
+            self._params, meta, self._step
+        )
+        self._step += 1
+        return self._params

@@ -1,0 +1,123 @@
+"""Per-process adapters over the TCP transport (reference drop-in surface).
+
+Two flavors:
+
+- :class:`DpwaTcpAdapter` — holds a JAX/numpy pytree; the process-per-peer
+  deployment model of the reference with this framework's pytree types.
+- :class:`DpwaTorchAdapter` — the reference's exact user surface
+  (``DpwaPyTorchAdapter(model, name, config)`` + ``update(loss)``,
+  SURVEY.md §2 "PyTorch adapter"): flattens ``model.parameters()`` to one
+  contiguous vector, gossips it over TCP, and writes the merge back into the
+  live torch model in place on CPU."""
+
+from __future__ import annotations
+
+from typing import Any, Union
+
+import numpy as np
+
+from dpwa_tpu.config import DpwaConfig, load_config
+from dpwa_tpu.parallel.tcp import TcpTransport
+from dpwa_tpu.utils.pytree import ravel
+
+PyTree = Any
+
+
+def _resolve(config: Union[DpwaConfig, str]) -> DpwaConfig:
+    return load_config(config) if isinstance(config, str) else config
+
+
+class DpwaTcpAdapter:
+    """Reference-style per-process adapter for a JAX/numpy pytree."""
+
+    def __init__(self, params: PyTree, name: str, config: Union[DpwaConfig, str]):
+        self.config = _resolve(config)
+        self.transport = TcpTransport(self.config, name)
+        flat, self._unravel = ravel(params)
+        self._vec = np.asarray(flat, dtype=np.float32)
+        self._clock = 0.0
+        self._step = 0
+        self.last_alpha = 0.0
+        self.last_partner = -1
+        # Serve initial weights immediately (reference init publishes too).
+        self.transport.publish(self._vec, self._clock, 0.0)
+
+    @property
+    def params(self) -> PyTree:
+        return self._unravel(self._vec)
+
+    @property
+    def step(self) -> int:
+        return self._step
+
+    def update(self, loss: float, params: PyTree = None) -> PyTree:
+        if params is not None:
+            self._vec = np.asarray(ravel(params)[0], dtype=np.float32)
+        self._clock += 1.0
+        self._vec, self.last_alpha, self.last_partner = self.transport.exchange(
+            self._vec, self._clock, float(loss), self._step
+        )
+        self._step += 1
+        return self.params
+
+    def close(self) -> None:
+        self.transport.close()
+
+
+class DpwaTorchAdapter:
+    """The reference's ``DpwaPyTorchAdapter`` surface, verbatim.
+
+    Keeps existing reference-user training scripts working unchanged: only
+    the import path changes (capability-parity requirement, SURVEY.md §1
+    "Key architectural property")."""
+
+    def __init__(self, model, name: str, config: Union[DpwaConfig, str]):
+        import torch  # local import: torch is optional for the framework
+
+        self._torch = torch
+        self.model = model
+        self.config = _resolve(config)
+        self.transport = TcpTransport(self.config, name)
+        self._clock = 0.0
+        self._step = 0
+        self.last_alpha = 0.0
+        self.last_partner = -1
+        self.transport.publish(self._flatten(), self._clock, 0.0)
+
+    def _flatten(self) -> np.ndarray:
+        with self._torch.no_grad():
+            parts = [
+                p.detach().cpu().numpy().ravel() for p in self.model.parameters()
+            ]
+        return (
+            np.concatenate(parts).astype(np.float32)
+            if parts
+            else np.zeros(0, np.float32)
+        )
+
+    def _unflatten_into_model(self, vec: np.ndarray) -> None:
+        torch = self._torch
+        offset = 0
+        with torch.no_grad():
+            for p in self.model.parameters():
+                n = p.numel()
+                chunk = vec[offset : offset + n].reshape(tuple(p.shape))
+                p.copy_(torch.from_numpy(np.ascontiguousarray(chunk)).to(p.dtype))
+                offset += n
+
+    def update(self, loss: float) -> None:
+        self._clock += 1.0
+        vec = self._flatten()
+        merged, self.last_alpha, self.last_partner = self.transport.exchange(
+            vec, self._clock, float(loss), self._step
+        )
+        self._step += 1
+        if self.last_alpha != 0.0:
+            self._unflatten_into_model(merged)
+
+    def close(self) -> None:
+        self.transport.close()
+
+
+# Alias matching the reference's class name exactly.
+DpwaPyTorchAdapter = DpwaTorchAdapter

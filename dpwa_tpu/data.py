@@ -1,0 +1,131 @@
+"""Offline datasets and per-peer data streams.
+
+Gossip training's defining trait: **each peer trains on its own data
+stream** (SURVEY.md "What dpwa is").  :func:`peer_batches` materializes that —
+given one dataset it deals every peer a disjoint shard and an independent
+shuffle, and yields peer-stacked ``[n_peers, batch, ...]`` arrays ready to be
+sharded over the mesh.
+
+This box has zero network egress, so the loaders are offline-first:
+``sklearn``'s bundled 8×8 digits for a real image-classification task, plus
+synthetic Gaussian-blob tasks for fast unit tests.  A real MNIST/CIFAR
+directory is picked up if one exists on disk."""
+
+from __future__ import annotations
+
+import os
+from typing import Iterator, Tuple
+
+import numpy as np
+
+Array = np.ndarray
+
+
+def gaussian_blobs(
+    n_classes: int = 4,
+    dim: int = 16,
+    n_per_class: int = 256,
+    seed: int = 0,
+    spread: float = 0.5,
+) -> Tuple[Array, Array]:
+    """Linearly separable-ish classification task for fast tests."""
+    rng = np.random.default_rng(seed)
+    centers = rng.standard_normal((n_classes, dim)) * 3.0
+    xs, ys = [], []
+    for c in range(n_classes):
+        xs.append(centers[c] + spread * rng.standard_normal((n_per_class, dim)))
+        ys.append(np.full(n_per_class, c))
+    x = np.concatenate(xs).astype(np.float32)
+    y = np.concatenate(ys).astype(np.int32)
+    order = rng.permutation(len(x))
+    return x[order], y[order]
+
+
+def load_digits_dataset(
+    test_fraction: float = 0.2, seed: int = 0
+) -> Tuple[Array, Array, Array, Array]:
+    """8×8 grayscale digits (1797 samples, bundled with sklearn) as NHWC."""
+    from sklearn.datasets import load_digits
+
+    digits = load_digits()
+    x = (digits.images.astype(np.float32) / 16.0)[..., None]  # [N, 8, 8, 1]
+    y = digits.target.astype(np.int32)
+    rng = np.random.default_rng(seed)
+    order = rng.permutation(len(x))
+    x, y = x[order], y[order]
+    n_test = int(len(x) * test_fraction)
+    return x[n_test:], y[n_test:], x[:n_test], y[:n_test]
+
+
+def find_mnist_dir() -> str | None:
+    """Look for an on-disk MNIST (idx or npz) without any network access."""
+    for root in ("/root/datasets", "/root/data", "/datasets", "/tmp/mnist"):
+        if os.path.isdir(root):
+            for name in ("mnist.npz", "train-images-idx3-ubyte"):
+                if os.path.exists(os.path.join(root, name)):
+                    return root
+    return None
+
+
+def load_mnist_or_digits() -> Tuple[Array, Array, Array, Array, str]:
+    """Full MNIST if present on disk, else the bundled 8×8 digits.
+
+    Returns (x_train, y_train, x_test, y_test, dataset_name)."""
+    root = find_mnist_dir()
+    if root is not None:
+        npz = os.path.join(root, "mnist.npz")
+        if os.path.exists(npz):
+            with np.load(npz) as d:
+                x_tr = d["x_train"].astype(np.float32)[..., None] / 255.0
+                x_te = d["x_test"].astype(np.float32)[..., None] / 255.0
+                return (
+                    x_tr,
+                    d["y_train"].astype(np.int32),
+                    x_te,
+                    d["y_test"].astype(np.int32),
+                    "mnist",
+                )
+    x_tr, y_tr, x_te, y_te = load_digits_dataset()
+    return x_tr, y_tr, x_te, y_te, "digits"
+
+
+def peer_split(
+    x: Array, y: Array, n_peers: int, seed: int = 0
+) -> Tuple[list, list]:
+    """Deal the dataset into n disjoint per-peer shards (own data streams)."""
+    rng = np.random.default_rng(seed)
+    order = rng.permutation(len(x))
+    shard = len(x) // n_peers
+    xs = [x[order[i * shard : (i + 1) * shard]] for i in range(n_peers)]
+    ys = [y[order[i * shard : (i + 1) * shard]] for i in range(n_peers)]
+    return xs, ys
+
+
+def peer_batches(
+    x: Array,
+    y: Array,
+    n_peers: int,
+    batch_size: int,
+    seed: int = 0,
+) -> Iterator[Tuple[Array, Array]]:
+    """Endless stream of peer-stacked batches ``([n, b, ...], [n, b])``.
+
+    Each peer cycles its own shard with an independent shuffle — the
+    SPMD stand-in for the reference's N independent data loaders."""
+    xs, ys = peer_split(x, y, n_peers, seed)
+    rngs = [np.random.default_rng(seed + 1000 + i) for i in range(n_peers)]
+    cursors = [np.array([], dtype=np.int64)] * n_peers
+    while True:
+        bx, by = [], []
+        for i in range(n_peers):
+            while len(cursors[i]) < batch_size:
+                cursors[i] = np.concatenate(
+                    [cursors[i], rngs[i].permutation(len(xs[i]))]
+                )
+            take, cursors[i] = (
+                cursors[i][:batch_size],
+                cursors[i][batch_size:],
+            )
+            bx.append(xs[i][take])
+            by.append(ys[i][take])
+        yield np.stack(bx), np.stack(by)

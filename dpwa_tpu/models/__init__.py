@@ -1,0 +1,1 @@
+from dpwa_tpu.models.mnist import ConvNet, SmallNet  # noqa: F401

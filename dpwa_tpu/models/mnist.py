@@ -1,0 +1,45 @@
+"""MNIST-class ConvNets (Flax).
+
+The reference's only example model is a stock PyTorch MNIST ConvNet wired to
+the adapter (SURVEY.md §2 "MNIST example"; reference ``examples/mnist`` —
+mount empty).  :class:`ConvNet` is the TPU-native equivalent for 28×28
+inputs; :class:`SmallNet` is a scaled-down sibling for the 8×8
+``sklearn.datasets.load_digits`` images used by the offline test suite."""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class ConvNet(nn.Module):
+    """Conv(32)→Conv(64)→pool→Dense(128)→Dense(classes), for 28×28×1."""
+
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        x = nn.Conv(32, (3, 3))(x)
+        x = nn.relu(x)
+        x = nn.Conv(64, (3, 3))(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(128)(x)
+        x = nn.relu(x)
+        return nn.Dense(self.num_classes)(x)
+
+
+class SmallNet(nn.Module):
+    """Tiny net for 8×8 digits: one conv + one hidden dense."""
+
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        x = nn.Conv(16, (3, 3))(x)
+        x = nn.relu(x)
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(64)(x)
+        x = nn.relu(x)
+        return nn.Dense(self.num_classes)(x)

@@ -137,6 +137,15 @@ class IciTransport:
                 f"mesh axis {axis_name!r} has size {axis_size} but config "
                 f"names {config.n_peers} peers"
             )
+        # XLA:CPU's in-process collectives rendezvous on a shared thread
+        # pool; on thread-starved hosts, letting many in-flight steps queue
+        # up deadlocks the pool (threads blocked in step k+j's rendezvous
+        # starve the laggards of step k, which aborts after 40s).  Bounding
+        # run-ahead to one step on CPU meshes removes the hazard; real TPU
+        # meshes keep fully async dispatch.
+        self._block_per_call = all(
+            d.platform == "cpu" for d in self.mesh.devices.flat
+        )
         self._exchange = self._build_exchange()
 
     def _build_exchange(self):
@@ -190,4 +199,7 @@ class IciTransport:
           meta: :class:`PeerMeta` of ``[n_peers]`` float32 arrays.
           step: int — selects the pairing and the participation draw.
         """
-        return self._exchange(params, meta, jnp.asarray(step, jnp.int32))
+        out = self._exchange(params, meta, jnp.asarray(step, jnp.int32))
+        if self._block_per_call:
+            jax.block_until_ready(out)
+        return out

@@ -1,0 +1,199 @@
+"""The SPMD gossip training loop.
+
+The reference's hot loop (SURVEY.md §3.2) is::
+
+    forward / loss.backward() / optimizer.step()   # local, per process
+    adapter.update(loss)                           # publish, fetch, merge
+
+Here the entire loop — per-peer forward/backward, optax update, AND the
+gossip exchange — is **one jitted ``shard_map`` program** over the ``peers``
+mesh axis (SURVEY.md §3.5).  Manual SPMD, deliberately: auto sharding
+propagation through vmapped convolutions makes GSPMD introduce all-gathers
+of the per-peer replicas, which is both a performance bug (the whole point
+of gossip is that nothing is globally gathered) and a deadlock on
+thread-starved CPU test meshes.  Inside ``shard_map`` every peer's
+forward/backward/optimizer math is provably local; the **only** collective
+in the compiled program is the pairing ``ppermute`` of the exchange."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from dpwa_tpu.interpolation import PeerMeta
+from dpwa_tpu.parallel.ici import (
+    ExchangeInfo,
+    IciTransport,
+    gossip_exchange_local,
+)
+from dpwa_tpu.parallel.mesh import peer_sharding
+
+PyTree = Any
+# loss_fn(single_peer_params, (x, y)) -> scalar loss
+LossFn = Callable[[PyTree, Tuple[jnp.ndarray, jnp.ndarray]], jnp.ndarray]
+
+
+class GossipTrainState(NamedTuple):
+    """Peer-stacked training state. Every leaf's leading axis is n_peers."""
+
+    params: PyTree
+    opt_state: PyTree
+    clock: jnp.ndarray  # float32[n] — steps trained, rides with exchanges
+    step: jnp.ndarray  # int32 scalar — global schedule position
+
+
+def init_gossip_state(
+    stacked_params: PyTree,
+    optimizer: optax.GradientTransformation,
+    transport: IciTransport,
+) -> GossipTrainState:
+    """Build state from peer-stacked params and shard it over the mesh."""
+    n = transport.config.n_peers
+    leading = {leaf.shape[0] for leaf in jax.tree.leaves(stacked_params)}
+    if leading != {n}:
+        raise ValueError(
+            f"stacked params must have leading peer axis {n}, got {leading}"
+        )
+    opt_state = jax.vmap(optimizer.init)(stacked_params)
+    sh = peer_sharding(transport.mesh, transport.axis_name)
+    put = lambda t: jax.tree.map(lambda v: jax.device_put(v, sh), t)
+    return GossipTrainState(
+        params=put(stacked_params),
+        opt_state=put(opt_state),
+        clock=jax.device_put(jnp.zeros(n, jnp.float32), sh),
+        step=jnp.int32(0),
+    )
+
+
+def stack_params(params: PyTree, n_peers: int) -> PyTree:
+    """Replicate one pytree n times along a new leading peer axis —
+    identical warm start on every peer (the reference's default: every
+    process builds the same model)."""
+    return jax.tree.map(
+        lambda v: jnp.broadcast_to(v[None], (n_peers,) + v.shape), params
+    )
+
+
+def init_params_per_peer(
+    init_fn: Callable[[jax.Array], PyTree], key: jax.Array, n_peers: int
+) -> PyTree:
+    """Independent random init per peer (diverged cold start)."""
+    return jax.vmap(init_fn)(jax.random.split(key, n_peers))
+
+
+def make_gossip_train_step(
+    loss_fn: LossFn,
+    optimizer: optax.GradientTransformation,
+    transport: IciTransport,
+):
+    """Returns jitted ``train_step(state, batch) -> (state, losses, info)``.
+
+    ``batch`` is a peer-stacked ``(x[n, b, ...], y[n, b])`` pair; ``losses``
+    is float32[n] (per peer) and also becomes the metadata the
+    loss-weighted interpolation sees, matching the reference's
+    ``update(loss)`` argument."""
+    grad_fn = jax.value_and_grad(loss_fn)
+    schedule, interp = transport.schedule, transport.interp
+    axis, mesh = transport.axis_name, transport.mesh
+    shard = lambda t: jax.tree.map(lambda v: v[0], t)
+    unshard = lambda t: jax.tree.map(lambda v: v[None], t)
+
+    def body(params, opt_state, clock, step, batch):
+        # Local (per-device) values: strip the size-1 peer block axis.
+        params, opt_state = shard(params), shard(opt_state)
+        x, y = batch
+        loss, grads = grad_fn(params, (x[0], y[0]))
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        clock = clock[0] + 1.0
+        meta = PeerMeta(clock, loss.astype(jnp.float32))
+        merged, (partner, alpha, part) = gossip_exchange_local(
+            params, meta, step, schedule=schedule, interp=interp, axis_name=axis
+        )
+        return (
+            unshard(merged),
+            unshard(opt_state),
+            clock[None],
+            loss[None],
+            (partner[None], alpha[None], part[None]),
+        )
+
+    mapped = shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(axis), P(axis), P(axis), P(), P(axis)),
+        out_specs=(P(axis), P(axis), P(axis), P(axis), P(axis)),
+    )
+
+    @jax.jit
+    def _step(state: GossipTrainState, batch):
+        params, opt_state, clock, losses, info = mapped(
+            state.params, state.opt_state, state.clock, state.step, batch
+        )
+        new_state = GossipTrainState(
+            params=params,
+            opt_state=opt_state,
+            clock=clock,
+            step=state.step + 1,
+        )
+        return new_state, losses, ExchangeInfo(*info)
+
+    # Same CPU run-ahead bound as IciTransport.exchange: the in-process
+    # collective rendezvous deadlocks a thread-starved host if many steps'
+    # collectives are in flight.  TPU meshes stay fully async.
+    block_per_call = all(d.platform == "cpu" for d in mesh.devices.flat)
+
+    def train_step(state: GossipTrainState, batch):
+        out = _step(state, batch)
+        if block_per_call:
+            jax.block_until_ready(out)
+        return out
+
+    return train_step
+
+
+def make_gossip_eval_fn(
+    apply_fn: Callable[[PyTree, jnp.ndarray], jnp.ndarray],
+    transport: IciTransport = None,
+):
+    """Returns jitted ``eval_fn(stacked_params, x, y) -> accuracy[n]``.
+
+    Evaluates every peer's replica on the same (replicated) test set.  With
+    a ``transport``, runs as shard_map so each replica is evaluated on its
+    own device with zero collectives; without one, falls back to vmap."""
+
+    def one(params, x, y):
+        logits = apply_fn(params, x)
+        return jnp.mean(jnp.argmax(logits, -1) == y)
+
+    if transport is None:
+
+        @jax.jit
+        def eval_fn(stacked_params, x, y):
+            return jax.vmap(lambda p: one(p, x, y))(stacked_params)
+
+        return eval_fn
+
+    axis, mesh = transport.axis_name, transport.mesh
+
+    def body(stacked_params, x, y):
+        params = jax.tree.map(lambda v: v[0], stacked_params)
+        return one(params, x, y)[None]
+
+    mapped = shard_map(
+        body, mesh=mesh, in_specs=(P(axis), P(), P()), out_specs=P(axis)
+    )
+    return jax.jit(mapped)
+
+
+def consensus_params(stacked_params: PyTree) -> PyTree:
+    """Mean over the peer axis — the 'deployed' model after training.
+
+    Gossip preserves this mean at every exchange (doubly-stochastic merges),
+    so it is the natural final artifact."""
+    return jax.tree.map(lambda v: v.mean(axis=0), stacked_params)

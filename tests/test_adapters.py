@@ -1,0 +1,103 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dpwa_tpu.adapters.jax_adapter import DpwaJaxAdapter
+from dpwa_tpu.adapters.tcp_adapter import DpwaTcpAdapter, DpwaTorchAdapter
+from dpwa_tpu.config import make_local_config
+
+
+def test_jax_adapter_replicates_single_pytree():
+    cfg = make_local_config(8)
+    params = {"w": jnp.arange(4.0)}
+    ad = DpwaJaxAdapter(params, cfg)
+    assert ad.params["w"].shape == (8, 4)
+    # Identical replicas + alpha=0.5 merge => params unchanged.
+    out = ad.update(1.0)
+    np.testing.assert_allclose(
+        np.asarray(out["w"]), np.tile(np.arange(4.0), (8, 1))
+    )
+    assert ad.step == 1
+
+
+def test_jax_adapter_accepts_stacked_params_and_yaml(tmp_path):
+    yaml_file = tmp_path / "nodes.yaml"
+    yaml_file.write_text(
+        "nodes: [a, b, c, d, e, f, g, h]\n"
+        "interpolation: {type: constant, factor: 0.5}\n"
+    )
+    stacked = {"w": jnp.arange(8.0)[:, None] * jnp.ones((8, 3))}
+    ad = DpwaJaxAdapter(stacked, str(yaml_file))
+    out = ad.update(np.ones(8))
+    # Ring step 0 pairs (0,1)(2,3)...: each pair averages.
+    w = np.asarray(out["w"])
+    np.testing.assert_allclose(w[0], np.full(3, 0.5))
+    np.testing.assert_allclose(w[1], np.full(3, 0.5))
+    np.testing.assert_allclose(w[6], np.full(3, 6.5))
+
+
+def test_jax_adapter_gossip_reaches_consensus():
+    cfg = make_local_config(8, schedule="ring")
+    stacked = {"w": jnp.arange(8.0)[:, None] * jnp.ones((8, 2))}
+    ad = DpwaJaxAdapter(stacked, cfg)
+    for _ in range(30):
+        ad.update(1.0)
+    w = np.asarray(ad.params["w"])
+    np.testing.assert_allclose(w, np.full((8, 2), 3.5), atol=1e-3)
+
+
+def _wire(adapters):
+    for a in adapters:
+        for i, other in enumerate(adapters):
+            a.transport.set_peer_port(i, other.transport.port)
+
+
+def test_tcp_adapter_two_process_merge():
+    cfg = make_local_config(2, base_port=0)
+    a0 = DpwaTcpAdapter({"w": jnp.zeros(4)}, "node0", cfg)
+    a1 = DpwaTcpAdapter({"w": jnp.ones(4)}, "node1", cfg)
+    try:
+        _wire([a0, a1])
+        # publish happens in update(); run one lock-step round.
+        a0.transport.publish(np.zeros(4, np.float32), 1, 1)
+        a1.transport.publish(np.ones(4, np.float32), 1, 1)
+        p0 = a0.update(1.0)
+        p1 = a1.update(1.0)
+        np.testing.assert_allclose(np.asarray(p0["w"]), np.full(4, 0.5))
+        np.testing.assert_allclose(np.asarray(p1["w"]), np.full(4, 0.5))
+        assert a0.last_partner == 1 and a1.last_partner == 0
+    finally:
+        a0.close()
+        a1.close()
+
+
+def test_torch_adapter_reference_surface():
+    torch = pytest.importorskip("torch")
+    model0 = torch.nn.Linear(4, 2)
+    model1 = torch.nn.Linear(4, 2)
+    with torch.no_grad():
+        for p in model0.parameters():
+            p.zero_()
+        for p in model1.parameters():
+            p.fill_(1.0)
+    cfg = make_local_config(2, base_port=0)
+    a0 = DpwaTorchAdapter(model0, "node0", cfg)
+    a1 = DpwaTorchAdapter(model1, "node1", cfg)
+    try:
+        _wire([a0, a1])
+        a0.transport.publish(a0._flatten(), 1, 1)
+        a1.transport.publish(a1._flatten(), 1, 1)
+        a0.update(0.5)
+        a1.update(0.5)
+        for p in model0.parameters():
+            np.testing.assert_allclose(
+                p.detach().numpy(), np.full(tuple(p.shape), 0.5)
+            )
+        for p in model1.parameters():
+            np.testing.assert_allclose(
+                p.detach().numpy(), np.full(tuple(p.shape), 0.5)
+            )
+    finally:
+        a0.close()
+        a1.close()
