@@ -1,0 +1,192 @@
+#!/usr/bin/env python
+"""Headline benchmark: pairwise-averaging bandwidth, TPU vs reference CPU/TCP.
+
+Measures the hot operation of the framework — the gossip exchange
+``x ← (1−α)·x + α·x_partner`` — on the accelerator, against the
+reference-equivalent baseline (flattened float32 vector over a localhost TCP
+socket + CPU axpy merge; SURVEY.md §3.2 hot spots).  BASELINE.json:2 names
+this (pairwise-avg GB/s/chip) the metric; the north-star target is ≥50× the
+CPU/TCP path (BASELINE.json:5).
+
+Accounting (SURVEY.md §7 "honest GB/s/chip"): one exchange moves
+2 × vector-bytes per peer (receive the partner's vector, write the merge).
+With N real devices the exchange is the actual ``ppermute`` collective; on a
+single chip it is the stacked virtual-peer merge (same math, measures the
+on-chip HBM path).  Both are reported per chip.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": ..., "unit": "GB/s/chip", "vs_baseline": ...}
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+import time
+
+import numpy as np
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def bench_device(d: int, n_peers: int, iters: int) -> float:
+    """Averaging bandwidth on the default JAX backend, GB/s per chip."""
+    import jax
+    import jax.numpy as jnp
+
+    devices = jax.devices()
+    log(f"device backend: {devices[0].platform} x{len(devices)}")
+
+    if len(devices) >= n_peers:
+        # Real multi-device path: the actual transport collective.
+        from dpwa_tpu.config import make_local_config
+        from dpwa_tpu.interpolation import PeerMeta
+        from dpwa_tpu.parallel.ici import IciTransport
+        from dpwa_tpu.parallel.mesh import make_mesh, peer_sharding
+
+        cfg = make_local_config(n_peers, schedule="ring")
+        mesh = make_mesh(cfg, devices=devices[:n_peers])
+        transport = IciTransport(cfg, mesh=mesh)
+        sh = peer_sharding(mesh)
+        x = jax.device_put(
+            jnp.ones((n_peers, d), jnp.float32)
+            * jnp.arange(n_peers, dtype=jnp.float32)[:, None],
+            sh,
+        )
+        meta = PeerMeta(
+            jnp.ones(n_peers, jnp.float32), jnp.ones(n_peers, jnp.float32)
+        )
+        params = {"v": x}
+        merged, _ = transport.exchange(params, meta, 0)  # warmup/compile
+        float(merged["v"].sum())
+        t0 = time.perf_counter()
+        for step in range(iters):
+            params, _ = transport.exchange(params, meta, step)
+        # Host readback forces real completion (async dispatch would
+        # otherwise let timing observe only the enqueue).
+        float(params["v"].sum())
+        dt = time.perf_counter() - t0
+        # Per chip: each chip receives d*4 bytes and writes d*4 bytes.
+        bytes_per_chip = 2 * d * 4 * iters
+        return bytes_per_chip / dt / 1e9
+
+    # Single-chip path: stacked virtual peers (SURVEY.md §7 note), ring
+    # pairing resolved as data by the fused merge op (Pallas on TPU: one
+    # pipelined HBM pass; scalar-prefetched partner row indices).
+    from dpwa_tpu.ops.merge import pairwise_merge
+    from dpwa_tpu.parallel.schedules import _ring_even, _ring_odd
+
+    perms = jnp.asarray(
+        np.stack([_ring_even(n_peers), _ring_odd(n_peers)]), jnp.int32
+    )
+    alphas = jnp.full((n_peers,), 0.5, jnp.float32)
+
+    x = jnp.ones((n_peers, d), jnp.float32) * jnp.arange(
+        n_peers, dtype=jnp.float32
+    )[:, None]
+    x2 = pairwise_merge(x, perms[0], alphas)
+    float(x2.sum())
+    t0 = time.perf_counter()
+    for step in range(iters):
+        x = pairwise_merge(x, perms[step % 2], alphas)
+    # Host readback forces real completion (see multi-device note above).
+    float(x.sum())
+    dt = time.perf_counter() - t0
+    # All n virtual peers live on the one chip: it reads the permuted
+    # partner vector and writes the merge for each -> 2*d*4 bytes per peer.
+    total_bytes = n_peers * 2 * d * 4 * iters
+    return total_bytes / dt / 1e9
+
+
+def bench_tcp(d: int, iters: int, timeout_ms: int = 10000) -> float:
+    """Reference-equivalent baseline: 2 peers, localhost TCP, CPU merge."""
+    from dpwa_tpu.config import make_local_config
+    from dpwa_tpu.parallel.tcp import TcpTransport
+
+    cfg = make_local_config(
+        2, base_port=0, schedule="ring", timeout_ms=timeout_ms
+    )
+    ts = [TcpTransport(cfg, f"node{i}") for i in range(2)]
+    for t in ts:
+        for i, other in enumerate(ts):
+            t.set_peer_port(i, other.port)
+    try:
+        vecs = [
+            np.full(d, float(i), np.float32) for i in range(2)
+        ]
+        # Warmup round.
+        for i, t in enumerate(ts):
+            t.publish(vecs[i], 0, 0)
+        for i, t in enumerate(ts):
+            t.exchange(vecs[i], 0, 0, 0)
+
+        durations = []
+        for it in range(iters):
+            for i, t in enumerate(ts):
+                t.publish(vecs[i], it, 0)
+            results = [None, None]
+
+            def run(i):
+                results[i] = ts[i].exchange(vecs[i], it, 0, 0)
+
+            t0 = time.perf_counter()
+            threads = [
+                threading.Thread(target=run, args=(i,)) for i in range(2)
+            ]
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join()
+            durations.append(time.perf_counter() - t0)
+            assert results[0][1] != 0.0, "TCP exchange failed"
+        dt = float(np.median(durations))
+        # Per peer per exchange: receive d*4 bytes + write the merge d*4.
+        return 2 * d * 4 / dt / 1e9
+    finally:
+        for t in ts:
+            t.close()
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument(
+        "--size", type=int, default=24 * 1024 * 1024,
+        help="flat vector length (floats); default ~100MB, ResNet-50 scale "
+        "(multiple of 1024 so the Pallas fast path applies)",
+    )
+    ap.add_argument("--peers", type=int, default=8)
+    ap.add_argument("--iters", type=int, default=30)
+    ap.add_argument("--tcp-iters", type=int, default=5)
+    ap.add_argument(
+        "--tcp-size", type=int, default=0,
+        help="TCP vector length (defaults to --size)",
+    )
+    args = ap.parse_args()
+
+    tcp_d = args.tcp_size or args.size
+    log(f"TCP baseline: d={tcp_d} ({tcp_d * 4 / 1e6:.0f} MB) ...")
+    tcp_gbps = bench_tcp(tcp_d, args.tcp_iters)
+    log(f"TCP baseline: {tcp_gbps:.3f} GB/s/peer")
+
+    log(f"device path: d={args.size}, peers={args.peers} ...")
+    dev_gbps = bench_device(args.size, args.peers, args.iters)
+    log(f"device path: {dev_gbps:.2f} GB/s/chip")
+
+    print(
+        json.dumps(
+            {
+                "metric": "pairwise_avg_bandwidth",
+                "value": round(dev_gbps, 3),
+                "unit": "GB/s/chip",
+                "vs_baseline": round(dev_gbps / tcp_gbps, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

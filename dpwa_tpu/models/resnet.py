@@ -1,0 +1,164 @@
+"""ResNets for the CIFAR-10 / ImageNet gossip benchmarks (Flax).
+
+BASELINE.json configs 2–3: CIFAR-10 ResNet-20 8-peer ring gossip (the
+headline benchmark) and ImageNet ResNet-50 32-peer random-pair.  The
+reference never defines these models itself — it wraps stock torchvision
+models through its adapter — so these are clean-room Flax implementations of
+the standard architectures (He et al. 2015; CIFAR variant per section 4.2 of
+the paper).
+
+TPU-first choices:
+
+- NHWC layout and 3×3 convs → XLA maps convs onto the MXU directly.
+- ``norm='group'`` (default) keeps the forward pass a pure function of
+  params — no mutable batch-stats collection — which keeps the whole gossip
+  train step a single fused SPMD program and avoids cross-replica stat
+  entanglement (each gossip peer would otherwise carry diverging BN stats
+  that the exchange must also merge).  ``norm='batch'`` is available for
+  strict parity experiments; its ``batch_stats`` ride along as ordinary
+  merged state.
+- bfloat16 compute / float32 params via the ``dtype`` knob.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+ModuleDef = Any
+
+
+def _norm(norm: str, dtype) -> Callable[..., nn.Module]:
+    if norm == "group":
+        return partial(nn.GroupNorm, num_groups=None, group_size=16, dtype=dtype)
+    if norm == "batch":
+        return partial(
+            nn.BatchNorm, use_running_average=False, momentum=0.9, dtype=dtype
+        )
+    raise ValueError(f"unknown norm {norm!r}")
+
+
+class BasicBlock(nn.Module):
+    """3×3 + 3×3 residual block (ResNet-20/32/44/56 family)."""
+
+    filters: int
+    strides: int
+    norm: ModuleDef
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = nn.Conv(
+            self.filters, (3, 3), (self.strides, self.strides),
+            use_bias=False, dtype=self.dtype,
+        )(x)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.filters, (3, 3), use_bias=False, dtype=self.dtype)(y)
+        y = self.norm()(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(
+                self.filters, (1, 1), (self.strides, self.strides),
+                use_bias=False, dtype=self.dtype,
+            )(residual)
+            residual = self.norm()(residual)
+        return nn.relu(y + residual)
+
+
+class BottleneckBlock(nn.Module):
+    """1×1 → 3×3 → 1×1 bottleneck (ResNet-50 family)."""
+
+    filters: int
+    strides: int
+    norm: ModuleDef
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = nn.Conv(self.filters, (1, 1), use_bias=False, dtype=self.dtype)(x)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = nn.Conv(
+            self.filters, (3, 3), (self.strides, self.strides),
+            use_bias=False, dtype=self.dtype,
+        )(y)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.filters * 4, (1, 1), use_bias=False, dtype=self.dtype)(y)
+        y = self.norm()(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(
+                self.filters * 4, (1, 1), (self.strides, self.strides),
+                use_bias=False, dtype=self.dtype,
+            )(residual)
+            residual = self.norm()(residual)
+        return nn.relu(y + residual)
+
+
+class CifarResNet(nn.Module):
+    """CIFAR-style ResNet: 3×3 stem, 3 stages of n blocks at 16/32/64."""
+
+    depth: int = 20
+    num_classes: int = 10
+    norm_type: str = "group"
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        if (self.depth - 2) % 6 != 0:
+            raise ValueError("CIFAR ResNet depth must be 6n+2")
+        n = (self.depth - 2) // 6
+        norm = _norm(self.norm_type, self.dtype)
+        x = x.astype(self.dtype)
+        x = nn.Conv(16, (3, 3), use_bias=False, dtype=self.dtype)(x)
+        x = norm()(x)
+        x = nn.relu(x)
+        for stage, filters in enumerate((16, 32, 64)):
+            for block in range(n):
+                strides = 2 if stage > 0 and block == 0 else 1
+                x = BasicBlock(filters, strides, norm, self.dtype)(x)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+
+
+def ResNet20(**kw) -> CifarResNet:
+    return CifarResNet(depth=20, **kw)
+
+
+def ResNet56(**kw) -> CifarResNet:
+    return CifarResNet(depth=56, **kw)
+
+
+class ImageNetResNet(nn.Module):
+    """ImageNet-style ResNet with bottleneck blocks (ResNet-50 default)."""
+
+    stage_sizes: Sequence[int] = (3, 4, 6, 3)
+    num_classes: int = 1000
+    norm_type: str = "group"
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        norm = _norm(self.norm_type, self.dtype)
+        x = x.astype(self.dtype)
+        x = nn.Conv(64, (7, 7), (2, 2), use_bias=False, dtype=self.dtype)(x)
+        x = norm()(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for stage, (size, filters) in enumerate(
+            zip(self.stage_sizes, (64, 128, 256, 512))
+        ):
+            for block in range(size):
+                strides = 2 if stage > 0 and block == 0 else 1
+                x = BottleneckBlock(filters, strides, norm, self.dtype)(x)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+
+
+def ResNet50(**kw) -> ImageNetResNet:
+    return ImageNetResNet(stage_sizes=(3, 4, 6, 3), **kw)

@@ -1,0 +1,1 @@
+from dpwa_tpu.ops.merge import pairwise_merge, pallas_pairwise_merge  # noqa: F401
