@@ -1,0 +1,53 @@
+"""Checkpoint / resume (Orbax).
+
+The reference has no checkpointing — users call ``torch.save`` themselves
+(SURVEY.md §5 "Checkpoint/resume").  The rebuild ships it first-class: the
+full :class:`~dpwa_tpu.train.GossipTrainState` — params, optimizer state,
+per-peer clocks, and the global schedule position ``step`` — is saved
+atomically and restored sharded.  Saving ``step`` matters specifically for
+gossip: the pairing schedule and the participation draws are deterministic
+functions of it, so a resumed run continues the exact exchange sequence.
+
+Per-peer divergence is preserved: replicas legitimately differ between
+exchanges, and the peer-stacked leaves capture every replica, not one
+canonical copy."""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+from dpwa_tpu.train import GossipTrainState
+
+PyTree = Any
+
+
+def save_checkpoint(path: str, state: GossipTrainState) -> None:
+    """Atomically save a gossip training state to ``path`` (a directory)."""
+    path = os.path.abspath(path)
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(path, dict(state._asdict()), force=True)
+
+
+def restore_checkpoint(
+    path: str, like: Optional[GossipTrainState] = None
+) -> GossipTrainState:
+    """Restore a state saved by :func:`save_checkpoint`.
+
+    ``like`` (same treedef/shapes/shardings as the saved state) restores
+    arrays onto the right devices/shardings; without it, arrays come back
+    as host numpy."""
+    path = os.path.abspath(path)
+    with ocp.StandardCheckpointer() as ckptr:
+        if like is not None:
+            target = jax.tree.map(
+                ocp.utils.to_shape_dtype_struct, dict(like._asdict())
+            )
+            restored = ckptr.restore(path, target)
+        else:
+            restored = ckptr.restore(path)
+    return GossipTrainState(**restored)

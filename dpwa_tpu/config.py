@@ -53,11 +53,16 @@ class ProtocolConfig:
     pool_size: int = 16
     group_size: int = 0
     inter_period: int = 4
+    drop_probability: float = 0.0  # fault injection: drop pairs at this rate
 
     def __post_init__(self) -> None:
         if not 0.0 <= self.fetch_probability <= 1.0:
             raise ValueError(
                 f"fetch_probability must be in [0, 1], got {self.fetch_probability}"
+            )
+        if not 0.0 <= self.drop_probability <= 1.0:
+            raise ValueError(
+                f"drop_probability must be in [0, 1], got {self.drop_probability}"
             )
         if self.schedule not in ("ring", "random", "hierarchical"):
             raise ValueError(f"unknown schedule {self.schedule!r}")

@@ -1,0 +1,85 @@
+"""Structured per-step metrics (JSONL).
+
+The reference logs free-text lines via the ``logging`` module (peer chosen,
+α, clocks — SURVEY.md §5 "Metrics/logging").  The rebuild emits structured
+records instead: one JSON object per step with loss, exchange partner, α,
+participation, bytes moved, and wall-clock timings, to stdout and/or a
+JSONL file — greppable and plottable without parsing prose."""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from typing import IO, Any, Mapping, Optional
+
+import numpy as np
+
+
+def _jsonable(v: Any) -> Any:
+    if isinstance(v, (np.integer,)):
+        return int(v)
+    if isinstance(v, (np.floating,)):
+        return float(v)
+    if isinstance(v, np.ndarray):
+        return v.tolist()
+    if hasattr(v, "tolist"):  # jax arrays
+        return np.asarray(v).tolist()
+    return v
+
+
+class MetricsLogger:
+    """Writes one JSON object per record; stdlib-only, no deps."""
+
+    def __init__(
+        self,
+        path: Optional[str] = None,
+        stream: Optional[IO[str]] = None,
+        every: int = 1,
+    ):
+        self._file = open(path, "a", encoding="utf-8") if path else None
+        self._stream = stream
+        self.every = max(1, every)
+        self._t0 = time.perf_counter()
+
+    def log(self, step: int, **fields: Any) -> None:
+        if step % self.every != 0:
+            return
+        rec: dict[str, Any] = {
+            "step": int(step),
+            "t": round(time.perf_counter() - self._t0, 4),
+        }
+        for k, v in fields.items():
+            rec[k] = _jsonable(v)
+        line = json.dumps(rec)
+        if self._file is not None:
+            self._file.write(line + "\n")
+            self._file.flush()
+        if self._stream is not None:
+            print(line, file=self._stream, flush=True)
+
+    def log_exchange(
+        self,
+        step: int,
+        losses,
+        info,
+        payload_bytes: int,
+        **extra: Any,
+    ) -> None:
+        """Convenience: the standard gossip-round record."""
+        alpha = np.asarray(info.alpha)
+        part = np.asarray(info.participated)
+        self.log(
+            step,
+            loss_mean=float(np.asarray(losses).mean()),
+            losses=losses,
+            partner=info.partner,
+            alpha=alpha,
+            participated=part,
+            exchanged_bytes=int(payload_bytes * int(part.sum())),
+            **extra,
+        )
+
+    def close(self) -> None:
+        if self._file is not None:
+            self._file.close()

@@ -96,6 +96,17 @@ def gossip_exchange_local(
         drawn = participation_draw(
             schedule.seed, step, pair_id, schedule.fetch_probability
         )
+    if schedule.drop_probability > 0.0:
+        # Fault injection: masked merge (α=0) is the SPMD form of the
+        # reference's timed-out fetch (SURVEY.md §5).
+        drawn = jnp.logical_and(
+            drawn,
+            jnp.logical_not(
+                schedules.fault_draw(
+                    schedule.seed, step, pair_id, schedule.drop_probability
+                )
+            ),
+        )
     participated = jnp.logical_and(drawn, partner != me)
     alpha = jnp.where(participated, interp(meta, remote_meta), 0.0)
     alpha = alpha.astype(jnp.float32)

@@ -31,6 +31,18 @@ import numpy as np
 from dpwa_tpu.config import DpwaConfig
 
 
+def _pair_key(seed, step, pair_id, tag: int):
+    return jax.random.fold_in(
+        jax.random.fold_in(
+            jax.random.fold_in(
+                jax.random.key(seed), jnp.asarray(step, jnp.int32)
+            ),
+            jnp.asarray(pair_id, jnp.int32),
+        ),
+        tag,
+    )
+
+
 def participation_draw(seed, step, pair_id, fetch_probability):
     """One Bernoulli per (step, pair), shared by both members of the pair.
 
@@ -40,11 +52,20 @@ def participation_draw(seed, step, pair_id, fetch_probability):
     TCP-vs-ICI parity test (SURVEY.md §4) bit-comparable.  All of ``step`` and
     ``pair_id`` may be traced.
     """
-    key = jax.random.fold_in(
-        jax.random.fold_in(jax.random.key(seed), jnp.asarray(step, jnp.int32)),
-        jnp.asarray(pair_id, jnp.int32),
-    )
-    return jax.random.uniform(key) < fetch_probability
+    return jax.random.uniform(_pair_key(seed, step, pair_id, 0)) < fetch_probability
+
+
+def fault_draw(seed, step, pair_id, drop_probability):
+    """Fault-injection draw: True means this pair's exchange is DROPPED.
+
+    The gossip failure model (SURVEY.md §5 "Failure detection"): a failed
+    fetch is skipped and training continues.  A nonzero
+    ``protocol.drop_probability`` injects such failures at a configured rate
+    — an independent threefry stream (tag 1) from the participation draw, so
+    the two knobs compose without correlation.  Same stream on the host (TCP
+    path times out naturally, but injection lets tests force it) and in-jit
+    (masked merge, α=0)."""
+    return jax.random.uniform(_pair_key(seed, step, pair_id, 1)) < drop_probability
 
 
 def is_involution(perm: np.ndarray) -> bool:
@@ -62,11 +83,17 @@ def _ring_even(n: int) -> np.ndarray:
 
 
 def _ring_odd(n: int) -> np.ndarray:
-    """Pair (1,2),(3,4),... and close the ring with (n-1, 0) when n is even."""
+    """Pair (1,2),(3,4),... and close the ring with (n-1, 0) when n is even.
+
+    n == 2 keeps the single pair active in both phases — a 2-node ring has
+    only one edge, and idling it every other step would halve the exchange
+    rate for no reason."""
+    if n == 2:
+        return np.array([1, 0])
     perm = np.arange(n)
     for i in range(1, n - 1, 2):
         perm[i], perm[i + 1] = i + 1, i
-    if n % 2 == 0 and n > 2:
+    if n % 2 == 0:
         perm[n - 1], perm[0] = 0, n - 1
     return perm
 
@@ -136,6 +163,7 @@ class Schedule:
     fetch_probability: float
     seed: int
     name: str
+    drop_probability: float = 0.0
 
     @property
     def pool_size(self) -> int:
@@ -160,13 +188,17 @@ class Schedule:
         p = self.partner(step, i)
         if p == i:
             return False
-        if self.fetch_probability >= 1.0:
-            return True
-        return bool(
+        pair_id = min(i, p)
+        ok = self.fetch_probability >= 1.0 or bool(
             participation_draw(
-                self.seed, step, min(i, p), self.fetch_probability
+                self.seed, step, pair_id, self.fetch_probability
             )
         )
+        if ok and self.drop_probability > 0.0:
+            ok = not bool(
+                fault_draw(self.seed, step, pair_id, self.drop_probability)
+            )
+        return ok
 
 
 def build_schedule(config: DpwaConfig) -> Schedule:
@@ -197,6 +229,7 @@ def build_schedule(config: DpwaConfig) -> Schedule:
         fetch_probability=proto.fetch_probability,
         seed=proto.seed,
         name=proto.schedule,
+        drop_probability=proto.drop_probability,
     )
 
 

@@ -1,0 +1,80 @@
+"""Device bootstrap helpers for examples and entry points.
+
+JAX freezes its platform choice at first backend initialization, so "run an
+n-peer mesh on whatever this host has" needs the decision made BEFORE
+anything touches ``jax.devices()``.  :func:`ensure_devices` centralizes the
+policy:
+
+- ``native``: use the platform jax picked (real TPU slice); error if it has
+  fewer than n devices.
+- ``cpu``: force an n-device host-platform (emulated) mesh — the SURVEY.md
+  §4 test topology.
+- ``auto`` (default): if the environment already provides ≥n devices, use
+  them; otherwise, if no backend is initialized yet, fall back to the
+  emulated CPU mesh (dev boxes); otherwise raise with the fix.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def ensure_devices(n: int, mode: str = "auto"):
+    """Return a list of ≥n jax devices, forcing a CPU mesh if allowed."""
+    import jax
+    from jax._src import xla_bridge as xb
+
+    def force_cpu() -> None:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={n}"
+            ).strip()
+        jax.config.update("jax_platforms", "cpu")
+
+    if mode == "cpu":
+        if xb.backends_are_initialized():
+            if jax.default_backend() != "cpu" or len(jax.devices()) < n:
+                raise RuntimeError(
+                    "jax already initialized on "
+                    f"{jax.default_backend()} x{len(jax.devices())}; "
+                    "set XLA_FLAGS=--xla_force_host_platform_device_count="
+                    f"{n} JAX_PLATFORMS=cpu before starting python"
+                )
+        else:
+            force_cpu()
+        return jax.devices()[:n]
+
+    if mode == "native":
+        devices = jax.devices()
+        if len(devices) < n:
+            raise RuntimeError(
+                f"need {n} devices, have {len(devices)} "
+                f"({devices[0].platform})"
+            )
+        return devices[:n]
+
+    # auto — checking the native platform would initialize it irreversibly,
+    # so with no backend up yet: honor an existing force-flag, else default
+    # to the emulated CPU mesh (dev-box friendly; real-slice users pass
+    # mode='native').
+    if not xb.backends_are_initialized():
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" in flags:
+            devices = jax.devices()
+            if len(devices) >= n:
+                return devices[:n]
+            raise RuntimeError(
+                f"XLA_FLAGS provides {len(devices)} devices but config "
+                f"names {n} peers"
+            )
+        force_cpu()
+        return jax.devices()[:n]
+    devices = jax.devices()
+    if len(devices) >= n:
+        return devices[:n]
+    raise RuntimeError(
+        f"need {n} devices, have {len(devices)}; relaunch with "
+        f"XLA_FLAGS=--xla_force_host_platform_device_count={n} "
+        f"JAX_PLATFORMS=cpu for an emulated mesh"
+    )
