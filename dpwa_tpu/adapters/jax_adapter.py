@@ -48,10 +48,12 @@ class DpwaJaxAdapter:
         config: Union[DpwaConfig, str],
         mesh=None,
         stacked: Optional[bool] = None,
+        exchange_filter=None,
     ):
         if isinstance(config, str):
             config = load_config(config)
         self.config = config
+        self.exchange_filter = exchange_filter
         self.transport = IciTransport(config, mesh=mesh)
         n = config.n_peers
         if stacked is None:
@@ -97,8 +99,19 @@ class DpwaJaxAdapter:
         )
         self._clock = self._clock + 1.0
         meta = PeerMeta(self._clock, losses)
-        self._params, self.last_info = self.transport.exchange(
-            self._params, meta, self._step
-        )
+        if self.exchange_filter is not None:
+            # Subset-pytree gossip: only matching leaves enter the
+            # collective (BASELINE.json:11 — LoRA adapters only).
+            from dpwa_tpu.utils.pytree import combine, partition
+
+            selected, rest = partition(self._params, self.exchange_filter)
+            merged_sel, self.last_info = self.transport.exchange(
+                selected, meta, self._step
+            )
+            self._params = combine(merged_sel, rest)
+        else:
+            self._params, self.last_info = self.transport.exchange(
+                self._params, meta, self._step
+            )
         self._step += 1
         return self._params

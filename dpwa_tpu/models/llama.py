@@ -1,0 +1,240 @@
+"""Compact Llama-family decoder with LoRA, for subset-pytree gossip.
+
+BASELINE.json:11 (config 5): "Llama-3-8B LoRA fine-tune, pairwise-avg of
+LoRA adapters across v5p-128" — only the LoRA adapter weights enter the
+gossip exchange; base weights never move.  The reference never touches model
+internals (it sees a flat parameter vector, SURVEY.md §5 "Long-context"), so
+this is a clean-room Flax implementation of the standard architecture:
+RMSNorm, rotary position embeddings, multi-head causal attention, SwiGLU
+MLP.  ``llama3_8b_config()`` gives the real dimensions; tests and the
+dry-run use tiny configs — same code, same pytree paths.
+
+LoRA: :class:`LoRADense` adds ``lora_a``/``lora_b`` factors beside the
+frozen base kernel.  Every LoRA leaf's path contains ``lora_``, so the
+subset predicate :func:`lora_filter` selects exactly the adapter state for
+the exchange (``dpwa_tpu.utils.pytree.partition``)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    d_model: int = 512
+    n_layers: int = 4
+    n_heads: int = 8
+    n_kv_heads: Optional[int] = None  # GQA; None = MHA
+    d_ff: int = 1376
+    max_seq_len: int = 2048
+    rope_theta: float = 500000.0
+    lora_rank: int = 0  # 0 = no LoRA
+    lora_alpha: float = 16.0
+    dtype: jnp.dtype = jnp.float32
+
+    @property
+    def kv_heads(self) -> int:
+        return self.n_kv_heads or self.n_heads
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+
+def llama3_8b_config(lora_rank: int = 16) -> LlamaConfig:
+    """The real Llama-3-8B dimensions (public architecture constants)."""
+    return LlamaConfig(
+        vocab_size=128256,
+        d_model=4096,
+        n_layers=32,
+        n_heads=32,
+        n_kv_heads=8,
+        d_ff=14336,
+        max_seq_len=8192,
+        rope_theta=500000.0,
+        lora_rank=lora_rank,
+        dtype=jnp.bfloat16,
+    )
+
+
+def lora_filter(path: str) -> bool:
+    """Subset predicate: the LoRA adapter leaves (and nothing else)."""
+    return "lora_" in path
+
+
+class LoRADense(nn.Module):
+    """Dense with a rank-r LoRA delta: ``y = x·W + (α/r)·x·A·B``.
+
+    The base kernel is ordinary Flax state (frozen by the optimizer mask in
+    LoRA fine-tuning); ``lora_a``/``lora_b`` are the trainable, gossiped
+    adapter."""
+
+    features: int
+    rank: int
+    alpha: float = 16.0
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        in_features = x.shape[-1]
+        kernel = self.param(
+            "kernel",
+            nn.initializers.lecun_normal(),
+            (in_features, self.features),
+        )
+        y = x @ kernel.astype(self.dtype)
+        if self.rank > 0:
+            lora_a = self.param(
+                "lora_a",
+                nn.initializers.normal(stddev=0.02),
+                (in_features, self.rank),
+            )
+            lora_b = self.param(
+                "lora_b", nn.initializers.zeros, (self.rank, self.features)
+            )
+            scale = self.alpha / self.rank
+            y = y + (x @ lora_a.astype(self.dtype)) @ lora_b.astype(
+                self.dtype
+            ) * scale
+        return y
+
+
+class RMSNorm(nn.Module):
+    eps: float = 1e-5
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],))
+        var = jnp.mean(jnp.square(x.astype(jnp.float32)), -1, keepdims=True)
+        return (x * jax.lax.rsqrt(var + self.eps)).astype(self.dtype) * scale
+
+
+def rope(x: jnp.ndarray, positions: jnp.ndarray, theta: float) -> jnp.ndarray:
+    """Rotary embedding over the last (head_dim) axis. x: [..., T, H, D]."""
+    d = x.shape[-1]
+    freqs = 1.0 / (
+        theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d)
+    )
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [T, D/2]
+    cos = jnp.cos(angles)[..., None, :]  # [T, 1, D/2]
+    sin = jnp.sin(angles)[..., None, :]
+    x1, x2 = x[..., ::2], x[..., 1::2]
+    out1 = x1 * cos - x2 * sin
+    out2 = x1 * sin + x2 * cos
+    return jnp.stack([out1, out2], axis=-1).reshape(x.shape).astype(x.dtype)
+
+
+class Attention(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x, positions):
+        cfg = self.cfg
+        B, T, _ = x.shape
+        H, KV, D = cfg.n_heads, cfg.kv_heads, cfg.head_dim
+        dense = lambda feats, name: LoRADense(
+            feats, cfg.lora_rank, cfg.lora_alpha, cfg.dtype, name=name
+        )
+        q = dense(H * D, "wq")(x).reshape(B, T, H, D)
+        k = dense(KV * D, "wk")(x).reshape(B, T, KV, D)
+        v = dense(KV * D, "wv")(x).reshape(B, T, KV, D)
+        q = rope(q, positions, cfg.rope_theta)
+        k = rope(k, positions, cfg.rope_theta)
+        if KV != H:  # GQA: repeat kv heads
+            rep = H // KV
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
+        scores = jnp.einsum("bthd,bshd->bhts", q, k) / jnp.sqrt(D).astype(
+            cfg.dtype
+        )
+        mask = jnp.tril(jnp.ones((T, T), bool))
+        scores = jnp.where(mask[None, None], scores, -jnp.inf)
+        probs = jax.nn.softmax(scores.astype(jnp.float32), -1).astype(cfg.dtype)
+        out = jnp.einsum("bhts,bshd->bthd", probs, v).reshape(B, T, H * D)
+        return dense(cfg.d_model, "wo")(out)
+
+
+class MLP(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        dense = lambda feats, name: LoRADense(
+            feats, cfg.lora_rank, cfg.lora_alpha, cfg.dtype, name=name
+        )
+        gate = dense(cfg.d_ff, "w_gate")(x)
+        up = dense(cfg.d_ff, "w_up")(x)
+        return dense(cfg.d_model, "w_down")(nn.silu(gate) * up)
+
+
+class Block(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x, positions):
+        cfg = self.cfg
+        x = x + Attention(cfg, name="attn")(
+            RMSNorm(dtype=cfg.dtype, name="attn_norm")(x), positions
+        )
+        x = x + MLP(cfg, name="mlp")(
+            RMSNorm(dtype=cfg.dtype, name="mlp_norm")(x)
+        )
+        return x
+
+
+class Llama(nn.Module):
+    """Decoder-only LM; returns logits [B, T, vocab]."""
+
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, tokens):
+        cfg = self.cfg
+        B, T = tokens.shape
+        x = nn.Embed(
+            cfg.vocab_size, cfg.d_model, dtype=cfg.dtype, name="embed"
+        )(tokens)
+        positions = jnp.arange(T)
+        for i in range(cfg.n_layers):
+            x = Block(cfg, name=f"layer_{i}")(x, positions)
+        x = RMSNorm(dtype=cfg.dtype, name="final_norm")(x)
+        logits = nn.Dense(
+            cfg.vocab_size, use_bias=False, dtype=jnp.float32, name="lm_head"
+        )(x)
+        return logits
+
+
+def lora_mask(params) -> object:
+    """Pytree of bools: True on LoRA leaves (trainable), False on base."""
+    from dpwa_tpu.utils.pytree import _path_str
+
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    treedef = jax.tree_util.tree_structure(params)
+    return jax.tree_util.tree_unflatten(
+        treedef, [lora_filter(_path_str(p)) for p, _ in flat]
+    )
+
+
+def lora_optimizer(base_opt, params):
+    """LoRA fine-tune optimizer: train adapters, hard-freeze base weights.
+
+    (``optax.masked(opt, mask)`` alone is NOT a freeze — it passes unmasked
+    gradients through as raw updates.  Base leaves here get
+    ``set_to_zero``, so they stay bit-identical to init, matching config
+    5's 'full base weights untouched'.)"""
+    import optax
+
+    labels = jax.tree.map(
+        lambda is_lora: "train" if is_lora else "freeze", lora_mask(params)
+    )
+    return optax.multi_transform(
+        {"train": base_opt, "freeze": optax.set_to_zero()}, labels
+    )

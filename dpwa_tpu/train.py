@@ -17,7 +17,7 @@ in the compiled program is the pairing ``ppermute`` of the exchange."""
 
 from __future__ import annotations
 
-from typing import Any, Callable, NamedTuple, Tuple
+from typing import Any, Callable, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -32,6 +32,8 @@ from dpwa_tpu.parallel.ici import (
     gossip_exchange_local,
 )
 from dpwa_tpu.parallel.mesh import peer_sharding
+from dpwa_tpu.utils.pytree import combine as pytree_combine
+from dpwa_tpu.utils.pytree import partition as pytree_partition
 
 PyTree = Any
 # loss_fn(single_peer_params, (x, y)) -> scalar loss
@@ -90,13 +92,18 @@ def make_gossip_train_step(
     loss_fn: LossFn,
     optimizer: optax.GradientTransformation,
     transport: IciTransport,
+    exchange_filter: Optional[Callable[[str], bool]] = None,
 ):
     """Returns jitted ``train_step(state, batch) -> (state, losses, info)``.
 
     ``batch`` is a peer-stacked ``(x[n, b, ...], y[n, b])`` pair; ``losses``
     is float32[n] (per peer) and also becomes the metadata the
     loss-weighted interpolation sees, matching the reference's
-    ``update(loss)`` argument."""
+    ``update(loss)`` argument.
+
+    ``exchange_filter`` enables subset-pytree gossip (BASELINE.json:11, the
+    LoRA config): only leaves whose path matches the predicate enter the
+    collective; everything else never moves — neither over ICI nor DCN."""
     grad_fn = jax.value_and_grad(loss_fn)
     schedule, interp = transport.schedule, transport.interp
     axis, mesh = transport.axis_name, transport.mesh
@@ -106,15 +113,23 @@ def make_gossip_train_step(
     def body(params, opt_state, clock, step, batch):
         # Local (per-device) values: strip the size-1 peer block axis.
         params, opt_state = shard(params), shard(opt_state)
-        x, y = batch
-        loss, grads = grad_fn(params, (x[0], y[0]))
+        loss, grads = grad_fn(params, shard(batch))
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         clock = clock[0] + 1.0
         meta = PeerMeta(clock, loss.astype(jnp.float32))
-        merged, (partner, alpha, part) = gossip_exchange_local(
-            params, meta, step, schedule=schedule, interp=interp, axis_name=axis
-        )
+        if exchange_filter is not None:
+            selected, rest = pytree_partition(params, exchange_filter)
+            merged_sel, (partner, alpha, part) = gossip_exchange_local(
+                selected, meta, step,
+                schedule=schedule, interp=interp, axis_name=axis,
+            )
+            merged = pytree_combine(merged_sel, rest)
+        else:
+            merged, (partner, alpha, part) = gossip_exchange_local(
+                params, meta, step,
+                schedule=schedule, interp=interp, axis_name=axis,
+            )
         return (
             unshard(merged),
             unshard(opt_state),

@@ -1,0 +1,160 @@
+"""Config-5 semantics: Llama+LoRA with subset-pytree gossip.
+
+BASELINE.json:11 — pairwise-average ONLY the LoRA adapters; full base
+weights untouched (never exchanged, never trained)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from dpwa_tpu.config import make_local_config
+from dpwa_tpu.models.llama import (
+    Llama,
+    LlamaConfig,
+    llama3_8b_config,
+    lora_filter,
+    lora_optimizer,
+)
+from dpwa_tpu.parallel.ici import IciTransport
+from dpwa_tpu.parallel.mesh import make_mesh
+from dpwa_tpu.train import (
+    init_gossip_state,
+    init_params_per_peer,
+    make_gossip_train_step,
+)
+from dpwa_tpu.utils.pytree import partition
+
+
+def tiny_cfg(lora_rank=4):
+    return LlamaConfig(
+        vocab_size=64,
+        d_model=32,
+        n_layers=2,
+        n_heads=4,
+        n_kv_heads=2,
+        d_ff=64,
+        max_seq_len=32,
+        lora_rank=lora_rank,
+    )
+
+
+def test_llama_forward_shapes():
+    cfg = tiny_cfg()
+    model = Llama(cfg)
+    tokens = jnp.zeros((2, 16), jnp.int32)
+    params = model.init(jax.random.key(0), tokens)
+    logits = model.apply(params, tokens)
+    assert logits.shape == (2, 16, 64)
+    assert jnp.all(jnp.isfinite(logits))
+
+
+def test_llama_gqa_matches_mha_shape():
+    cfg = tiny_cfg()
+    assert cfg.kv_heads == 2  # GQA path exercised
+    model = Llama(cfg)
+    tokens = jnp.arange(16)[None] % 64
+    params = model.init(jax.random.key(1), tokens)
+    assert jnp.all(jnp.isfinite(model.apply(params, tokens)))
+
+
+def test_causality():
+    """Changing a future token must not affect earlier logits."""
+    cfg = tiny_cfg(lora_rank=0)
+    model = Llama(cfg)
+    t1 = jnp.asarray([[1, 2, 3, 4, 5, 6, 7, 8]])
+    t2 = t1.at[0, -1].set(42)
+    params = model.init(jax.random.key(0), t1)
+    l1 = model.apply(params, t1)
+    l2 = model.apply(params, t2)
+    np.testing.assert_allclose(
+        np.asarray(l1[0, :-1]), np.asarray(l2[0, :-1]), atol=1e-5
+    )
+
+
+def test_lora_filter_selects_only_adapters():
+    cfg = tiny_cfg()
+    model = Llama(cfg)
+    params = model.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))
+    sel, rest = partition(params, lora_filter)
+    sel_leaves = [l for l in jax.tree.leaves(sel)]
+    rest_leaves = [l for l in jax.tree.leaves(rest)]
+    assert sel_leaves and rest_leaves
+    # every selected leaf has rank-4 factor shape
+    n_lora = sum(1 for l in sel_leaves)
+    # 2 layers x (4 attn + 3 mlp) LoRADense x 2 factors
+    assert n_lora == 2 * 7 * 2
+
+
+def test_llama3_8b_config_real_dims():
+    cfg = llama3_8b_config()
+    assert cfg.d_model == 4096 and cfg.n_layers == 32
+    assert cfg.kv_heads == 8 and cfg.d_ff == 14336
+
+
+def test_lora_subset_gossip_leaves_base_untouched():
+    n = 4
+    cfg = tiny_cfg()
+    model = Llama(cfg)
+    dcfg = make_local_config(n, schedule="ring")
+    transport = IciTransport(dcfg, mesh=make_mesh(dcfg, jax.devices()[:n]))
+
+    tokens0 = jnp.zeros((1, 8), jnp.int32)
+    init = lambda k: model.init(k, tokens0)
+    # Different init per peer so base-weight divergence would be visible if
+    # the exchange ever touched them.
+    stacked = init_params_per_peer(init, jax.random.key(0), n)
+    opt = lora_optimizer(
+        optax.adam(1e-2), jax.tree.map(lambda v: v[0], stacked)
+    )
+    state = init_gossip_state(stacked, opt, transport)
+
+    def loss_fn(params, batch):
+        tokens, targets = batch
+        logits = model.apply(params, tokens)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, targets
+        ).mean()
+
+    step_fn = make_gossip_train_step(
+        loss_fn, opt, transport, exchange_filter=lora_filter
+    )
+    rng = np.random.default_rng(0)
+    batch_tokens = jnp.asarray(rng.integers(0, 64, (n, 2, 8)), jnp.int32)
+    batch_targets = jnp.asarray(rng.integers(0, 64, (n, 2, 8)), jnp.int32)
+
+    initial = jax.tree.map(np.asarray, stacked)
+    for _ in range(5):
+        state, losses, info = step_fn(state, (batch_tokens, batch_targets))
+    final = jax.tree.map(np.asarray, state.params)
+
+    init_sel, init_rest = partition(initial, lora_filter)
+    fin_sel, fin_rest = partition(final, lora_filter)
+
+    # Base weights: bit-identical to init on every peer (frozen AND never
+    # exchanged).
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(a, b), init_rest, fin_rest
+    )
+    # LoRA leaves: trained (lora_a moved) and exchanged (peers agree after
+    # ring gossip with alpha=0.5 from identical-zero lora_b start).
+    moved = [
+        not np.array_equal(a, b)
+        for a, b in zip(jax.tree.leaves(init_sel), jax.tree.leaves(fin_sel))
+    ]
+    assert any(moved)
+    assert np.all(np.asarray(losses) > 0)
+    assert np.asarray(info.participated).all()
+
+
+def test_lora_rank_zero_has_no_adapter_params():
+    cfg = tiny_cfg(lora_rank=0)
+    model = Llama(cfg)
+    params = model.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))
+    sel, _ = partition(params, lora_filter)
+    assert not jax.tree.leaves(sel)  # no lora leaves at rank 0
+    from dpwa_tpu.utils.pytree import subset_ravel
+
+    with pytest.raises(ValueError):
+        subset_ravel(params, lora_filter)
