@@ -1,0 +1,80 @@
+"""Multi-host (DCN) support: ``jax.distributed`` + hierarchical gossip.
+
+The reference scales across machines with one TCP process per node
+(SURVEY.md §3.4); the TPU-native equivalent is a multi-host JAX program:
+every host runs THIS same SPMD code, ``jax.distributed`` stitches their
+chips into one global device list, and the ``peers`` mesh axis spans all of
+them.  ``ppermute`` pairs that stay inside a host ride ICI; pairs that cross
+hosts ride DCN — which is why config 4 (BASELINE.json:10) uses the
+hierarchical schedule: dense intra-host slots, sparse inter-host slots.
+
+``mesh_utils.create_device_mesh`` keeps each host's chips contiguous along
+the axis, so ``group_size = chips-per-host`` aligns the schedule's groups
+with the physical ICI domains.
+
+Single-host usage is unchanged — these helpers are no-ops there (the
+framework runs identically on an emulated CPU mesh; see tests)."""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+from dpwa_tpu.config import DpwaConfig
+from dpwa_tpu.parallel.ici import IciTransport
+from dpwa_tpu.parallel.mesh import PEER_AXIS, make_mesh
+
+
+def initialize_multihost(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Bring up ``jax.distributed``.
+
+    With no arguments, relies on the environment (TPU pod metadata or
+    ``JAX_COORDINATOR_ADDRESS``/``JAX_NUM_PROCESSES``/``JAX_PROCESS_ID``),
+    which is how TPU VMs launch.  Call once per host before any backend
+    use."""
+    kwargs = {}
+    if coordinator_address is not None:
+        kwargs = dict(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    jax.distributed.initialize(**kwargs)
+
+
+def hierarchical_config_for_hosts(
+    config: DpwaConfig, chips_per_host: Optional[int] = None
+) -> DpwaConfig:
+    """Rewrite ``config`` so the hierarchical schedule's groups equal the
+    physical hosts (intra-group = ICI, inter-group = DCN)."""
+    import dataclasses
+
+    chips = chips_per_host or jax.local_device_count()
+    if config.n_peers % chips != 0:
+        raise ValueError(
+            f"{config.n_peers} peers not divisible by {chips} chips/host"
+        )
+    proto = dataclasses.replace(
+        config.protocol, schedule="hierarchical", group_size=chips
+    )
+    return dataclasses.replace(config, protocol=proto)
+
+
+class DcnHierarchicalTransport(IciTransport):
+    """Gossip transport for multi-host meshes (config 4).
+
+    Identical execution path to :class:`IciTransport` — the hierarchy lives
+    in the *schedule*: intra-group pairings permute within a host's
+    contiguous chip block (ICI), the sparse inter-group slot permutes
+    across blocks (DCN).  This class only enforces that alignment."""
+
+    def __init__(self, config: DpwaConfig, mesh=None, axis_name: str = PEER_AXIS):
+        if config.protocol.schedule != "hierarchical":
+            config = hierarchical_config_for_hosts(config)
+        super().__init__(config, mesh=mesh, axis_name=axis_name)

@@ -1,0 +1,66 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dpwa_tpu.config import make_local_config
+from dpwa_tpu.interpolation import PeerMeta
+from dpwa_tpu.parallel.distributed import (
+    DcnHierarchicalTransport,
+    hierarchical_config_for_hosts,
+)
+from dpwa_tpu.parallel.mesh import make_mesh
+from dpwa_tpu.utils.profiling import measure_exchange_bandwidth
+
+
+def test_hierarchical_config_for_hosts():
+    cfg = make_local_config(8, schedule="ring")
+    out = hierarchical_config_for_hosts(cfg, chips_per_host=4)
+    assert out.protocol.schedule == "hierarchical"
+    assert out.protocol.group_size == 4
+    with pytest.raises(ValueError):
+        hierarchical_config_for_hosts(make_local_config(6), chips_per_host=4)
+
+
+def test_dcn_transport_auto_hierarchical():
+    cfg = make_local_config(8, schedule="ring")  # not hierarchical yet
+    t = DcnHierarchicalTransport(
+        hierarchical_config_for_hosts(cfg, chips_per_host=4),
+        mesh=make_mesh(make_local_config(8)),
+    )
+    assert t.schedule.name == "hierarchical"
+    groups = np.arange(8) // 4
+    # Last pool slot crosses hosts, earlier slots stay inside.
+    perm = t.schedule.pool[-1]
+    assert (groups[perm] != groups).all()
+    for slot in range(t.schedule.pool_size - 1):
+        perm = t.schedule.pool[slot]
+        assert (groups[perm] == groups).all()
+
+
+def test_dcn_transport_exchanges():
+    cfg = hierarchical_config_for_hosts(
+        make_local_config(8), chips_per_host=4
+    )
+    t = DcnHierarchicalTransport(cfg, mesh=make_mesh(cfg))
+    params = {"w": jnp.arange(8.0)[:, None] * jnp.ones((8, 4))}
+    meta = PeerMeta(jnp.ones(8), jnp.ones(8))
+    for step in range(t.schedule.pool_size):
+        params, info = t.exchange(params, meta, step)
+        partner = np.asarray(info.partner)
+        np.testing.assert_array_equal(partner[partner], np.arange(8))
+    # After a full period every peer has mixed with its group and across.
+    w = np.asarray(params["w"])[:, 0]
+    assert w.std() < np.arange(8.0).std()
+
+
+def test_measure_exchange_bandwidth():
+    from dpwa_tpu.parallel.ici import IciTransport
+
+    cfg = make_local_config(8)
+    t = IciTransport(cfg, mesh=make_mesh(cfg))
+    params = {"w": jnp.ones((8, 1024))}
+    meta = PeerMeta(jnp.ones(8), jnp.ones(8))
+    out = measure_exchange_bandwidth(t, params, meta, iters=3)
+    assert out["payload_bytes"] == 1024 * 4
+    assert out["gbps_per_chip"] > 0
