@@ -1,0 +1,115 @@
+"""Native (C++) runtime kernels, loaded via ctypes.
+
+Compiled on first use with the system ``g++`` (no pybind11/pip needed) and
+cached beside this module; every entry point has a numpy fallback so the
+framework runs unchanged where no toolchain exists.  The reference is pure
+Python (SURVEY.md §2 'Native components — none'); this accelerates the
+reference-equivalent CPU path — the TPU path's "native layer" is XLA/Pallas.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+_SRC = os.path.join(os.path.dirname(__file__), "dpwa_native.cpp")
+_LIB = os.path.join(os.path.dirname(__file__), "_libdpwa_native.so")
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-shared", "-fPIC", "-o", _LIB, _SRC],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        return True
+    except (OSError, subprocess.SubprocessError):
+        return False
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """The shared library, building it if necessary; None if unavailable."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_LIB) or os.path.getmtime(
+            _LIB
+        ) < os.path.getmtime(_SRC):
+            if not _build():
+                return None
+        try:
+            lib = ctypes.CDLL(_LIB)
+        except OSError:
+            return None
+        lib.dpwa_merge_out.argtypes = [
+            ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_float),
+            ctypes.c_float,
+            ctypes.c_size_t,
+        ]
+        lib.dpwa_merge_inplace.argtypes = [
+            ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_float),
+            ctypes.c_float,
+            ctypes.c_size_t,
+        ]
+        lib.dpwa_checksum.argtypes = [
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.c_size_t,
+        ]
+        lib.dpwa_checksum.restype = ctypes.c_uint64
+        _lib = lib
+        return _lib
+
+
+def _fptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+def merge_out(
+    local: np.ndarray, remote: np.ndarray, alpha: float
+) -> np.ndarray:
+    """``(1-alpha)*local + alpha*remote`` — native single pass when
+    possible, numpy otherwise.  float32 contiguous fast path."""
+    lib = load()
+    if (
+        lib is not None
+        and local.dtype == np.float32
+        and remote.dtype == np.float32
+        and local.flags.c_contiguous
+        and remote.flags.c_contiguous
+    ):
+        dst = np.empty_like(local)
+        lib.dpwa_merge_out(
+            _fptr(dst), _fptr(local), _fptr(remote),
+            ctypes.c_float(alpha), dst.size,
+        )
+        return dst
+    return ((1.0 - alpha) * local.astype(np.float32)
+            + alpha * remote.astype(np.float32)).astype(local.dtype)
+
+
+def checksum(data: bytes) -> int:
+    """FNV-1a of a byte string (wire-format integrity); pure-python
+    fallback matches bit-for-bit."""
+    lib = load()
+    if lib is not None:
+        buf = (ctypes.c_uint8 * len(data)).from_buffer_copy(data)
+        return int(lib.dpwa_checksum(buf, len(data)))
+    h = 1469598103934665603
+    for b in data:
+        h = ((h ^ b) * 1099511628211) % (1 << 64)
+    return h

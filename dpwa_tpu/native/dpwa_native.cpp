@@ -1,0 +1,43 @@
+// Native runtime kernels for the CPU/TCP gossip path.
+//
+// The reference's merge is numpy `(1-a)*x + a*remote` (SURVEY.md §3.2 hot
+// spots) — three full passes over memory plus two temporaries.  This is the
+// single-pass fused form, plus a checksum used by the wire format.  Built
+// with -O3 so the compiler vectorizes the axpy loop; no external deps.
+//
+// Exposed C ABI (loaded via ctypes, see dpwa_tpu/native/__init__.py):
+//   dpwa_merge_out(dst, local, remote, alpha, n):  dst = (1-a)*local + a*remote
+//   dpwa_merge_inplace(dst, remote, alpha, n):     dst = (1-a)*dst + a*remote
+//   dpwa_checksum(data, n):                        FNV-1a over bytes
+
+#include <cstddef>
+#include <cstdint>
+
+extern "C" {
+
+void dpwa_merge_out(float* dst, const float* local, const float* remote,
+                    float alpha, size_t n) {
+  const float beta = 1.0f - alpha;
+  for (size_t i = 0; i < n; ++i) {
+    dst[i] = beta * local[i] + alpha * remote[i];
+  }
+}
+
+void dpwa_merge_inplace(float* dst, const float* remote, float alpha,
+                        size_t n) {
+  const float beta = 1.0f - alpha;
+  for (size_t i = 0; i < n; ++i) {
+    dst[i] = beta * dst[i] + alpha * remote[i];
+  }
+}
+
+uint64_t dpwa_checksum(const uint8_t* data, size_t n) {
+  uint64_t h = 1469598103934665603ull;  // FNV offset basis
+  for (size_t i = 0; i < n; ++i) {
+    h ^= data[i];
+    h *= 1099511628211ull;  // FNV prime
+  }
+  return h;
+}
+
+}  // extern "C"

@@ -36,6 +36,7 @@ from typing import Optional, Tuple
 
 import numpy as np
 
+from dpwa_tpu import native
 from dpwa_tpu.config import DpwaConfig
 from dpwa_tpu.interpolation import PeerMeta, make_interpolation
 from dpwa_tpu.parallel.schedules import Schedule, build_schedule
@@ -208,10 +209,19 @@ class TcpTransport:
         local = PeerMeta(np.float32(clock), np.float32(loss))
         remote = PeerMeta(np.float32(remote_clock), np.float32(remote_loss))
         alpha = float(self.interp(local, remote))
-        merged = (1.0 - alpha) * vec.astype(np.float32) + alpha * remote_vec.astype(
-            np.float32
-        )
-        return merged.astype(vec.dtype), alpha, partner
+        if vec.dtype == np.float32 and remote_vec.dtype == np.float32:
+            # Native single-pass axpy (numpy takes three passes + temps).
+            merged = native.merge_out(
+                np.ascontiguousarray(vec),
+                np.ascontiguousarray(remote_vec),
+                alpha,
+            )
+        else:
+            merged = (
+                (1.0 - alpha) * vec.astype(np.float32)
+                + alpha * remote_vec.astype(np.float32)
+            ).astype(vec.dtype)
+        return merged, alpha, partner
 
     def close(self) -> None:
         self.server.close()
