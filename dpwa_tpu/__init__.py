@@ -24,7 +24,41 @@ Public API (mirrors the reference's surface):
   merge-coefficient strategies.
 """
 
-from dpwa_tpu.config import DpwaConfig, load_config  # noqa: F401
-from dpwa_tpu.interpolation import make_interpolation  # noqa: F401
+from dpwa_tpu.config import DpwaConfig, load_config, make_local_config  # noqa: F401
+from dpwa_tpu.interpolation import PeerMeta, make_interpolation  # noqa: F401
 
 __version__ = "0.1.0"
+
+__all__ = [
+    "DpwaConfig",
+    "load_config",
+    "make_local_config",
+    "PeerMeta",
+    "make_interpolation",
+    "__version__",
+]
+
+
+def __getattr__(name):
+    # Heavy submodule members, loaded lazily so `import dpwa_tpu` stays
+    # cheap and jax-platform decisions stay with the caller.
+    lazy = {
+        "DpwaJaxAdapter": ("dpwa_tpu.adapters.jax_adapter", "DpwaJaxAdapter"),
+        "DpwaTcpAdapter": ("dpwa_tpu.adapters.tcp_adapter", "DpwaTcpAdapter"),
+        "DpwaTorchAdapter": (
+            "dpwa_tpu.adapters.tcp_adapter", "DpwaTorchAdapter",
+        ),
+        "IciTransport": ("dpwa_tpu.parallel.ici", "IciTransport"),
+        "TcpTransport": ("dpwa_tpu.parallel.tcp", "TcpTransport"),
+        "build_schedule": ("dpwa_tpu.parallel.schedules", "build_schedule"),
+        "make_mesh": ("dpwa_tpu.parallel.mesh", "make_mesh"),
+        "make_gossip_train_step": ("dpwa_tpu.train", "make_gossip_train_step"),
+        "init_gossip_state": ("dpwa_tpu.train", "init_gossip_state"),
+        "GossipTrainState": ("dpwa_tpu.train", "GossipTrainState"),
+    }
+    if name in lazy:
+        import importlib
+
+        module, attr = lazy[name]
+        return getattr(importlib.import_module(module), attr)
+    raise AttributeError(f"module 'dpwa_tpu' has no attribute {name!r}")

@@ -1,0 +1,108 @@
+#!/usr/bin/env python
+"""The reference's exact usage, verbatim: PyTorch model + Dpwa adapter.
+
+A user of zenghanfu/dpwa switches to this framework by changing ONE import
+(SURVEY.md §1 "Key architectural property": the adapter API and example
+scripts are preserved).  Train loop shape per SURVEY.md §3.2:
+
+    forward / loss.backward() / optimizer.step()
+    adapter.update(loss)        # publish, pick peer, fetch, merge in place
+
+Launch one process per YAML node:
+
+    python main.py --name node0 --config ../mnist/nodes.yaml &
+    python main.py --name node1 --config ../mnist/nodes.yaml &
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--name", required=True)
+    ap.add_argument("--config", default="../mnist/nodes.yaml")
+    ap.add_argument("--steps", type=int, default=200)
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--lr", type=float, default=1e-3)
+    args = ap.parse_args()
+
+    import torch
+    import torch.nn as nn
+    import torch.nn.functional as F
+
+    # The one changed import vs the reference:
+    from dpwa_tpu.adapters.tcp_adapter import DpwaPyTorchAdapter
+    from dpwa_tpu.config import load_config
+    from dpwa_tpu.data import load_mnist_or_digits, peer_split
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    cfg_path = (
+        args.config
+        if os.path.exists(args.config)
+        else os.path.join(here, args.config)
+    )
+    cfg = load_config(cfg_path)
+    me = cfg.node_index(args.name)
+
+    x_tr, y_tr, x_te, y_te, dataset = load_mnist_or_digits()
+    xs, ys = peer_split(x_tr, y_tr, cfg.n_peers, seed=cfg.protocol.seed)
+    x_my = torch.from_numpy(xs[me]).permute(0, 3, 1, 2)  # NCHW
+    y_my = torch.from_numpy(ys[me]).long()
+    side = x_tr.shape[1]
+
+    class Net(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.conv = nn.Conv2d(1, 16, 3, padding=1)
+            self.fc1 = nn.Linear(16 * side * side, 64)
+            self.fc2 = nn.Linear(64, 10)
+
+        def forward(self, x):
+            x = F.relu(self.conv(x))
+            x = x.flatten(1)
+            return self.fc2(F.relu(self.fc1(x)))
+
+    torch.manual_seed(me)
+    model = Net()
+    optimizer = torch.optim.Adam(model.parameters(), lr=args.lr)
+    adapter = DpwaPyTorchAdapter(model, args.name, cfg)
+
+    rng = np.random.default_rng(1000 + me)
+    try:
+        for step in range(args.steps):
+            idx = rng.integers(0, len(x_my), args.batch_size)
+            xb, yb = x_my[idx], y_my[idx]
+            optimizer.zero_grad()
+            loss = F.cross_entropy(model(xb), yb)
+            loss.backward()
+            optimizer.step()
+            adapter.update(loss.item())  # the reference's per-step call
+            if step % 50 == 0:
+                print(
+                    f"[{args.name}] step {step} loss {loss.item():.4f} "
+                    f"alpha {adapter.last_alpha:.2f} "
+                    f"peer {adapter.last_partner}",
+                    flush=True,
+                )
+        with torch.no_grad():
+            x_all = torch.from_numpy(x_te).permute(0, 3, 1, 2)
+            acc = (
+                (model(x_all).argmax(1).numpy() == y_te).mean()
+            )
+        print(f"[{args.name}] {dataset} test accuracy: {acc:.4f}")
+    finally:
+        adapter.close()
+
+
+if __name__ == "__main__":
+    main()
