@@ -31,12 +31,15 @@ import jax.numpy as jnp
 ModuleDef = Any
 
 
-def _norm(norm: str, dtype) -> Callable[..., nn.Module]:
+def _norm(norm: str, dtype, train: bool = True) -> Callable[..., nn.Module]:
     if norm == "group":
         return partial(nn.GroupNorm, num_groups=None, group_size=16, dtype=dtype)
     if norm == "batch":
         return partial(
-            nn.BatchNorm, use_running_average=False, momentum=0.9, dtype=dtype
+            nn.BatchNorm,
+            use_running_average=not train,
+            momentum=0.9,
+            dtype=dtype,
         )
     raise ValueError(f"unknown norm {norm!r}")
 
@@ -109,11 +112,11 @@ class CifarResNet(nn.Module):
     dtype: Any = jnp.float32
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, train: bool = True):
         if (self.depth - 2) % 6 != 0:
             raise ValueError("CIFAR ResNet depth must be 6n+2")
         n = (self.depth - 2) // 6
-        norm = _norm(self.norm_type, self.dtype)
+        norm = _norm(self.norm_type, self.dtype, train)
         x = x.astype(self.dtype)
         x = nn.Conv(16, (3, 3), use_bias=False, dtype=self.dtype)(x)
         x = norm()(x)
@@ -143,8 +146,8 @@ class ImageNetResNet(nn.Module):
     dtype: Any = jnp.float32
 
     @nn.compact
-    def __call__(self, x):
-        norm = _norm(self.norm_type, self.dtype)
+    def __call__(self, x, train: bool = True):
+        norm = _norm(self.norm_type, self.dtype, train)
         x = x.astype(self.dtype)
         x = nn.Conv(64, (7, 7), (2, 2), use_bias=False, dtype=self.dtype)(x)
         x = norm()(x)

@@ -41,18 +41,25 @@ LossFn = Callable[[PyTree, Tuple[jnp.ndarray, jnp.ndarray]], jnp.ndarray]
 
 
 class GossipTrainState(NamedTuple):
-    """Peer-stacked training state. Every leaf's leading axis is n_peers."""
+    """Peer-stacked training state. Every leaf's leading axis is n_peers.
+
+    ``model_state`` carries non-parameter model variables (e.g. BatchNorm
+    ``batch_stats``); it is exchanged alongside params — running statistics
+    are part of the replica and must gossip with the same α — but never
+    touched by the optimizer."""
 
     params: PyTree
     opt_state: PyTree
     clock: jnp.ndarray  # float32[n] — steps trained, rides with exchanges
     step: jnp.ndarray  # int32 scalar — global schedule position
+    model_state: PyTree = None
 
 
 def init_gossip_state(
     stacked_params: PyTree,
     optimizer: optax.GradientTransformation,
     transport: IciTransport,
+    stacked_model_state: PyTree = None,
 ) -> GossipTrainState:
     """Build state from peer-stacked params and shard it over the mesh."""
     n = transport.config.n_peers
@@ -69,6 +76,9 @@ def init_gossip_state(
         opt_state=put(opt_state),
         clock=jax.device_put(jnp.zeros(n, jnp.float32), sh),
         step=jnp.int32(0),
+        model_state=put(stacked_model_state)
+        if stacked_model_state is not None
+        else None,
     )
 
 
@@ -161,6 +171,101 @@ def make_gossip_train_step(
     # Same CPU run-ahead bound as IciTransport.exchange: the in-process
     # collective rendezvous deadlocks a thread-starved host if many steps'
     # collectives are in flight.  TPU meshes stay fully async.
+    block_per_call = all(d.platform == "cpu" for d in mesh.devices.flat)
+
+    def train_step(state: GossipTrainState, batch):
+        out = _step(state, batch)
+        if block_per_call:
+            jax.block_until_ready(out)
+        return out
+
+    return train_step
+
+
+def make_gossip_train_step_with_state(
+    loss_fn,
+    optimizer: optax.GradientTransformation,
+    transport: IciTransport,
+    exchange_filter: Optional[Callable[[str], bool]] = None,
+):
+    """Like :func:`make_gossip_train_step`, for models with non-parameter
+    variables (BatchNorm running stats etc., the reference's stock torch
+    ResNets).
+
+    ``loss_fn(params, model_state, batch) -> (loss, new_model_state)``.
+    ``model_state`` is exchanged together with the (filtered) params —
+    running statistics belong to the replica, so they merge with the same
+    α — but the optimizer never sees it."""
+    grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+    schedule, interp = transport.schedule, transport.interp
+    axis, mesh = transport.axis_name, transport.mesh
+    shard = lambda t: jax.tree.map(lambda v: v[0], t)
+    unshard = lambda t: jax.tree.map(lambda v: v[None], t)
+
+    def body(params, opt_state, model_state, clock, step, batch):
+        params, opt_state = shard(params), shard(opt_state)
+        model_state = shard(model_state)
+        (loss, new_model_state), grads = grad_fn(
+            params, model_state, shard(batch)
+        )
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        clock = clock[0] + 1.0
+        meta = PeerMeta(clock, loss.astype(jnp.float32))
+        if exchange_filter is not None:
+            selected, rest = pytree_partition(params, exchange_filter)
+            payload = (selected, new_model_state)
+            (merged_sel, merged_state), (partner, alpha, part) = (
+                gossip_exchange_local(
+                    payload, meta, step,
+                    schedule=schedule, interp=interp, axis_name=axis,
+                )
+            )
+            merged = pytree_combine(merged_sel, rest)
+        else:
+            (merged, merged_state), (partner, alpha, part) = (
+                gossip_exchange_local(
+                    (params, new_model_state), meta, step,
+                    schedule=schedule, interp=interp, axis_name=axis,
+                )
+            )
+        return (
+            unshard(merged),
+            unshard(opt_state),
+            unshard(merged_state),
+            clock[None],
+            loss[None],
+            (partner[None], alpha[None], part[None]),
+        )
+
+    mapped = shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(axis), P(axis), P(axis), P(axis), P(), P(axis)),
+        out_specs=(
+            P(axis), P(axis), P(axis), P(axis), P(axis), P(axis),
+        ),
+    )
+
+    @jax.jit
+    def _step(state: GossipTrainState, batch):
+        params, opt_state, model_state, clock, losses, info = mapped(
+            state.params,
+            state.opt_state,
+            state.model_state,
+            state.clock,
+            state.step,
+            batch,
+        )
+        new_state = GossipTrainState(
+            params=params,
+            opt_state=opt_state,
+            clock=clock,
+            step=state.step + 1,
+            model_state=model_state,
+        )
+        return new_state, losses, ExchangeInfo(*info)
+
     block_per_call = all(d.platform == "cpu" for d in mesh.devices.flat)
 
     def train_step(state: GossipTrainState, batch):
