@@ -53,6 +53,9 @@ def __getattr__(name):
         "build_schedule": ("dpwa_tpu.parallel.schedules", "build_schedule"),
         "make_mesh": ("dpwa_tpu.parallel.mesh", "make_mesh"),
         "make_gossip_train_step": ("dpwa_tpu.train", "make_gossip_train_step"),
+        "make_gossip_train_step_with_state": (
+            "dpwa_tpu.train", "make_gossip_train_step_with_state",
+        ),
         "init_gossip_state": ("dpwa_tpu.train", "init_gossip_state"),
         "GossipTrainState": ("dpwa_tpu.train", "GossipTrainState"),
     }

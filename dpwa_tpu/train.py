@@ -98,126 +98,44 @@ def init_params_per_peer(
     return jax.vmap(init_fn)(jax.random.split(key, n_peers))
 
 
-def make_gossip_train_step(
-    loss_fn: LossFn,
-    optimizer: optax.GradientTransformation,
-    transport: IciTransport,
-    exchange_filter: Optional[Callable[[str], bool]] = None,
-):
-    """Returns jitted ``train_step(state, batch) -> (state, losses, info)``.
-
-    ``batch`` is a peer-stacked ``(x[n, b, ...], y[n, b])`` pair; ``losses``
-    is float32[n] (per peer) and also becomes the metadata the
-    loss-weighted interpolation sees, matching the reference's
-    ``update(loss)`` argument.
-
-    ``exchange_filter`` enables subset-pytree gossip (BASELINE.json:11, the
-    LoRA config): only leaves whose path matches the predicate enter the
-    collective; everything else never moves — neither over ICI nor DCN."""
-    grad_fn = jax.value_and_grad(loss_fn)
-    schedule, interp = transport.schedule, transport.interp
-    axis, mesh = transport.axis_name, transport.mesh
-    shard = lambda t: jax.tree.map(lambda v: v[0], t)
-    unshard = lambda t: jax.tree.map(lambda v: v[None], t)
-
-    def body(params, opt_state, clock, step, batch):
-        # Local (per-device) values: strip the size-1 peer block axis.
-        params, opt_state = shard(params), shard(opt_state)
-        loss, grads = grad_fn(params, shard(batch))
-        updates, opt_state = optimizer.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
-        clock = clock[0] + 1.0
-        meta = PeerMeta(clock, loss.astype(jnp.float32))
-        if exchange_filter is not None:
-            selected, rest = pytree_partition(params, exchange_filter)
-            merged_sel, (partner, alpha, part) = gossip_exchange_local(
-                selected, meta, step,
-                schedule=schedule, interp=interp, axis_name=axis,
-            )
-            merged = pytree_combine(merged_sel, rest)
-        else:
-            merged, (partner, alpha, part) = gossip_exchange_local(
-                params, meta, step,
-                schedule=schedule, interp=interp, axis_name=axis,
-            )
-        return (
-            unshard(merged),
-            unshard(opt_state),
-            clock[None],
-            loss[None],
-            (partner[None], alpha[None], part[None]),
-        )
-
-    mapped = shard_map(
-        body,
-        mesh=mesh,
-        in_specs=(P(axis), P(axis), P(axis), P(), P(axis)),
-        out_specs=(P(axis), P(axis), P(axis), P(axis), P(axis)),
-    )
-
-    @jax.jit
-    def _step(state: GossipTrainState, batch):
-        params, opt_state, clock, losses, info = mapped(
-            state.params, state.opt_state, state.clock, state.step, batch
-        )
-        new_state = GossipTrainState(
-            params=params,
-            opt_state=opt_state,
-            clock=clock,
-            step=state.step + 1,
-        )
-        return new_state, losses, ExchangeInfo(*info)
-
-    # Same CPU run-ahead bound as IciTransport.exchange: the in-process
-    # collective rendezvous deadlocks a thread-starved host if many steps'
-    # collectives are in flight.  TPU meshes stay fully async.
-    block_per_call = all(d.platform == "cpu" for d in mesh.devices.flat)
-
-    def train_step(state: GossipTrainState, batch):
-        out = _step(state, batch)
-        if block_per_call:
-            jax.block_until_ready(out)
-        return out
-
-    return train_step
-
-
-def make_gossip_train_step_with_state(
+def _make_step(
     loss_fn,
     optimizer: optax.GradientTransformation,
     transport: IciTransport,
-    exchange_filter: Optional[Callable[[str], bool]] = None,
+    exchange_filter: Optional[Callable[[str], bool]],
+    with_state: bool,
 ):
-    """Like :func:`make_gossip_train_step`, for models with non-parameter
-    variables (BatchNorm running stats etc., the reference's stock torch
-    ResNets).
+    """Shared builder behind both public step factories.
 
-    ``loss_fn(params, model_state, batch) -> (loss, new_model_state)``.
-    ``model_state`` is exchanged together with the (filtered) params —
-    running statistics belong to the replica, so they merge with the same
-    α — but the optimizer never sees it."""
-    grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+    When ``with_state`` is False, ``model_state`` is threaded through as an
+    empty pytree ``()`` — zero leaves, so it adds nothing to the compiled
+    program — keeping one body/shard_map/_step implementation for both."""
+    grad_fn = jax.value_and_grad(loss_fn, has_aux=with_state)
     schedule, interp = transport.schedule, transport.interp
     axis, mesh = transport.axis_name, transport.mesh
     shard = lambda t: jax.tree.map(lambda v: v[0], t)
     unshard = lambda t: jax.tree.map(lambda v: v[None], t)
 
     def body(params, opt_state, model_state, clock, step, batch):
+        # Local (per-device) values: strip the size-1 peer block axis.
         params, opt_state = shard(params), shard(opt_state)
-        model_state = shard(model_state)
-        (loss, new_model_state), grads = grad_fn(
-            params, model_state, shard(batch)
-        )
+        if with_state:
+            model_state = shard(model_state)
+            (loss, new_model_state), grads = grad_fn(
+                params, model_state, shard(batch)
+            )
+        else:
+            loss, grads = grad_fn(params, shard(batch))
+            new_model_state = ()
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         clock = clock[0] + 1.0
         meta = PeerMeta(clock, loss.astype(jnp.float32))
         if exchange_filter is not None:
             selected, rest = pytree_partition(params, exchange_filter)
-            payload = (selected, new_model_state)
             (merged_sel, merged_state), (partner, alpha, part) = (
                 gossip_exchange_local(
-                    payload, meta, step,
+                    (selected, new_model_state), meta, step,
                     schedule=schedule, interp=interp, axis_name=axis,
                 )
             )
@@ -252,7 +170,7 @@ def make_gossip_train_step_with_state(
         params, opt_state, model_state, clock, losses, info = mapped(
             state.params,
             state.opt_state,
-            state.model_state,
+            state.model_state if with_state else (),
             state.clock,
             state.step,
             batch,
@@ -262,19 +180,77 @@ def make_gossip_train_step_with_state(
             opt_state=opt_state,
             clock=clock,
             step=state.step + 1,
-            model_state=model_state,
+            model_state=model_state if with_state else state.model_state,
         )
         return new_state, losses, ExchangeInfo(*info)
 
+    # Same CPU run-ahead bound as IciTransport.exchange: the in-process
+    # collective rendezvous deadlocks a thread-starved host if many steps'
+    # collectives are in flight.  TPU meshes stay fully async.
     block_per_call = all(d.platform == "cpu" for d in mesh.devices.flat)
 
     def train_step(state: GossipTrainState, batch):
+        if not with_state and state.model_state is not None:
+            raise ValueError(
+                "state carries model_state but this step was built with "
+                "make_gossip_train_step, which would never update it; use "
+                "make_gossip_train_step_with_state instead"
+            )
+        if with_state and state.model_state is None:
+            raise ValueError(
+                "step built with make_gossip_train_step_with_state but "
+                "state.model_state is None; pass stacked_model_state to "
+                "init_gossip_state"
+            )
         out = _step(state, batch)
         if block_per_call:
             jax.block_until_ready(out)
         return out
 
     return train_step
+
+
+def make_gossip_train_step(
+    loss_fn: LossFn,
+    optimizer: optax.GradientTransformation,
+    transport: IciTransport,
+    exchange_filter: Optional[Callable[[str], bool]] = None,
+):
+    """Returns jitted ``train_step(state, batch) -> (state, losses, info)``.
+
+    ``batch`` is a peer-stacked ``(x[n, b, ...], y[n, b])`` pair; ``losses``
+    is float32[n] (per peer) and also becomes the metadata the
+    loss-weighted interpolation sees, matching the reference's
+    ``update(loss)`` argument.
+
+    ``exchange_filter`` enables subset-pytree gossip (BASELINE.json:11, the
+    LoRA config): only leaves whose path matches the predicate enter the
+    collective; everything else never moves — neither over ICI nor DCN.
+
+    Raises at call time if ``state.model_state`` is set — that state would
+    silently stop updating; use :func:`make_gossip_train_step_with_state`."""
+    return _make_step(
+        loss_fn, optimizer, transport, exchange_filter, with_state=False
+    )
+
+
+def make_gossip_train_step_with_state(
+    loss_fn,
+    optimizer: optax.GradientTransformation,
+    transport: IciTransport,
+    exchange_filter: Optional[Callable[[str], bool]] = None,
+):
+    """Like :func:`make_gossip_train_step`, for models with non-parameter
+    variables (BatchNorm running stats etc., the reference's stock torch
+    ResNets).
+
+    ``loss_fn(params, model_state, batch) -> (loss, new_model_state)``.
+    ``model_state`` is exchanged together with the (filtered) params —
+    running statistics belong to the replica, so they merge with the same
+    α — but the optimizer never sees it."""
+    return _make_step(
+        loss_fn, optimizer, transport, exchange_filter, with_state=True
+    )
 
 
 def make_gossip_eval_fn(

@@ -19,6 +19,34 @@ from __future__ import annotations
 import os
 
 
+def repoint_to_host_mesh(n: int):
+    """Make an ≥n-device forced-CPU host mesh effective and return devices.
+
+    Raises the ``--xla_force_host_platform_device_count`` value in
+    ``XLA_FLAGS`` to at least ``n`` (XLA parses the env var at first client
+    creation, so this must run before the CPU client exists), then probes
+    the live backend: if it can't supply ``n`` devices (e.g. a
+    site-registered TPU plugin overrode ``jax_platforms``), repoints jax at
+    CPU and rebuilds the backend set.  Rebuilding invalidates arrays created
+    on the old backend — call this at process start."""
+    import re
+
+    import jax
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    m = re.search(r"--xla_force_host_platform_device_count=(\d+)", flags)
+    if m is None or int(m.group(1)) < n:
+        want = f"--xla_force_host_platform_device_count={n}"
+        flags = flags.replace(m.group(0), want) if m else f"{flags} {want}"
+        os.environ["XLA_FLAGS"] = flags.strip()
+    if len(jax.devices()) < n:
+        import jax.extend.backend
+
+        jax.config.update("jax_platforms", "cpu")
+        jax.extend.backend.clear_backends()
+    return jax.devices()
+
+
 def ensure_devices(n: int, mode: str = "auto"):
     """Return a list of ≥n jax devices, forcing a CPU mesh if allowed."""
     import jax
@@ -61,7 +89,9 @@ def ensure_devices(n: int, mode: str = "auto"):
     if not xb.backends_are_initialized():
         flags = os.environ.get("XLA_FLAGS", "")
         if "xla_force_host_platform_device_count" in flags:
-            devices = jax.devices()
+            # The flag expresses host-mesh intent; make it effective even
+            # if a site-registered TPU plugin overrode jax_platforms.
+            devices = repoint_to_host_mesh(n)
             if len(devices) >= n:
                 return devices[:n]
             raise RuntimeError(

@@ -72,7 +72,10 @@ def synthetic_cifar(n_train=4096, n_test=512, seed=0):
 
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--config", default="nodes.yaml")
+    ap.add_argument(
+        "--config",
+        default=os.path.join(os.path.dirname(__file__), "nodes.yaml"),
+    )
     ap.add_argument("--data-dir", default="/root/datasets")
     ap.add_argument("--synthetic", action="store_true")
     ap.add_argument("--steps", type=int, default=400)

@@ -152,7 +152,10 @@ def run_ici(args) -> None:
 
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--config", default="nodes.yaml")
+    ap.add_argument(
+        "--config",
+        default=os.path.join(os.path.dirname(__file__), "nodes.yaml"),
+    )
     ap.add_argument("--name", help="this process's node name (TCP transport)")
     ap.add_argument("--transport", choices=("tcp", "ici"), default="ici")
     ap.add_argument("--steps", type=int, default=300)

@@ -30,7 +30,12 @@ sys.path.insert(
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--name", required=True)
-    ap.add_argument("--config", default="../mnist/nodes.yaml")
+    ap.add_argument(
+        "--config",
+        default=os.path.join(
+            os.path.dirname(__file__), "..", "mnist", "nodes.yaml"
+        ),
+    )
     ap.add_argument("--steps", type=int, default=200)
     ap.add_argument("--batch-size", type=int, default=32)
     ap.add_argument("--lr", type=float, default=1e-3)
