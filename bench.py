@@ -75,19 +75,48 @@ def bench_device(d: int, n_peers: int, iters: int) -> float:
         return bytes_per_chip / dt / 1e9
 
     # Single-chip path: stacked virtual peers (SURVEY.md §7 note), ring
-    # pairing resolved as data by the fused merge op (Pallas on TPU: one
-    # pipelined HBM pass; scalar-prefetched partner row indices).
-    from dpwa_tpu.ops.merge import pairwise_merge
+    # pairing resolved as data by the fused merge.  On TPU this is the
+    # in-place pair kernel (pallas_pair_merge): one read + one write per
+    # element — the traffic floor — with the pairing arriving as
+    # scalar-prefetch data, so both ring phases share one compiled kernel.
+    from dpwa_tpu.ops.merge import (
+        involution_pairs,
+        pairwise_merge,
+        pallas_pair_merge,
+    )
     from dpwa_tpu.parallel.schedules import _ring_even, _ring_odd
 
-    perms = jnp.asarray(
-        np.stack([_ring_even(n_peers), _ring_odd(n_peers)]), jnp.int32
-    )
+    pools = [_ring_even(n_peers), _ring_odd(n_peers)]
     alphas = jnp.full((n_peers,), 0.5, jnp.float32)
 
     x = jnp.ones((n_peers, d), jnp.float32) * jnp.arange(
         n_peers, dtype=jnp.float32
     )[:, None]
+
+    if devices[0].platform == "tpu" and d % 1024 == 0:
+        n_pairs = max(len(involution_pairs(p)[0]) for p in pools)
+        lr = [involution_pairs(p, pad_to=n_pairs) for p in pools]
+        lefts = [jnp.asarray(l) for l, _ in lr]
+        rights = [jnp.asarray(r) for _, r in lr]
+        # 3D layout: the donated buffer aliases straight into the kernel
+        # (a 2D buffer would pay a reshape copy every step).
+        x = x.reshape(n_peers, d // 128, 128)
+        x = pallas_pair_merge(x, lefts[0], rights[0], alphas)  # compile
+        float(x.sum())
+        t0 = time.perf_counter()
+        for step in range(iters):
+            i = step % 2
+            x = pallas_pair_merge(x, lefts[i], rights[i], alphas)
+        # Host readback forces real completion (see multi-device note).
+        float(x.sum())
+        dt = time.perf_counter() - t0
+        # Honest accounting: the in-place kernel touches exactly the
+        # 2*n_pairs listed rows (fixed-point peers sit out with zero
+        # traffic), each read once + written once.
+        total_bytes = 2 * n_pairs * 2 * d * 4 * iters
+        return total_bytes / dt / 1e9
+
+    perms = jnp.asarray(np.stack(pools), jnp.int32)
     x2 = pairwise_merge(x, perms[0], alphas)
     float(x2.sum())
     t0 = time.perf_counter()

@@ -1,1 +1,6 @@
-from dpwa_tpu.ops.merge import pairwise_merge, pallas_pairwise_merge  # noqa: F401
+from dpwa_tpu.ops.merge import (  # noqa: F401
+    involution_pairs,
+    pairwise_merge,
+    pallas_pair_merge,
+    pallas_pairwise_merge,
+)

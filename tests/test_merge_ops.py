@@ -4,7 +4,9 @@ import numpy as np
 import pytest
 
 from dpwa_tpu.ops.merge import (
+    involution_pairs,
     pairwise_merge,
+    pallas_pair_merge,
     pallas_pairwise_merge,
     xla_pairwise_merge,
 )
@@ -51,6 +53,100 @@ def test_pairwise_merge_dispatch_cpu():
     want = np.asarray(xla_pairwise_merge(x, partner, alpha))
     got = np.asarray(pairwise_merge(x, partner, alpha))
     np.testing.assert_allclose(got, want, rtol=3e-4, atol=1e-6)
+
+
+def test_involution_pairs_basic():
+    left, right = involution_pairs([1, 0, 3, 2, 5, 4, 7, 6])
+    np.testing.assert_array_equal(left, [0, 2, 4, 6])
+    np.testing.assert_array_equal(right, [1, 3, 5, 7])
+
+
+def test_involution_pairs_drops_fixed_points_and_pads():
+    # 2<->4 swap; 0,1,3 fixed.
+    left, right = involution_pairs([0, 1, 4, 3, 2])
+    np.testing.assert_array_equal(left, [2])
+    np.testing.assert_array_equal(right, [4])
+    left, right = involution_pairs([0, 1, 4, 3, 2], pad_to=2)
+    assert len(left) == 2 and left[1] == right[1]  # no-op self-pad
+    with pytest.raises(ValueError):
+        involution_pairs([1, 2, 0])  # 3-cycle, not an involution
+
+
+def test_pair_merge_matches_xla():
+    # d = 8*128 so the tiled DMA path runs (on CPU backend it still
+    # executes via the pallas CPU lowering).
+    x, partner, alpha = _case(d=1024)
+    want = np.asarray(xla_pairwise_merge(x, partner, alpha))
+    left, right = involution_pairs(partner)
+    got = np.asarray(
+        pallas_pair_merge(
+            x.copy(), jnp.asarray(left), jnp.asarray(right), alpha
+        )
+    )
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-7)
+
+
+def test_pair_merge_fixed_points_untouched():
+    # Peers 0,1 pair up; 2,3 sit out — their rows must be bit-identical.
+    x, _, alpha = _case(n=4, d=1024)
+    partner = jnp.asarray([1, 0, 2, 3], jnp.int32)
+    left, right = involution_pairs(partner, pad_to=2)
+    got = np.asarray(
+        pallas_pair_merge(
+            x.copy(), jnp.asarray(left), jnp.asarray(right), alpha
+        )
+    )
+    xn = np.asarray(x)
+    np.testing.assert_array_equal(got[2], xn[2])
+    np.testing.assert_array_equal(got[3], xn[3])
+    want = np.asarray(xla_pairwise_merge(x, partner, alpha))
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-7)
+
+
+def test_pair_merge_odd_shape_falls_back():
+    x, partner, alpha = _case(d=1000)  # not a multiple of 1024
+    want = np.asarray(xla_pairwise_merge(x, partner, alpha))
+    left, right = involution_pairs(partner)
+    got = np.asarray(
+        pallas_pair_merge(
+            x.copy(), jnp.asarray(left), jnp.asarray(right), alpha
+        )
+    )
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-7)
+
+
+def test_pair_merge_3d_layout_matches_2d():
+    # The zero-copy hot-loop layout: [n, rows, 128] in, same shape out.
+    x, partner, alpha = _case(d=2048)
+    left, right = involution_pairs(partner)
+    want = np.asarray(
+        pallas_pair_merge(
+            x.copy(), jnp.asarray(left), jnp.asarray(right), alpha
+        )
+    )
+    x3 = x.reshape(8, 16, 128)
+    got = np.asarray(
+        pallas_pair_merge(
+            x3.copy(), jnp.asarray(left), jnp.asarray(right), alpha
+        )
+    )
+    assert got.shape == (8, 16, 128)
+    np.testing.assert_array_equal(got.reshape(8, 2048), want)
+
+
+def test_pair_merge_bf16():
+    x, partner, alpha = _case(d=1024)
+    xb = x.astype(jnp.bfloat16)
+    left, right = involution_pairs(partner)
+    got = np.asarray(
+        pallas_pair_merge(
+            xb.copy(), jnp.asarray(left), jnp.asarray(right), alpha
+        ).astype(jnp.float32)
+    )
+    want = np.asarray(
+        xla_pairwise_merge(xb.astype(jnp.float32), partner, alpha)
+    )
+    np.testing.assert_allclose(got, want, rtol=2e-2, atol=1e-2)
 
 
 def test_merge_is_consensus_contraction():
