@@ -49,9 +49,16 @@ def __getattr__(name):
             "dpwa_tpu.adapters.tcp_adapter", "DpwaTorchAdapter",
         ),
         "IciTransport": ("dpwa_tpu.parallel.ici", "IciTransport"),
+        "StackedTransport": ("dpwa_tpu.parallel.stacked", "StackedTransport"),
         "TcpTransport": ("dpwa_tpu.parallel.tcp", "TcpTransport"),
         "build_schedule": ("dpwa_tpu.parallel.schedules", "build_schedule"),
         "make_mesh": ("dpwa_tpu.parallel.mesh", "make_mesh"),
+        "make_stacked_train_step": (
+            "dpwa_tpu.parallel.stacked", "make_stacked_train_step",
+        ),
+        "init_stacked_state": (
+            "dpwa_tpu.parallel.stacked", "init_stacked_state",
+        ),
         "make_gossip_train_step": ("dpwa_tpu.train", "make_gossip_train_step"),
         "make_gossip_train_step_with_state": (
             "dpwa_tpu.train", "make_gossip_train_step_with_state",

@@ -311,8 +311,17 @@ def _pair_merge_impl(
             for dma in out_dma(c, c % n_buf):
                 dma.wait()
 
+    # Pad self-pairs (L == R) must be exact no-ops.  (1−a)·x + a·x is NOT
+    # bitwise x in floating point for a ∉ {0, 1}, so force a = 0 there:
+    # 1.0·x + 0.0·x IS exact, keeping sat-out rows bit-identical (the α=0
+    # self-merge semantics the transports guarantee).
+    noop = left == right
     a_pairs = jnp.stack(
-        [alpha[left], alpha[right]], axis=1
+        [
+            jnp.where(noop, 0.0, alpha[left]),
+            jnp.where(noop, 0.0, alpha[right]),
+        ],
+        axis=1,
     ).reshape(-1).astype(jnp.float32)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(

@@ -1,0 +1,245 @@
+"""Single-device gossip over a stacked virtual-peer axis.
+
+The SPMD transport (:mod:`dpwa_tpu.parallel.ici`) needs one device per peer.
+This module provides the same gossip semantics on ONE device — every replica
+lives in a ``[n_peers, ...]``-stacked pytree and the exchange is a batched
+gather-merge instead of a ``ppermute`` — so a single TPU chip can train and
+benchmark an N-peer gossip run (SURVEY.md §7: the dev/bench box has exactly
+one chip; the driver's real meshes come later).
+
+Semantics parity is exact, not approximate: the pairing pool, the per-pair
+participation/fault draws (same counter-based threefry streams), the
+interpolation α from exchanged (clock, loss) metadata, and the masked merge
+all reproduce :func:`dpwa_tpu.parallel.ici.gossip_exchange_local` bit for
+bit — ``tests/test_stacked.py`` asserts it against the multi-device path on
+a forced-CPU mesh.  One jitted program still advances every replica's round;
+there is simply no collective in it, only a leading-axis gather.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from dpwa_tpu.config import DpwaConfig
+from dpwa_tpu.interpolation import PeerMeta, make_interpolation
+from dpwa_tpu.parallel import schedules
+from dpwa_tpu.parallel.ici import ExchangeInfo
+from dpwa_tpu.parallel.schedules import participation_draw
+from dpwa_tpu.utils.pytree import combine as pytree_combine
+from dpwa_tpu.utils.pytree import partition as pytree_partition
+
+PyTree = Any
+
+
+def stacked_gossip_exchange(
+    params: PyTree,
+    meta: PeerMeta,
+    step: jnp.ndarray,
+    *,
+    schedule: schedules.Schedule,
+    interp,
+) -> Tuple[PyTree, ExchangeInfo]:
+    """One gossip round over a ``[n, ...]``-stacked pytree, single device.
+
+    The batched twin of
+    :func:`dpwa_tpu.parallel.ici.gossip_exchange_local`: identical pool
+    selection (``step % pool_size``), identical per-pair threefry draws,
+    identical α math — the partner's replica arrives by leading-axis gather
+    (``x[partner]``, fused by XLA into the merge) instead of ``ppermute``.
+    """
+    n = schedule.n_peers
+    me = jnp.arange(n)
+    pool = jnp.asarray(schedule.pool)  # [K, n] baked-in constant
+    branch = jnp.mod(jnp.asarray(step, jnp.int32), schedule.pool_size)
+    partner = pool[branch]  # [n]
+
+    remote_meta = jax.tree.map(lambda v: v[partner], meta)
+    pair_id = jnp.minimum(me, partner)
+    if schedule.fetch_probability >= 1.0:
+        drawn = jnp.ones(n, jnp.bool_)
+    else:
+        drawn = jax.vmap(
+            lambda pid: participation_draw(
+                schedule.seed, step, pid, schedule.fetch_probability
+            )
+        )(pair_id)
+    if schedule.drop_probability > 0.0:
+        drawn = jnp.logical_and(
+            drawn,
+            jnp.logical_not(
+                jax.vmap(
+                    lambda pid: schedules.fault_draw(
+                        schedule.seed, step, pid, schedule.drop_probability
+                    )
+                )(pair_id)
+            ),
+        )
+    participated = jnp.logical_and(drawn, partner != me)
+    alpha = jax.vmap(interp)(meta, remote_meta)
+    alpha = jnp.where(participated, alpha, 0.0).astype(jnp.float32)
+
+    def merge(x):
+        a = alpha.reshape((n,) + (1,) * (x.ndim - 1)).astype(
+            jnp.promote_types(x.dtype, jnp.float32)
+        )
+        return ((1.0 - a) * x.astype(a.dtype) + a * x[partner].astype(a.dtype)).astype(
+            x.dtype
+        )
+
+    merged = jax.tree.map(merge, params)
+    return merged, ExchangeInfo(partner, alpha, participated)
+
+
+class StackedTransport:
+    """Virtual-peer gossip on a single device.
+
+    Drop-in peer of :class:`dpwa_tpu.parallel.ici.IciTransport` behind the
+    same ``exchange(params, meta, step)`` surface, for hosts with fewer
+    devices than peers.  The YAML config is the same one that drives the
+    ICI and TCP transports (BASELINE.json:5 contract) — ``nodes:`` length
+    sets the stacked-axis size; host/port entries are ignored.
+    """
+
+    def __init__(self, config: DpwaConfig):
+        self.config = config
+        self.schedule = schedules.build_schedule(config)
+        self.interp = make_interpolation(config.interpolation)
+        schedule, interp = self.schedule, self.interp
+
+        @jax.jit
+        def exchange(params, meta, step):
+            return stacked_gossip_exchange(
+                params, meta, step, schedule=schedule, interp=interp
+            )
+
+        self._exchange = exchange
+
+    def exchange(
+        self, params: PyTree, meta: PeerMeta, step
+    ) -> Tuple[PyTree, ExchangeInfo]:
+        """One gossip round over every stacked replica.
+
+        Args:
+          params: pytree whose leaves are ``[n_peers, ...]`` arrays.
+          meta: :class:`PeerMeta` of ``[n_peers]`` float32 arrays.
+          step: int — selects the pairing and the participation draw.
+        """
+        return self._exchange(params, meta, jnp.asarray(step, jnp.int32))
+
+
+class StackedTrainState(NamedTuple):
+    """Stacked training state; every leaf's leading axis is n_peers."""
+
+    params: PyTree
+    opt_state: PyTree
+    clock: jnp.ndarray  # float32[n]
+    step: jnp.ndarray  # int32 scalar
+    model_state: PyTree = None
+
+
+def init_stacked_state(
+    stacked_params: PyTree,
+    optimizer: optax.GradientTransformation,
+    transport: StackedTransport,
+    stacked_model_state: PyTree = None,
+) -> StackedTrainState:
+    n = transport.config.n_peers
+    leading = {leaf.shape[0] for leaf in jax.tree.leaves(stacked_params)}
+    if leading != {n}:
+        raise ValueError(
+            f"stacked params must have leading peer axis {n}, got {leading}"
+        )
+    return StackedTrainState(
+        params=stacked_params,
+        opt_state=jax.vmap(optimizer.init)(stacked_params),
+        clock=jnp.zeros(n, jnp.float32),
+        step=jnp.int32(0),
+        model_state=stacked_model_state,
+    )
+
+
+def make_stacked_train_step(
+    loss_fn,
+    optimizer: optax.GradientTransformation,
+    transport: StackedTransport,
+    exchange_filter: Optional[Callable[[str], bool]] = None,
+    with_state: bool = False,
+):
+    """Jitted ``train_step(state, batch) -> (state, losses, info)`` on one
+    device: vmapped per-peer forward/backward/optimizer followed by the
+    stacked gossip exchange, all in one XLA program — the single-chip twin
+    of :func:`dpwa_tpu.train.make_gossip_train_step`.
+
+    ``batch`` is peer-stacked ``(x[n, b, ...], y[n, b])``; with
+    ``with_state=True``, ``loss_fn(params, model_state, batch) ->
+    (loss, new_model_state)`` as in
+    :func:`dpwa_tpu.train.make_gossip_train_step_with_state`.
+    """
+    grad_fn = jax.value_and_grad(loss_fn, has_aux=with_state)
+    schedule, interp = transport.schedule, transport.interp
+
+    def check_state(state):
+        # Same misuse guards as the SPMD twin (dpwa_tpu/train.py): silently
+        # frozen BatchNorm stats are worse than an error.
+        if not with_state and state.model_state is not None:
+            raise ValueError(
+                "state carries model_state but this step was built with "
+                "with_state=False, which would never update it; pass "
+                "with_state=True"
+            )
+        if with_state and state.model_state is None:
+            raise ValueError(
+                "step built with with_state=True but state.model_state is "
+                "None; pass stacked_model_state to init_stacked_state"
+            )
+
+    def per_peer(params, opt_state, model_state, batch):
+        if with_state:
+            (loss, new_model_state), grads = grad_fn(
+                params, model_state, batch
+            )
+        else:
+            loss, grads = grad_fn(params, batch)
+            new_model_state = ()
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, new_model_state, loss
+
+    @jax.jit
+    def _step(state: StackedTrainState, batch):
+        model_state = state.model_state if with_state else ()
+        params, opt_state, new_model_state, losses = jax.vmap(per_peer)(
+            state.params, state.opt_state, model_state, batch
+        )
+        clock = state.clock + 1.0
+        meta = PeerMeta(clock, losses.astype(jnp.float32))
+        if exchange_filter is not None:
+            selected, rest = pytree_partition(params, exchange_filter)
+            (merged_sel, merged_state), info = stacked_gossip_exchange(
+                (selected, new_model_state), meta, state.step,
+                schedule=schedule, interp=interp,
+            )
+            merged = pytree_combine(merged_sel, rest)
+        else:
+            (merged, merged_state), info = stacked_gossip_exchange(
+                (params, new_model_state), meta, state.step,
+                schedule=schedule, interp=interp,
+            )
+        new_state = StackedTrainState(
+            params=merged,
+            opt_state=opt_state,
+            clock=clock,
+            step=state.step + 1,
+            model_state=merged_state if with_state else state.model_state,
+        )
+        return new_state, losses, info
+
+    def train_step(state: StackedTrainState, batch):
+        check_state(state)
+        return _step(state, batch)
+
+    return train_step

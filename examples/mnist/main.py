@@ -14,6 +14,10 @@ ICI (TPU-native: one SPMD process drives every peer)::
 
     python main.py --config nodes.yaml --transport ici
 
+Stacked (single chip, N virtual peers — no mesh needed)::
+
+    python main.py --config nodes.yaml --transport stacked
+
 Uses full MNIST if found on disk, else the bundled 8×8 digits (this box has
 no network egress; see dpwa_tpu.data)."""
 
@@ -102,13 +106,17 @@ def run_tcp(args) -> None:
         adapter.close()
 
 
-def run_ici(args) -> None:
-    """SPMD: one process, every peer a device on the ``peers`` mesh axis."""
+def run_single_process(args, stacked: bool) -> None:
+    """One process drives every peer: SPMD over a device mesh
+    (``--transport ici``) or a stacked virtual-peer axis on one device
+    (``--transport stacked``).  Same data, model, loop, and report."""
     from dpwa_tpu.config import load_config
-    from dpwa_tpu.utils.devices import ensure_devices
 
     cfg = load_config(args.config)
-    ensure_devices(cfg.n_peers, mode=args.devices)
+    if not stacked:
+        from dpwa_tpu.utils.devices import ensure_devices
+
+        ensure_devices(cfg.n_peers, mode=args.devices)
 
     import jax
     import jax.numpy as jnp
@@ -116,26 +124,37 @@ def run_ici(args) -> None:
 
     from dpwa_tpu.data import load_mnist_or_digits, peer_batches
     from dpwa_tpu.metrics import MetricsLogger
-    from dpwa_tpu.parallel.ici import IciTransport
-    from dpwa_tpu.parallel.mesh import make_mesh
-    from dpwa_tpu.train import (
-        init_gossip_state,
-        init_params_per_peer,
-        make_gossip_eval_fn,
-        make_gossip_train_step,
-    )
+    from dpwa_tpu.train import init_params_per_peer, make_gossip_eval_fn
     from dpwa_tpu.utils.pytree import tree_size_bytes
 
     n = cfg.n_peers
-    transport = IciTransport(cfg, mesh=make_mesh(cfg))
+    if stacked:
+        from dpwa_tpu.parallel.stacked import (
+            StackedTransport,
+            init_stacked_state,
+            make_stacked_train_step,
+        )
+
+        transport = StackedTransport(cfg)
+        init_state, make_step = init_stacked_state, make_stacked_train_step
+        eval_transport = None
+    else:
+        from dpwa_tpu.parallel.ici import IciTransport
+        from dpwa_tpu.parallel.mesh import make_mesh
+        from dpwa_tpu.train import init_gossip_state, make_gossip_train_step
+
+        transport = IciTransport(cfg, mesh=make_mesh(cfg))
+        init_state, make_step = init_gossip_state, make_gossip_train_step
+        eval_transport = transport
+
     x_tr, y_tr, x_te, y_te, dataset = load_mnist_or_digits()
     model = build_model(x_tr.shape[1:])
     init = lambda k: model.init(k, jnp.zeros((1,) + x_tr.shape[1:]))
-    stacked = init_params_per_peer(init, jax.random.key(0), n)
+    stacked_params = init_params_per_peer(init, jax.random.key(0), n)
     opt = optax.adam(args.lr)
-    state = init_gossip_state(stacked, opt, transport)
-    step_fn = make_gossip_train_step(make_loss(model), opt, transport)
-    payload = tree_size_bytes(jax.tree.map(lambda v: v[0], stacked))
+    state = init_state(stacked_params, opt, transport)
+    step_fn = make_step(make_loss(model), opt, transport)
+    payload = tree_size_bytes(jax.tree.map(lambda v: v[0], stacked_params))
 
     metrics = MetricsLogger(stream=sys.stdout, every=args.log_every)
     batches = peer_batches(
@@ -144,7 +163,7 @@ def run_ici(args) -> None:
     for step in range(args.steps):
         state, losses, info = step_fn(state, next(batches))
         metrics.log_exchange(step, losses, info, payload_bytes=payload)
-    eval_fn = make_gossip_eval_fn(model.apply, transport)
+    eval_fn = make_gossip_eval_fn(model.apply, eval_transport)
     accs = np.asarray(eval_fn(state.params, jnp.asarray(x_te), jnp.asarray(y_te)))
     print(f"{dataset} per-peer test accuracy: {accs.round(4).tolist()}")
     print(f"mean test accuracy: {accs.mean():.4f}")
@@ -157,7 +176,9 @@ def main() -> None:
         default=os.path.join(os.path.dirname(__file__), "nodes.yaml"),
     )
     ap.add_argument("--name", help="this process's node name (TCP transport)")
-    ap.add_argument("--transport", choices=("tcp", "ici"), default="ici")
+    ap.add_argument(
+        "--transport", choices=("tcp", "ici", "stacked"), default="ici"
+    )
     ap.add_argument("--steps", type=int, default=300)
     ap.add_argument("--batch-size", type=int, default=32)
     ap.add_argument("--lr", type=float, default=2e-3)
@@ -177,7 +198,7 @@ def main() -> None:
             ap.error("--transport tcp requires --name (this node's identity)")
         run_tcp(args)
     else:
-        run_ici(args)
+        run_single_process(args, stacked=args.transport == "stacked")
 
 
 if __name__ == "__main__":

@@ -103,6 +103,20 @@ def test_pair_merge_fixed_points_untouched():
     np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-7)
 
 
+def test_pair_merge_pad_rows_bit_identical_despite_alpha():
+    # A pad self-pair must be an exact no-op even when the padded row's
+    # alpha is nonzero: (1-a)x + a·x is NOT bitwise x for a ∉ {0,1}, so
+    # the kernel forces a=0 on L==R pairs.  (On TPU the unforced form
+    # really does perturb the row — caught on hardware.)
+    x, _, _ = _case(n=4, d=1024)
+    alpha = jnp.full((4,), 0.7, jnp.float32)
+    left = jnp.asarray([0, 2], jnp.int32)
+    right = jnp.asarray([1, 2], jnp.int32)  # (0,1) real pair; (2,2) pad
+    got = np.asarray(pallas_pair_merge(x.copy(), left, right, alpha))
+    np.testing.assert_array_equal(got[2], np.asarray(x)[2])
+    np.testing.assert_array_equal(got[3], np.asarray(x)[3])
+
+
 def test_pair_merge_odd_shape_falls_back():
     x, partner, alpha = _case(d=1000)  # not a multiple of 1024
     want = np.asarray(xla_pairwise_merge(x, partner, alpha))
