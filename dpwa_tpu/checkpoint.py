@@ -18,6 +18,7 @@ import os
 from typing import Any, Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 import orbax.checkpoint as ocp
 
@@ -26,21 +27,27 @@ from dpwa_tpu.train import GossipTrainState
 PyTree = Any
 
 
-def save_checkpoint(path: str, state: GossipTrainState) -> None:
-    """Atomically save a gossip training state to ``path`` (a directory)."""
+def save_checkpoint(path: str, state) -> None:
+    """Atomically save a training state to ``path`` (a directory).
+
+    Accepts either peer-layout: :class:`~dpwa_tpu.train.GossipTrainState`
+    (mesh-sharded SPMD) or
+    :class:`~dpwa_tpu.parallel.stacked.StackedTrainState` (single-device
+    virtual peers) — both carry the same fields, so a run can even be
+    saved from one layout and resumed in the other (pass the matching
+    ``like`` at restore)."""
     path = os.path.abspath(path)
     with ocp.StandardCheckpointer() as ckptr:
         ckptr.save(path, dict(state._asdict()), force=True)
 
 
-def restore_checkpoint(
-    path: str, like: Optional[GossipTrainState] = None
-) -> GossipTrainState:
+def restore_checkpoint(path: str, like: Optional[Any] = None):
     """Restore a state saved by :func:`save_checkpoint`.
 
     ``like`` (same treedef/shapes/shardings as the saved state) restores
-    arrays onto the right devices/shardings; without it, arrays come back
-    as host numpy."""
+    arrays onto the right devices/shardings, and its type decides the
+    returned state class; without it, arrays come back as host numpy in a
+    :class:`GossipTrainState`."""
     path = os.path.abspath(path)
     with ocp.StandardCheckpointer() as ckptr:
         if like is not None:
@@ -48,6 +55,14 @@ def restore_checkpoint(
                 ocp.utils.to_shape_dtype_struct, dict(like._asdict())
             )
             restored = ckptr.restore(path, target)
+            # ``step`` is a host-scalar in spirit: leave it uncommitted so
+            # it can join a jitted computation under ANY sharding layout (a
+            # restored committed-to-one-device scalar would conflict with
+            # mesh-sharded params when resuming in a different layout than
+            # the save ran in).  Without ``like`` everything stays host
+            # numpy, per the contract above.
+            restored["step"] = jnp.asarray(np.asarray(restored["step"]))
         else:
             restored = ckptr.restore(path)
-    return GossipTrainState(**restored)
+    cls = type(like) if like is not None else GossipTrainState
+    return cls(**restored)

@@ -84,9 +84,15 @@ def main() -> None:
     ap.add_argument("--log-every", type=int, default=50)
     ap.add_argument("--bf16", action="store_true", help="bfloat16 compute")
     ap.add_argument(
+        "--transport", choices=("ici", "stacked"), default="ici",
+        help="'ici': SPMD over a device mesh (one device per peer); "
+        "'stacked': all peers on ONE device as a stacked axis — the "
+        "single-chip benchmarking mode",
+    )
+    ap.add_argument(
         "--devices", default="auto", choices=("auto", "cpu", "native"),
-        help="'native' uses the real accelerator mesh; 'cpu' forces an "
-        "emulated host mesh; 'auto' picks (default)",
+        help="ici only: 'native' uses the real accelerator mesh; 'cpu' "
+        "forces an emulated host mesh; 'auto' picks (default)",
     )
     args = ap.parse_args()
 
@@ -100,7 +106,18 @@ def main() -> None:
         else os.path.join(here, args.config)
     )
     cfg = load_config(cfg_path)
-    ensure_devices(cfg.n_peers, mode=args.devices)
+    if args.transport == "ici":
+        ensure_devices(cfg.n_peers, mode=args.devices)
+    else:
+        # Stacked needs one device, but the policy still applies: 'native'
+        # must not silently fall back to CPU and report its steps/sec as a
+        # single-chip number.
+        (dev,) = ensure_devices(1, mode=args.devices)
+        if args.devices == "native" and dev.platform == "cpu":
+            raise RuntimeError(
+                "--devices native: no accelerator available (jax picked "
+                "cpu); drop --devices or use --devices cpu explicitly"
+            )
 
     import jax
     import jax.numpy as jnp
@@ -109,13 +126,9 @@ def main() -> None:
     from dpwa_tpu.data import peer_batches
     from dpwa_tpu.metrics import MetricsLogger
     from dpwa_tpu.models.resnet import ResNet20
-    from dpwa_tpu.parallel.ici import IciTransport
-    from dpwa_tpu.parallel.mesh import make_mesh
     from dpwa_tpu.train import (
-        init_gossip_state,
         init_params_per_peer,
         make_gossip_eval_fn,
-        make_gossip_train_step,
     )
     from dpwa_tpu.utils.pytree import tree_size_bytes
 
@@ -134,21 +147,38 @@ def main() -> None:
         dataset = "synthetic-cifar-shaped"
 
     n = cfg.n_peers
-    transport = IciTransport(cfg, mesh=make_mesh(cfg))
+    if args.transport == "stacked":
+        from dpwa_tpu.parallel.stacked import (
+            StackedTransport,
+            init_stacked_state,
+            make_stacked_train_step,
+        )
+
+        transport = StackedTransport(cfg)
+        init_state, make_step = init_stacked_state, make_stacked_train_step
+        eval_transport = None
+    else:
+        from dpwa_tpu.parallel.ici import IciTransport
+        from dpwa_tpu.parallel.mesh import make_mesh
+        from dpwa_tpu.train import init_gossip_state, make_gossip_train_step
+
+        transport = IciTransport(cfg, mesh=make_mesh(cfg))
+        init_state, make_step = init_gossip_state, make_gossip_train_step
+        eval_transport = transport
     model = ResNet20(dtype=jnp.bfloat16 if args.bf16 else jnp.float32)
     init = lambda k: model.init(k, jnp.zeros((1, 32, 32, 3)))
     stacked = init_params_per_peer(init, jax.random.key(0), n)
     opt = optax.chain(
         optax.sgd(args.lr, momentum=0.9),
     )
-    state = init_gossip_state(stacked, opt, transport)
+    state = init_state(stacked, opt, transport)
 
     def loss_fn(params, batch):
         x, y = batch
         logits = model.apply(params, x)
         return optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
 
-    step_fn = make_gossip_train_step(loss_fn, opt, transport)
+    step_fn = make_step(loss_fn, opt, transport)
     payload = tree_size_bytes(jax.tree.map(lambda v: v[0], stacked))
     metrics = MetricsLogger(stream=sys.stdout, every=args.log_every)
     batches = peer_batches(x_tr, y_tr, n, args.batch_size, seed=cfg.protocol.seed)
@@ -164,11 +194,16 @@ def main() -> None:
     dt = time.perf_counter() - t0
     steps_per_sec = (args.steps - 1) / dt
 
-    eval_fn = make_gossip_eval_fn(model.apply, transport)
+    eval_fn = make_gossip_eval_fn(model.apply, eval_transport)
     accs = np.asarray(eval_fn(state.params, jnp.asarray(x_te), jnp.asarray(y_te)))
     acc_note = "" if dataset == "cifar10" else " (synthetic labels: chance-level)"
+    plat = jax.devices()[0].platform
+    ndev = 1 if args.transport == "stacked" else n
     print(f"dataset: {dataset}")
-    print(f"steps/sec (all {n} peers, incl. exchange): {steps_per_sec:.3f}")
+    print(
+        f"steps/sec (all {n} peers, incl. exchange, on {plat} x{ndev}): "
+        f"{steps_per_sec:.3f}"
+    )
     print(f"mean test accuracy: {accs.mean():.4f}{acc_note}")
 
 
