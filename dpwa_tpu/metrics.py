@@ -41,13 +41,16 @@ class MetricsLogger:
         self._stream = stream
         self.every = max(1, every)
         self._t0 = time.perf_counter()
+        self._pending = None
 
-    def log(self, step: int, **fields: Any) -> None:
+    def log(self, step: int, _t: Optional[float] = None, **fields: Any) -> None:
         if step % self.every != 0:
             return
         rec: dict[str, Any] = {
             "step": int(step),
-            "t": round(time.perf_counter() - self._t0, 4),
+            "t": round(
+                (time.perf_counter() - self._t0) if _t is None else _t, 4
+            ),
         }
         for k, v in fields.items():
             rec[k] = _jsonable(v)
@@ -58,19 +61,61 @@ class MetricsLogger:
         if self._stream is not None:
             print(line, file=self._stream, flush=True)
 
+    def elapsed(self) -> float:
+        """Seconds since this logger was created (the ``t`` clock)."""
+        return time.perf_counter() - self._t0
+
     def log_exchange(
         self,
         step: int,
         losses,
         info,
         payload_bytes: int,
+        t: Optional[float] = None,
         **extra: Any,
     ) -> None:
-        """Convenience: the standard gossip-round record."""
+        """Convenience: the standard gossip-round record — **deferred**.
+
+        Materializing a device value mid-stream blocks on the whole
+        in-flight dispatch pipeline, and that sync can dominate the loop
+        when device↔host latency is high (observed: seconds per sync
+        through a tunneled chip vs a sub-ms train step).  So this method
+        never blocks: on non-logging steps it returns without touching
+        ``losses``/``info`` at all; on logging steps it starts async
+        device→host copies and WRITES THE RECORD AT THE NEXT LOGGING
+        POINT (or :meth:`close`), by which time the data has long
+        arrived.  Records therefore appear one logging interval late,
+        with their original ``step``/``t`` stamps.
+
+        ``t`` overrides the record's time stamp (seconds on the
+        :meth:`elapsed` clock) — for callers that buffer records
+        themselves and replay them after a timed region."""
+        if step % self.every != 0:
+            return
+        for arr in (losses, info.partner, info.alpha, info.participated):
+            if hasattr(arr, "copy_to_host_async"):
+                arr.copy_to_host_async()
+        self.flush()
+        self._pending = (
+            step,
+            self.elapsed() if t is None else t,
+            losses,
+            info,
+            payload_bytes,
+            extra,
+        )
+
+    def flush(self) -> None:
+        """Write the deferred record, if any (blocks only on its arrays)."""
+        if self._pending is None:
+            return
+        step, t, losses, info, payload_bytes, extra = self._pending
+        self._pending = None
         alpha = np.asarray(info.alpha)
         part = np.asarray(info.participated)
         self.log(
             step,
+            _t=t,
             loss_mean=float(np.asarray(losses).mean()),
             losses=losses,
             partner=info.partner,
@@ -81,5 +126,6 @@ class MetricsLogger:
         )
 
     def close(self) -> None:
+        self.flush()
         if self._file is not None:
             self._file.close()

@@ -18,6 +18,7 @@ there is simply no collective in it, only a leading-axis gather.
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Callable, NamedTuple, Optional, Tuple
 
 import jax
@@ -153,12 +154,18 @@ def init_stacked_state(
         raise ValueError(
             f"stacked params must have leading peer axis {n}, got {leading}"
         )
+    # Own copies: the train step DONATES the state, so the state must not
+    # alias arrays the caller still holds.
+    own = lambda t: jax.tree.map(lambda v: jnp.array(v, copy=True), t)
+    params = own(stacked_params)
     return StackedTrainState(
-        params=stacked_params,
-        opt_state=jax.vmap(optimizer.init)(stacked_params),
+        params=params,
+        opt_state=jax.vmap(optimizer.init)(params),
         clock=jnp.zeros(n, jnp.float32),
         step=jnp.int32(0),
-        model_state=stacked_model_state,
+        model_state=own(stacked_model_state)
+        if stacked_model_state is not None
+        else None,
     )
 
 
@@ -178,6 +185,12 @@ def make_stacked_train_step(
     ``with_state=True``, ``loss_fn(params, model_state, batch) ->
     (loss, new_model_state)`` as in
     :func:`dpwa_tpu.train.make_gossip_train_step_with_state`.
+
+    The state is **donated**: each call consumes its input state's buffers
+    and the caller must use the returned one (``state, … = step(state, …)``
+    — the standard loop).  Without donation every in-flight step holds a
+    full fresh copy of params + optimizer state, and a deep async dispatch
+    queue (hundreds of steps) can swamp the HBM allocator.
     """
     grad_fn = jax.value_and_grad(loss_fn, has_aux=with_state)
     schedule, interp = transport.schedule, transport.interp
@@ -209,7 +222,7 @@ def make_stacked_train_step(
         params = optax.apply_updates(params, updates)
         return params, opt_state, new_model_state, loss
 
-    @jax.jit
+    @functools.partial(jax.jit, donate_argnums=(0,))
     def _step(state: StackedTrainState, batch):
         model_state = state.model_state if with_state else ()
         params, opt_state, new_model_state, losses = jax.vmap(per_peer)(

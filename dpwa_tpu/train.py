@@ -17,6 +17,7 @@ in the compiled program is the pairing ``ppermute`` of the exchange."""
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Callable, NamedTuple, Optional, Tuple
 
 import jax
@@ -70,7 +71,15 @@ def init_gossip_state(
         )
     opt_state = jax.vmap(optimizer.init)(stacked_params)
     sh = peer_sharding(transport.mesh, transport.axis_name)
-    put = lambda t: jax.tree.map(lambda v: jax.device_put(v, sh), t)
+    # The train step donates the state, so it must not alias arrays the
+    # caller still holds.  device_put of HOST data always materializes
+    # fresh buffers; only an existing jax.Array (possibly already in the
+    # target sharding, where device_put can alias) needs the extra copy.
+    def own(v):
+        out = jax.device_put(v, sh)
+        return out.copy() if isinstance(v, jax.Array) else out
+
+    put = lambda t: jax.tree.map(own, t)
     return GossipTrainState(
         params=put(stacked_params),
         opt_state=put(opt_state),
@@ -165,7 +174,11 @@ def _make_step(
         ),
     )
 
-    @jax.jit
+    # Donated: each call consumes the input state's buffers (the caller
+    # rebinds `state, … = step(state, …)`).  Without donation every
+    # in-flight step holds a fresh params+opt copy and a deep async
+    # dispatch queue can swamp the HBM allocator.
+    @functools.partial(jax.jit, donate_argnums=(0,))
     def _step(state: GossipTrainState, batch):
         params, opt_state, model_state, clock, losses, info = mapped(
             state.params,

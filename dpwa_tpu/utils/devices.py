@@ -32,6 +32,7 @@ def repoint_to_host_mesh(n: int):
     import re
 
     import jax
+    from jax._src import xla_bridge as xb
 
     flags = os.environ.get("XLA_FLAGS", "")
     m = re.search(r"--xla_force_host_platform_device_count=(\d+)", flags)
@@ -39,6 +40,12 @@ def repoint_to_host_mesh(n: int):
         want = f"--xla_force_host_platform_device_count={n}"
         flags = flags.replace(m.group(0), want) if m else f"{flags} {want}"
         os.environ["XLA_FLAGS"] = flags.strip()
+    if not xb.backends_are_initialized():
+        # Decide the platform BEFORE the first backend probe: the caller
+        # wants a host mesh, so never initialize a site-registered
+        # accelerator plugin just to count its devices — plugin init can
+        # block indefinitely (e.g. a tunneled chip whose relay is down).
+        jax.config.update("jax_platforms", "cpu")
     if len(jax.devices()) < n:
         import jax.extend.backend
 

@@ -91,8 +91,10 @@ def main() -> None:
     )
     ap.add_argument(
         "--devices", default="auto", choices=("auto", "cpu", "native"),
-        help="ici only: 'native' uses the real accelerator mesh; 'cpu' "
-        "forces an emulated host mesh; 'auto' picks (default)",
+        help="ici: 'native' requires a real accelerator mesh, 'cpu' forces "
+        "an emulated host mesh, 'auto' picks.  stacked: 'native' errors "
+        "unless an accelerator is present, 'cpu' forces the CPU backend, "
+        "'auto' keeps jax's default device",
     )
     args = ap.parse_args()
 
@@ -109,21 +111,28 @@ def main() -> None:
     if args.transport == "ici":
         ensure_devices(cfg.n_peers, mode=args.devices)
     else:
-        # Stacked needs one device, but the policy still applies: 'native'
-        # must not silently fall back to CPU and report its steps/sec as a
-        # single-chip number.
-        (dev,) = ensure_devices(1, mode=args.devices)
-        if args.devices == "native" and dev.platform == "cpu":
-            raise RuntimeError(
-                "--devices native: no accelerator available (jax picked "
-                "cpu); drop --devices or use --devices cpu explicitly"
-            )
+        # Stacked needs one device and should keep jax's native pick (the
+        # real chip) — ensure_devices' auto mode would force the emulated
+        # CPU mesh, which is for multi-device ICI runs.  The policy still
+        # applies: 'cpu' forces CPU, 'native' must not silently report a
+        # CPU fallback's steps/sec as a single-chip number.
+        if args.devices == "cpu":
+            ensure_devices(1, mode="cpu")
+        elif args.devices == "native":
+            import jax
+
+            if jax.devices()[0].platform == "cpu":
+                raise RuntimeError(
+                    "--devices native: no accelerator available (jax "
+                    "picked cpu); drop --devices or use --devices cpu "
+                    "explicitly"
+                )
 
     import jax
     import jax.numpy as jnp
     import optax
 
-    from dpwa_tpu.data import peer_batches
+    from dpwa_tpu.data import device_prefetch, peer_batches
     from dpwa_tpu.metrics import MetricsLogger
     from dpwa_tpu.models.resnet import ResNet20
     from dpwa_tpu.train import (
@@ -165,6 +174,14 @@ def main() -> None:
         transport = IciTransport(cfg, mesh=make_mesh(cfg))
         init_state, make_step = init_gossip_state, make_gossip_train_step
         eval_transport = transport
+    # Stage batches peer-sharded for the mesh path (a whole batch committed
+    # to one device would be resharded inside the jitted shard_map, which
+    # the thread-starved forced-CPU mesh cannot always service).
+    batch_sharding = None
+    if args.transport == "ici":
+        from dpwa_tpu.parallel.mesh import peer_sharding
+
+        batch_sharding = peer_sharding(transport.mesh)
     model = ResNet20(dtype=jnp.bfloat16 if args.bf16 else jnp.float32)
     init = lambda k: model.init(k, jnp.zeros((1, 32, 32, 3)))
     stacked = init_params_per_peer(init, jax.random.key(0), n)
@@ -181,17 +198,35 @@ def main() -> None:
     step_fn = make_step(loss_fn, opt, transport)
     payload = tree_size_bytes(jax.tree.map(lambda v: v[0], stacked))
     metrics = MetricsLogger(stream=sys.stdout, every=args.log_every)
-    batches = peer_batches(x_tr, y_tr, n, args.batch_size, seed=cfg.protocol.seed)
+    batches = device_prefetch(
+        peer_batches(x_tr, y_tr, n, args.batch_size, seed=cfg.protocol.seed),
+        sharding=batch_sharding,
+    )
 
     # Warmup/compile outside the timed region.
     state, losses, info = step_fn(state, next(batches))
     jax.block_until_ready(state.params)
-    t0 = time.perf_counter()
-    for step in range(1, args.steps):
-        state, losses, info = step_fn(state, next(batches))
-        metrics.log_exchange(step, losses, info, payload_bytes=payload)
-    jax.block_until_ready(state.params)
-    dt = time.perf_counter() - t0
+    # Metric values are RETAINED (tiny per-step device scalars, with
+    # their step-time stamps) and written after timing: materializing a
+    # device value mid-loop blocks on the whole in-flight pipeline,
+    # which would measure host↔device sync latency instead of training
+    # throughput.  The finally block flushes whatever was collected even
+    # if the run dies mid-loop.
+    records = []
+    try:
+        t0 = time.perf_counter()
+        for step in range(1, args.steps):
+            state, losses, info = step_fn(state, next(batches))
+            if step % metrics.every == 0:
+                records.append((step, metrics.elapsed(), losses, info))
+        jax.block_until_ready(state.params)
+        dt = time.perf_counter() - t0
+    finally:
+        for step, t_rec, losses_rec, info_rec in records:
+            metrics.log_exchange(
+                step, losses_rec, info_rec, payload_bytes=payload, t=t_rec
+            )
+        metrics.close()
     steps_per_sec = (args.steps - 1) / dt
 
     eval_fn = make_gossip_eval_fn(model.apply, eval_transport)

@@ -127,9 +127,12 @@ def main() -> None:
     state, losses, info = step_fn(state, batch())
     jax.block_until_ready(state.params)
     t0 = time.perf_counter()
-    for step in range(1, args.steps):
-        state, losses, info = step_fn(state, batch())
-        metrics.log_exchange(step, losses, info, payload_bytes=lora_bytes)
+    try:
+        for step in range(1, args.steps):
+            state, losses, info = step_fn(state, batch())
+            metrics.log_exchange(step, losses, info, payload_bytes=lora_bytes)
+    finally:
+        metrics.close()
     jax.block_until_ready(state.params)
     dt = time.perf_counter() - t0
     print(f"steps/sec (all {n} peers, incl. exchange): {(args.steps-1)/dt:.3f}")

@@ -117,12 +117,28 @@ def run_single_process(args, stacked: bool) -> None:
         from dpwa_tpu.utils.devices import ensure_devices
 
         ensure_devices(cfg.n_peers, mode=args.devices)
+    elif args.devices == "cpu":
+        from dpwa_tpu.utils.devices import ensure_devices
+
+        ensure_devices(1, mode="cpu")
+    elif args.devices == "native":
+        import jax
+
+        if jax.devices()[0].platform == "cpu":
+            raise RuntimeError(
+                "--devices native: no accelerator available (jax picked "
+                "cpu); drop --devices or use --devices cpu explicitly"
+            )
 
     import jax
     import jax.numpy as jnp
     import optax
 
-    from dpwa_tpu.data import load_mnist_or_digits, peer_batches
+    from dpwa_tpu.data import (
+        device_prefetch,
+        load_mnist_or_digits,
+        peer_batches,
+    )
     from dpwa_tpu.metrics import MetricsLogger
     from dpwa_tpu.train import init_params_per_peer, make_gossip_eval_fn
     from dpwa_tpu.utils.pytree import tree_size_bytes
@@ -147,6 +163,16 @@ def run_single_process(args, stacked: bool) -> None:
         init_state, make_step = init_gossip_state, make_gossip_train_step
         eval_transport = transport
 
+    # Stage batches in the layout the step consumes: peer-sharded over the
+    # mesh for ICI, single-device for stacked.  (A batch committed whole to
+    # one device would be resharded inside the jitted shard_map, which the
+    # thread-starved forced-CPU mesh cannot always service.)
+    batch_sharding = None
+    if not stacked:
+        from dpwa_tpu.parallel.mesh import peer_sharding
+
+        batch_sharding = peer_sharding(transport.mesh)
+
     x_tr, y_tr, x_te, y_te, dataset = load_mnist_or_digits()
     model = build_model(x_tr.shape[1:])
     init = lambda k: model.init(k, jnp.zeros((1,) + x_tr.shape[1:]))
@@ -157,12 +183,16 @@ def run_single_process(args, stacked: bool) -> None:
     payload = tree_size_bytes(jax.tree.map(lambda v: v[0], stacked_params))
 
     metrics = MetricsLogger(stream=sys.stdout, every=args.log_every)
-    batches = peer_batches(
-        x_tr, y_tr, n, args.batch_size, seed=cfg.protocol.seed
+    batches = device_prefetch(
+        peer_batches(x_tr, y_tr, n, args.batch_size, seed=cfg.protocol.seed),
+        sharding=batch_sharding,
     )
-    for step in range(args.steps):
-        state, losses, info = step_fn(state, next(batches))
-        metrics.log_exchange(step, losses, info, payload_bytes=payload)
+    try:
+        for step in range(args.steps):
+            state, losses, info = step_fn(state, next(batches))
+            metrics.log_exchange(step, losses, info, payload_bytes=payload)
+    finally:
+        metrics.close()
     eval_fn = make_gossip_eval_fn(model.apply, eval_transport)
     accs = np.asarray(eval_fn(state.params, jnp.asarray(x_te), jnp.asarray(y_te)))
     print(f"{dataset} per-peer test accuracy: {accs.round(4).tolist()}")
@@ -189,8 +219,10 @@ def main() -> None:
     )
     ap.add_argument(
         "--devices", default="auto", choices=("auto", "cpu", "native"),
-        help="ICI mode: 'native' uses the real accelerator mesh; 'cpu' "
-        "forces an emulated host mesh; 'auto' picks (default)",
+        help="ici: 'native' requires a real accelerator mesh, 'cpu' forces "
+        "an emulated host mesh, 'auto' picks.  stacked: 'native' errors "
+        "unless an accelerator is present, 'cpu' forces the CPU backend, "
+        "'auto' keeps jax's default device",
     )
     args = ap.parse_args()
     if args.transport == "tcp":
