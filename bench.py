@@ -9,24 +9,41 @@ this (pairwise-avg GB/s/chip) the metric; the north-star target is ≥50× the
 CPU/TCP path (BASELINE.json:5).
 
 Accounting (SURVEY.md §7 "honest GB/s/chip"): one exchange moves
-2 × vector-bytes per peer (receive the partner's vector, write the merge).
-With N real devices the exchange is the actual ``ppermute`` collective; on a
-single chip it is the stacked virtual-peer merge (same math, measures the
-on-chip HBM path).  Both are reported per chip.
+2 × vector-bytes per participating peer (receive the partner's vector, write
+the merge).  With N real devices the exchange is the actual ``ppermute``
+collective; on a single chip it is the stacked virtual-peer merge (same math,
+measures the on-chip HBM path).  Both are reported per chip.  Pools padded
+with self-pairs are counted by their *actual* pair count, so padded DMA rows
+never inflate the figure (exact for perfect matchings, conservative
+otherwise).
+
+Robustness: the accelerator backend on this box (a tunneled chip) can fail
+*or hang* at init.  The main process therefore never imports JAX; it probes
+the backend and runs the device leg in watchdog'd subprocesses, falls back
+to CPU on failure/timeout, and ALWAYS prints the final JSON line — worst
+case with the TCP baseline alone and ``backend: "none"``.
 
 Prints ONE JSON line:
-  {"metric": ..., "value": ..., "unit": "GB/s/chip", "vs_baseline": ...}
+  {"metric": ..., "value": ..., "unit": "GB/s/chip", "vs_baseline": ...,
+   "backend": "tpu"|"cpu"|"none", "tcp_baseline_gbps": ...}
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
+import subprocess
 import sys
 import threading
 import time
 
 import numpy as np
+
+# Conservative stand-in used for vs_baseline only when the in-run TCP leg
+# fails; value is the dev-box measurement recorded in BASELINE.md (2 peers,
+# localhost TCP, 100 MB f32 vector).
+RECORDED_TCP_GBPS = 0.22
 
 
 def log(msg: str) -> None:
@@ -94,7 +111,8 @@ def bench_device(d: int, n_peers: int, iters: int) -> float:
     )[:, None]
 
     if devices[0].platform == "tpu" and d % 1024 == 0:
-        n_pairs = max(len(involution_pairs(p)[0]) for p in pools)
+        actual_pairs = [len(involution_pairs(p)[0]) for p in pools]
+        n_pairs = max(actual_pairs)
         lr = [involution_pairs(p, pad_to=n_pairs) for p in pools]
         lefts = [jnp.asarray(l) for l, _ in lr]
         rights = [jnp.asarray(r) for _, r in lr]
@@ -110,10 +128,13 @@ def bench_device(d: int, n_peers: int, iters: int) -> float:
         # Host readback forces real completion (see multi-device note).
         float(x.sum())
         dt = time.perf_counter() - t0
-        # Honest accounting: the in-place kernel touches exactly the
-        # 2*n_pairs listed rows (fixed-point peers sit out with zero
-        # traffic), each read once + written once.
-        total_bytes = 2 * n_pairs * 2 * d * 4 * iters
+        # Honest accounting: count only the per-pool *actual* pairs over the
+        # iteration sequence, each row read once + written once.  Pools
+        # padded to max(n_pairs) do DMA the pad self-pair rows, but those
+        # bytes are excluded here so padding can only understate GB/s.
+        total_bytes = sum(
+            2 * actual_pairs[step % 2] * 2 * d * 4 for step in range(iters)
+        )
         return total_bytes / dt / 1e9
 
     perms = jnp.asarray(np.stack(pools), jnp.int32)
@@ -180,6 +201,67 @@ def bench_tcp(d: int, iters: int, timeout_ms: int = 10000) -> float:
             t.close()
 
 
+# ---------------------------------------------------------------------------
+# Watchdog'd subprocess orchestration (main process never imports JAX).
+# ---------------------------------------------------------------------------
+
+PROBE_SNIPPET = (
+    "import jax, jax.numpy as jnp;"
+    "print('PLATFORM', jax.devices()[0].platform);"
+    "print('SUM', float(jnp.ones(8).sum()))"
+)
+
+
+def probe_backend(timeout_s: float) -> str | None:
+    """Init + tiny compile in a subprocess; returns platform or None.
+
+    The axon plugin has been observed to *hang* (not just raise) at init
+    (VERDICT.md round 1), so the probe must be a killable subprocess.
+    """
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", PROBE_SNIPPET],
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+            env=os.environ.copy(),
+        )
+    except subprocess.TimeoutExpired:
+        log(f"backend probe HUNG past {timeout_s:.0f}s — treating as dead")
+        return None
+    if proc.returncode != 0:
+        tail = (proc.stderr or "").strip().splitlines()[-1:]
+        log(f"backend probe failed rc={proc.returncode}: {tail}")
+        return None
+    for line in proc.stdout.splitlines():
+        if line.startswith("PLATFORM "):
+            return line.split(None, 1)[1].strip()
+    return None
+
+
+def run_leg(
+    leg: str, extra: list[str], tag: str, timeout_s: float, env: dict
+) -> float | None:
+    """Run one benchmark leg as a watchdog'd subprocess; GB/s or None."""
+    cmd = [sys.executable, os.path.abspath(__file__), leg, *extra]
+    try:
+        proc = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=timeout_s, env=env
+        )
+    except subprocess.TimeoutExpired:
+        log(f"{leg} HUNG past {timeout_s:.0f}s — killed")
+        return None
+    sys.stderr.write(proc.stderr or "")
+    if proc.returncode != 0:
+        log(f"{leg} failed rc={proc.returncode}")
+        return None
+    for line in proc.stdout.splitlines():
+        if line.startswith(tag + " "):
+            return float(line.split()[1])
+    log(f"{leg} produced no {tag} line")
+    return None
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument(
@@ -194,26 +276,117 @@ def main() -> None:
         "--tcp-size", type=int, default=0,
         help="TCP vector length (defaults to --size)",
     )
+    ap.add_argument(
+        "--probe-timeout", type=float, default=240.0,
+        help="seconds before the backend-init probe is declared hung",
+    )
+    ap.add_argument(
+        "--device-timeout", type=float, default=600.0,
+        help="seconds before the device benchmark leg is declared hung",
+    )
+    ap.add_argument(
+        "--cpu-size", type=int, default=4 * 1024 * 1024,
+        help="reduced vector length for the CPU fallback leg",
+    )
+    ap.add_argument(
+        "--device-leg", action="store_true",
+        help="(internal) run only the device benchmark in this process",
+    )
+    ap.add_argument(
+        "--tcp-leg", action="store_true",
+        help="(internal) run only the TCP baseline in this process",
+    )
     args = ap.parse_args()
 
+    if args.device_leg:
+        gbps = bench_device(args.size, args.peers, args.iters)
+        print(f"DEVICE_GBPS {gbps:.6f}", flush=True)
+        return
+    if args.tcp_leg:
+        gbps = bench_tcp(args.tcp_size or args.size, args.tcp_iters)
+        print(f"TCP_GBPS {gbps:.6f}", flush=True)
+        return
+
+    # --- TCP baseline.  Subprocess pinned to the CPU backend: the transport
+    # itself is pure stdlib, but its schedule/interpolation imports touch
+    # jax, and backend init on this box can hang (VERDICT.md round 1).
+    # JAX_PLATFORMS=cpu alone is NOT enough — the tunnel's sitecustomize
+    # hook (injected via PYTHONPATH) patches backend resolution and hangs
+    # even for the CPU platform, so the hook dir must be scrubbed too.
     tcp_d = args.tcp_size or args.size
     log(f"TCP baseline: d={tcp_d} ({tcp_d * 4 / 1e6:.0f} MB) ...")
-    tcp_gbps = bench_tcp(tcp_d, args.tcp_iters)
-    log(f"TCP baseline: {tcp_gbps:.3f} GB/s/peer")
+    cpu_env = os.environ.copy()
+    cpu_env["JAX_PLATFORMS"] = "cpu"
+    cpu_env["PYTHONPATH"] = os.pathsep.join(
+        p for p in cpu_env.get("PYTHONPATH", "").split(os.pathsep)
+        if p and "axon" not in p
+    )
+    tcp_gbps = run_leg(
+        "--tcp-leg",
+        ["--tcp-size", str(tcp_d), "--tcp-iters", str(args.tcp_iters)],
+        "TCP_GBPS", args.device_timeout, cpu_env,
+    )
+    if tcp_gbps is not None:
+        log(f"TCP baseline: {tcp_gbps:.3f} GB/s/peer")
 
-    log(f"device path: d={args.size}, peers={args.peers} ...")
-    dev_gbps = bench_device(args.size, args.peers, args.iters)
-    log(f"device path: {dev_gbps:.2f} GB/s/chip")
+    # --- Backend probe, then the watchdog'd device leg with CPU fallback.
+    dev_gbps = None
+    backend = "none"
+    platform = probe_backend(args.probe_timeout)
+    cpu_leg_args = [
+        "--size", str(args.cpu_size),
+        "--peers", str(args.peers),
+        "--iters", str(max(args.iters // 3, 3)),
+    ]
+    if platform is not None:
+        log(f"backend probe OK: {platform}")
+        if platform == "cpu":
+            # Already on CPU: go straight to the reduced-size leg — the
+            # full accelerator-scale sizes exist for accelerator speeds.
+            leg_args = cpu_leg_args
+        else:
+            leg_args = [
+                "--size", str(args.size),
+                "--peers", str(args.peers),
+                "--iters", str(args.iters),
+            ]
+        log(f"device path: {leg_args} ...")
+        dev_gbps = run_leg(
+            "--device-leg", leg_args,
+            "DEVICE_GBPS", args.device_timeout, os.environ.copy(),
+        )
+        if dev_gbps is not None:
+            backend = platform
 
+    if dev_gbps is None and platform != "cpu":
+        log("falling back to CPU backend ...")
+        dev_gbps = run_leg(
+            "--device-leg", cpu_leg_args,
+            "DEVICE_GBPS", args.device_timeout, cpu_env,
+        )
+        if dev_gbps is not None:
+            backend = "cpu"
+
+    if dev_gbps is not None:
+        log(f"device path [{backend}]: {dev_gbps:.2f} GB/s/chip")
+
+    # --- The JSON line is emitted unconditionally.
+    baseline = tcp_gbps if tcp_gbps is not None else RECORDED_TCP_GBPS
+    value = dev_gbps if dev_gbps is not None else baseline
     print(
         json.dumps(
             {
                 "metric": "pairwise_avg_bandwidth",
-                "value": round(dev_gbps, 3),
+                "value": round(value, 3),
                 "unit": "GB/s/chip",
-                "vs_baseline": round(dev_gbps / tcp_gbps, 2),
+                "vs_baseline": round(value / baseline, 2),
+                "backend": backend,
+                "tcp_baseline_gbps": (
+                    round(tcp_gbps, 3) if tcp_gbps is not None else None
+                ),
             }
-        )
+        ),
+        flush=True,
     )
 
 

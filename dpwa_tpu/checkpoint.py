@@ -47,7 +47,11 @@ def restore_checkpoint(path: str, like: Optional[Any] = None):
     ``like`` (same treedef/shapes/shardings as the saved state) restores
     arrays onto the right devices/shardings, and its type decides the
     returned state class; without it, arrays come back as host numpy in a
-    :class:`GossipTrainState`."""
+    :class:`GossipTrainState` REGARDLESS of which layout saved the
+    checkpoint (the file records no layout; the two state classes carry
+    identical fields).  To re-label, rewrap:
+    ``StackedTrainState(**restored._asdict())``.  Pass ``like`` whenever
+    the class identity matters."""
     path = os.path.abspath(path)
     with ocp.StandardCheckpointer() as ckptr:
         if like is not None:

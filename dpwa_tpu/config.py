@@ -16,6 +16,7 @@ Schema::
       - {name: node1, host: 127.0.0.1, port: 45001}
     protocol:
       schedule: ring            # ring | random | hierarchical
+      mode: pairwise            # pairwise (mutual merge) | pull (one-sided)
       fetch_probability: 1.0    # per-step chance a pair actually exchanges
       timeout_ms: 500           # TCP transport only: fetch timeout
       seed: 0                   # schedule / participation RNG seed
@@ -47,6 +48,7 @@ class NodeSpec:
 @dataclasses.dataclass(frozen=True)
 class ProtocolConfig:
     schedule: str = "ring"
+    mode: str = "pairwise"  # pairwise (mutual merge) | pull (one-sided)
     fetch_probability: float = 1.0
     timeout_ms: int = 500
     seed: int = 0
@@ -66,6 +68,8 @@ class ProtocolConfig:
             )
         if self.schedule not in ("ring", "random", "hierarchical"):
             raise ValueError(f"unknown schedule {self.schedule!r}")
+        if self.mode not in ("pairwise", "pull"):
+            raise ValueError(f"unknown protocol mode {self.mode!r}")
 
 
 @dataclasses.dataclass(frozen=True)

@@ -8,6 +8,7 @@ JSONL file — greppable and plottable without parsing prose."""
 
 from __future__ import annotations
 
+import atexit
 import json
 import sys
 import time
@@ -29,7 +30,18 @@ def _jsonable(v: Any) -> Any:
 
 
 class MetricsLogger:
-    """Writes one JSON object per record; stdlib-only, no deps."""
+    """Writes one JSON object per record; stdlib-only, no deps.
+
+    CONTRACT: :meth:`log_exchange` is *deferred* — it holds each record
+    until the next logging point, so the final record of a run is only
+    written by :meth:`flush` / :meth:`close`.  Call :meth:`close` when
+    done, or use the logger as a context manager.  As a safety net an
+    ``atexit`` flush is registered, so a forgotten close loses nothing on
+    a clean interpreter exit — but records written that late appear after
+    anything else the process printed.  Interleaving direct :meth:`log`
+    calls between deferred :meth:`log_exchange` calls can emit lines out
+    of step order (the deferred record carries its original ``step``/``t``
+    stamps); call :meth:`flush` first if strict file order matters."""
 
     def __init__(
         self,
@@ -42,6 +54,13 @@ class MetricsLogger:
         self.every = max(1, every)
         self._t0 = time.perf_counter()
         self._pending = None
+        self._atexit = atexit.register(self.flush)
+
+    def __enter__(self) -> "MetricsLogger":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
 
     def log(self, step: int, _t: Optional[float] = None, **fields: Any) -> None:
         if step % self.every != 0:
@@ -127,5 +146,7 @@ class MetricsLogger:
 
     def close(self) -> None:
         self.flush()
+        atexit.unregister(self.flush)
         if self._file is not None:
             self._file.close()
+            self._file = None

@@ -229,13 +229,26 @@ def _pair_merge_impl(
         and d % (lanes * sublanes) == 0
         and (was_2d or (x.ndim == 3 and x.shape[2] == lanes))
     )
+    # Pad self-pairs (L == R) must be exact no-ops ON EVERY PATH.
+    # (1−a)·x + a·x is NOT bitwise x in floating point for a ∉ {0, 1}, so
+    # force a = 0 there: 1.0·x + 0.0·x IS exact, keeping sat-out rows
+    # bit-identical (the α=0 self-merge semantics the transports
+    # guarantee).  Hoisted above the fallback branch so the tiled kernel
+    # and the scatter fallback agree.
+    noop = left == right
+    a_left = jnp.where(noop, 0.0, alpha[left]).astype(jnp.float32)
+    a_right = jnp.where(noop, 0.0, alpha[right]).astype(jnp.float32)
+
     if not tiled_ok:
         # Shapes the tiled kernel can't take: scatter-form XLA fallback.
+        # Repeated pad rows put duplicate indices into `.at[].set`; with
+        # the forced a = 0 every duplicate writes the identical pre-merge
+        # value, so the unspecified winner is harmless.
         if n_pairs == 0:
             return x
         bshape = (-1,) + (1,) * (x.ndim - 1)
-        a_l = alpha[left].reshape(bshape).astype(x.dtype)
-        a_r = alpha[right].reshape(bshape).astype(x.dtype)
+        a_l = a_left.reshape(bshape).astype(x.dtype)
+        a_r = a_right.reshape(bshape).astype(x.dtype)
         x_l, x_r = x[left], x[right]
         x = x.at[left].set((1 - a_l) * x_l + a_l * x_r)
         return x.at[right].set((1 - a_r) * x_r + a_r * x_l)
@@ -311,18 +324,8 @@ def _pair_merge_impl(
             for dma in out_dma(c, c % n_buf):
                 dma.wait()
 
-    # Pad self-pairs (L == R) must be exact no-ops.  (1−a)·x + a·x is NOT
-    # bitwise x in floating point for a ∉ {0, 1}, so force a = 0 there:
-    # 1.0·x + 0.0·x IS exact, keeping sat-out rows bit-identical (the α=0
-    # self-merge semantics the transports guarantee).
-    noop = left == right
-    a_pairs = jnp.stack(
-        [
-            jnp.where(noop, 0.0, alpha[left]),
-            jnp.where(noop, 0.0, alpha[right]),
-        ],
-        axis=1,
-    ).reshape(-1).astype(jnp.float32)
+    # Interleave the (already pad-masked) per-pair alphas for the kernel.
+    a_pairs = jnp.stack([a_left, a_right], axis=1).reshape(-1)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,

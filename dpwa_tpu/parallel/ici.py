@@ -49,7 +49,11 @@ class ExchangeInfo(NamedTuple):
 
 
 def _perm_pairs(perm) -> Tuple[Tuple[int, int], ...]:
-    """ppermute (source, dest) pairs so device i receives from perm[i]."""
+    """ppermute (source, dest) pairs so device i receives from perm[i].
+
+    Valid for pairwise involutions AND one-sided pull maps: ``ppermute``
+    only requires each *destination* to appear once; a popular source may
+    feed several pullers."""
     return tuple((int(perm[i]), int(i)) for i in range(len(perm)))
 
 
@@ -70,7 +74,7 @@ def gossip_exchange_local(
     """
     me = lax.axis_index(axis_name)
     pool = jnp.asarray(schedule.pool)  # [K, n] baked-in constant
-    branch = jnp.mod(jnp.asarray(step, jnp.int32), schedule.pool_size)
+    branch = schedule.branch_traced(step)
     partner = pool[branch, me]
 
     def make_branch(perm):
@@ -89,7 +93,10 @@ def gossip_exchange_local(
         (params, meta),
     )
 
-    pair_id = jnp.minimum(me, partner)
+    # Pull mode: the pull is one-sided, so the puller draws alone (the
+    # reference's per-process fetch decision); pairwise: both members of a
+    # pair share one draw keyed on min(i, partner).
+    pair_id = me if schedule.mode == "pull" else jnp.minimum(me, partner)
     if schedule.fetch_probability >= 1.0:
         drawn = jnp.bool_(True)
     else:

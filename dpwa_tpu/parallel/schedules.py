@@ -68,6 +68,23 @@ def fault_draw(seed, step, pair_id, drop_probability):
     return jax.random.uniform(_pair_key(seed, step, pair_id, 1)) < drop_probability
 
 
+def pool_branch_draw(seed, step, pool_size: int, periodic: bool):
+    """Pool index in effect at ``step`` — traced or host, same stream.
+
+    Deterministic schedules (ring phases, the hierarchical period) cycle:
+    ``step % pool_size`` — the period IS the design.  The ``random``
+    schedule must not: cycling a pool of K matchings gives the pairing
+    sequence period K, a correlation artifact the reference (fresh draws
+    every step) does not have.  Its pool entry is therefore drawn i.i.d.
+    per step from an independent threefry stream (tag 2) shared by the
+    host (TCP) and in-jit (ICI/stacked) paths, so lock-step parity holds
+    while the pairing sequence is aperiodic."""
+    step = jnp.asarray(step, jnp.int32)
+    if periodic or pool_size <= 1:
+        return jnp.mod(step, pool_size)
+    return jax.random.randint(_pair_key(seed, step, 0, 2), (), 0, pool_size)
+
+
 def is_involution(perm: np.ndarray) -> bool:
     """True iff perm is a valid pairing: perm[perm[i]] == i for all i."""
     idx = np.arange(len(perm))
@@ -106,6 +123,57 @@ def _random_matching(n: int, rng: np.random.Generator) -> np.ndarray:
         a, b = order[i], order[i + 1]
         perm[a], perm[b] = b, a
     return perm
+
+
+def _ring_pull(n: int, phase: int) -> np.ndarray:
+    """Directed ring pull map: peer i pulls from its ±1 neighbor."""
+    return (np.arange(n) + (1 if phase % 2 == 0 else -1)) % n
+
+
+def _random_pull(n: int, rng: np.random.Generator) -> np.ndarray:
+    """Random pull map: every peer pulls a distinct source != itself.
+
+    Sattolo's algorithm — a uniform random *cyclic* permutation, so
+    ``src[i]`` is uniform over the other peers (the reference's per-process
+    random pick) while sources stay distinct.  The distinctness matters on
+    the fabric: ``lax.ppermute`` carries one send per source per round, so
+    a popular source cannot multicast; a derangement is the reference's
+    iid pull conditioned on collision-freeness (same marginals).  True
+    collisions still occur on the TCP transport under free-running
+    processes, where the Rx thread naturally serves any number of
+    fetchers."""
+    src = np.arange(n)
+    for i in range(n - 1, 0, -1):
+        j = rng.integers(0, i)
+        src[i], src[j] = src[j], src[i]
+    return src
+
+
+def _hierarchical_pull_pool(
+    n: int, group_size: int, inter_period: int
+) -> np.ndarray:
+    """Pull-mode two-level pool: directed intra-group ring rotations, with
+    every ``inter_period``-th slot pulling from the same index in the next
+    group (groups in a directed ring)."""
+    if n % group_size != 0:
+        raise ValueError(f"n_peers {n} not divisible by group_size {group_size}")
+    n_groups = n // group_size
+    pool = []
+    for slot in range(inter_period):
+        if slot == inter_period - 1 and n_groups > 1:
+            src = np.arange(n)
+            for g in range(n_groups):
+                pg = (g + 1) % n_groups
+                src[g * group_size : (g + 1) * group_size] = (
+                    np.arange(group_size) + pg * group_size
+                )
+            pool.append(src)
+        else:
+            base = _ring_pull(group_size, slot)
+            pool.append(
+                np.concatenate([base + g * group_size for g in range(n_groups)])
+            )
+    return np.stack(pool)
 
 
 def _hierarchical_pool(
@@ -164,19 +232,40 @@ class Schedule:
     seed: int
     name: str
     drop_probability: float = 0.0
+    mode: str = "pairwise"  # pairwise (involutions) | pull (one-sided maps)
 
     @property
     def pool_size(self) -> int:
         return len(self.pool)
 
+    @property
+    def periodic(self) -> bool:
+        """Whether pool selection cycles (ring/hierarchical) or is drawn
+        per step (random — see :func:`pool_branch_draw`)."""
+        return self.name != "random"
+
+    def branch_traced(self, step):
+        """Pool index at ``step`` as a traced int32 (the jit-path form)."""
+        return pool_branch_draw(self.seed, step, self.pool_size, self.periodic)
+
     def branch(self, step: int) -> int:
-        """Host-side pool index for ``step`` (the jit path computes the same
-        thing as ``step % pool_size`` on-device)."""
-        return int(step) % self.pool_size
+        """Host-side pool index for ``step`` — same stream as the jit path."""
+        if self.periodic or self.pool_size <= 1:
+            return int(step) % self.pool_size
+        return int(self.branch_traced(step))
+
+    def pair_id(self, i: int, partner: int):
+        """The RNG key a peer's participation/fault draws are folded on.
+
+        Pairwise mode: ``min(i, partner)`` — both members of a pair share
+        one draw, so the exchange is all-or-nothing.  Pull mode: ``i`` —
+        the pull is one-sided, so the puller draws alone (the reference's
+        per-process independent fetch decision, SURVEY.md §3.2)."""
+        return i if self.mode == "pull" else min(i, partner)
 
     def pairing(self, step: int) -> np.ndarray:
-        """The pairing permutation in effect at ``step`` (host-side view,
-        used by the TCP transport and by tests)."""
+        """The pairing permutation (pairwise) or pull map (pull) in effect
+        at ``step`` (host-side view, used by the TCP transport and tests)."""
         return self.pool[self.branch(step)]
 
     def partner(self, step: int, i: int) -> int:
@@ -188,7 +277,7 @@ class Schedule:
         p = self.partner(step, i)
         if p == i:
             return False
-        pair_id = min(i, p)
+        pair_id = self.pair_id(i, p)
         ok = self.fetch_probability >= 1.0 or bool(
             participation_draw(
                 self.seed, step, pair_id, self.fetch_probability
@@ -202,11 +291,28 @@ class Schedule:
 
 
 def build_schedule(config: DpwaConfig) -> Schedule:
-    """Materialize the pairing pool described by ``config.protocol``."""
+    """Materialize the pairing/pull pool described by ``config.protocol``."""
     proto = config.protocol
     n = config.n_peers
+    pull = proto.mode == "pull"
     if n == 1:
         pool = np.zeros((1, 1), dtype=np.int64)
+    elif pull:
+        # One-sided pull maps: arbitrary src[i], no involution constraint
+        # (the reference's RumorProtocol behavior — each process
+        # independently pulls a peer; SURVEY.md §3.2).
+        if proto.schedule == "ring":
+            pool = np.stack([_ring_pull(n, 0), _ring_pull(n, 1)])
+        elif proto.schedule == "random":
+            rng = np.random.default_rng(proto.seed)
+            pool = np.stack(
+                [_random_pull(n, rng) for _ in range(max(1, proto.pool_size))]
+            )
+        elif proto.schedule == "hierarchical":
+            group = proto.group_size or _auto_group_size(n)
+            pool = _hierarchical_pull_pool(n, group, max(2, proto.inter_period))
+        else:  # pragma: no cover - config validates earlier
+            raise ValueError(proto.schedule)
     elif proto.schedule == "ring":
         pool = np.stack([_ring_even(n), _ring_odd(n)])
     elif proto.schedule == "random":
@@ -221,7 +327,14 @@ def build_schedule(config: DpwaConfig) -> Schedule:
         raise ValueError(proto.schedule)
     pool = pool.astype(np.int32)
     for k, perm in enumerate(pool):
-        if not is_involution(perm):
+        if pull:
+            # Pull maps must be permutations (ppermute: unique sources AND
+            # destinations) with no self-pulls beyond the n == 1 corner.
+            if sorted(perm) != list(range(n)):
+                raise AssertionError(f"pull map not a permutation at slot {k}")
+            if n > 1 and np.any(perm == np.arange(n)):
+                raise AssertionError(f"pull map has self-pull at slot {k}")
+        elif not is_involution(perm):
             raise AssertionError(f"schedule produced non-involution at slot {k}")
     return Schedule(
         pool=pool,
@@ -230,6 +343,7 @@ def build_schedule(config: DpwaConfig) -> Schedule:
         seed=proto.seed,
         name=proto.schedule,
         drop_probability=proto.drop_probability,
+        mode=proto.mode,
     )
 
 

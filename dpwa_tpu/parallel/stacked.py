@@ -48,18 +48,21 @@ def stacked_gossip_exchange(
 
     The batched twin of
     :func:`dpwa_tpu.parallel.ici.gossip_exchange_local`: identical pool
-    selection (``step % pool_size``), identical per-pair threefry draws,
-    identical α math — the partner's replica arrives by leading-axis gather
-    (``x[partner]``, fused by XLA into the merge) instead of ``ppermute``.
+    selection (:meth:`Schedule.branch_traced` — cyclic for ring/
+    hierarchical, per-step threefry draw for random), identical per-pair
+    threefry draws, identical α math — the partner's replica arrives by
+    leading-axis gather (``x[partner]``, fused by XLA into the merge)
+    instead of ``ppermute``.
     """
     n = schedule.n_peers
     me = jnp.arange(n)
     pool = jnp.asarray(schedule.pool)  # [K, n] baked-in constant
-    branch = jnp.mod(jnp.asarray(step, jnp.int32), schedule.pool_size)
+    branch = schedule.branch_traced(step)
     partner = pool[branch]  # [n]
 
     remote_meta = jax.tree.map(lambda v: v[partner], meta)
-    pair_id = jnp.minimum(me, partner)
+    # Pull mode: one-sided, puller draws alone; pairwise: shared pair draw.
+    pair_id = me if schedule.mode == "pull" else jnp.minimum(me, partner)
     if schedule.fetch_probability >= 1.0:
         drawn = jnp.ones(n, jnp.bool_)
     else:

@@ -96,7 +96,13 @@ class PeerServer:
             self._payload = header + data
 
     def _serve(self) -> None:
-        self._sock.settimeout(0.2)
+        try:
+            # close() may already have closed the listener before this
+            # thread got scheduled; EBADF here is a clean shutdown, not an
+            # error to surface.
+            self._sock.settimeout(0.2)
+        except OSError:
+            return
         while not self._stop.is_set():
             try:
                 conn, _ = self._sock.accept()

@@ -55,7 +55,21 @@ def repoint_to_host_mesh(n: int):
 
 
 def ensure_devices(n: int, mode: str = "auto"):
-    """Return a list of ≥n jax devices, forcing a CPU mesh if allowed."""
+    """Return a list of ≥n jax devices, forcing a CPU mesh if allowed.
+
+    ``auto``-mode flag precedence: a ``--xla_force_host_platform_device_count``
+    flag in ``XLA_FLAGS`` ALWAYS wins when no backend is initialized yet —
+    the run goes to the emulated CPU mesh even on a host whose accelerator
+    plugin could have supplied ≥n real devices.  Rationale: probing the
+    accelerator to find out would initialize it irreversibly, and a
+    site-registered plugin can block indefinitely at init (the dev box's
+    tunneled chip does); the flag is taken as explicit host-mesh intent.
+    Accelerator users must not set the flag, or should pass
+    ``mode='native'``.
+
+    (Uses the private ``jax._src.xla_bridge.backends_are_initialized`` —
+    there is no public "is a backend up yet?" probe; every public API would
+    trigger the initialization this function exists to avoid.)"""
     import jax
     from jax._src import xla_bridge as xb
 

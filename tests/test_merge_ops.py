@@ -117,6 +117,24 @@ def test_pair_merge_pad_rows_bit_identical_despite_alpha():
     np.testing.assert_array_equal(got[3], np.asarray(x)[3])
 
 
+def test_pair_merge_pad_rows_bit_identical_on_fallback_shape():
+    # Same no-op guarantee on a shape the tiled kernel can't take (the
+    # scatter-form XLA fallback): the alpha-zeroing for L==R pads is
+    # hoisted above the fallback branch, and a pad row REPEATED in the
+    # lists (duplicate scatter indices) must still come back bitwise.
+    x, _, _ = _case(n=4, d=1000)  # not a multiple of 1024 -> fallback
+    alpha = jnp.full((4,), 0.7, jnp.float32)
+    left = jnp.asarray([0, 2, 2], jnp.int32)
+    right = jnp.asarray([1, 2, 2], jnp.int32)  # (0,1) real; (2,2) pad x2
+    got = np.asarray(pallas_pair_merge(x.copy(), left, right, alpha))
+    np.testing.assert_array_equal(got[2], np.asarray(x)[2])
+    np.testing.assert_array_equal(got[3], np.asarray(x)[3])
+    want01 = np.asarray(
+        xla_pairwise_merge(x, jnp.asarray([1, 0, 2, 3]), alpha)
+    )
+    np.testing.assert_allclose(got[:2], want01[:2], rtol=1e-6, atol=1e-7)
+
+
 def test_pair_merge_odd_shape_falls_back():
     x, partner, alpha = _case(d=1000)  # not a multiple of 1024
     want = np.asarray(xla_pairwise_merge(x, partner, alpha))

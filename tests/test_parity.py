@@ -78,6 +78,30 @@ def test_tcp_ici_parity(schedule, interpolation):
     np.testing.assert_allclose(tcp_out, ici_out, rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.parametrize("schedule", ["ring", "random"])
+def test_tcp_ici_parity_pull_mode(schedule):
+    # One-sided pull gossip (the reference's RumorProtocol behavior): the
+    # pull map is not an involution, the puller merges alone, and the TCP
+    # and ICI paths must still agree in lock-step.
+    n, d, steps = 4, 257, 6
+    cfg = make_local_config(
+        n,
+        base_port=0,
+        schedule=schedule,
+        mode="pull",
+        fetch_probability=0.6,
+        seed=17,
+        pool_size=4,
+    )
+    rng = np.random.default_rng(2)
+    vecs = [rng.standard_normal(d).astype(np.float32) for _ in range(n)]
+    clocks = [float(i + 1) for i in range(n)]
+    losses = [0.5 + 0.1 * i for i in range(n)]
+    tcp_out = run_tcp(cfg, vecs, clocks, losses, steps)
+    ici_out = run_ici(cfg, vecs, clocks, losses, steps)
+    np.testing.assert_allclose(tcp_out, ici_out, rtol=1e-5, atol=1e-6)
+
+
 def test_tcp_ici_parity_with_participation_mask():
     n, d, steps = 4, 64, 8
     cfg = make_local_config(

@@ -109,3 +109,64 @@ def test_single_peer_schedule():
     sched = build_schedule(make_local_config(1))
     assert sched.pairing(0)[0] == 0
     assert not sched.participates(0, 0)
+
+
+def test_random_branch_is_aperiodic_and_deterministic():
+    # The random schedule's pool entry is a per-step threefry draw, not
+    # step % pool_size cycling: the pairing sequence must not have period
+    # pool_size (the reference draws fresh pairings forever).
+    cfg = make_local_config(8, schedule="random", pool_size=8, seed=3)
+    a = build_schedule(cfg)
+    seq = [a.branch(s) for s in range(64)]
+    assert seq == [build_schedule(cfg).branch(s) for s in range(64)]
+    assert seq != [s % 8 for s in range(64)]
+    assert seq[:8] != seq[8:16] or seq[8:16] != seq[16:24]
+    assert all(0 <= b < 8 for b in seq)
+    # Traced and host paths agree (lock-step TCP/ICI parity depends on it).
+    assert [int(a.branch_traced(s)) for s in range(16)] == seq[:16]
+    # Deterministic cyclic schedules are untouched.
+    ring = build_schedule(make_local_config(8, schedule="ring"))
+    assert [ring.branch(s) for s in range(6)] == [0, 1, 0, 1, 0, 1]
+
+
+@pytest.mark.parametrize("schedule", ["ring", "random", "hierarchical"])
+def test_pull_maps_are_valid_sources(schedule):
+    cfg = make_local_config(8, schedule=schedule, mode="pull", group_size=4)
+    sched = build_schedule(cfg)
+    assert sched.mode == "pull"
+    for src in sched.pool:
+        assert np.all(src >= 0) and np.all(src < 8)
+        assert np.all(src != np.arange(8))  # nobody pulls from itself
+
+
+def test_ring_pull_is_directed_rotation():
+    sched = build_schedule(make_local_config(6, schedule="ring", mode="pull"))
+    np.testing.assert_array_equal(sched.pairing(0), (np.arange(6) + 1) % 6)
+    np.testing.assert_array_equal(sched.pairing(1), (np.arange(6) - 1) % 6)
+
+
+def test_pull_participation_is_one_sided():
+    # In pull mode each peer draws participation alone: find a step where
+    # a puller participates while the peer it pulls from does not.
+    cfg = make_local_config(
+        8, schedule="random", mode="pull", fetch_probability=0.5, seed=7
+    )
+    sched = build_schedule(cfg)
+    asymmetric = False
+    for step in range(30):
+        for i in range(8):
+            j = sched.partner(step, i)
+            if sched.participates(step, i) != sched.participates(step, j):
+                asymmetric = True
+    assert asymmetric
+
+
+def test_hierarchical_pull_structure():
+    cfg = make_local_config(
+        16, schedule="hierarchical", mode="pull", group_size=4, inter_period=4
+    )
+    sched = build_schedule(cfg)
+    groups = np.arange(16) // 4
+    for slot in range(3):
+        assert np.all(groups[sched.pool[slot]] == groups)  # intra-group
+    assert np.all(groups[sched.pool[3]] != groups)  # inter-group slot

@@ -53,6 +53,9 @@ def stacked_meta(n, clocks=None, losses=None):
         dict(schedule="ring", interpolation="clock"),
         dict(schedule="ring", interpolation="loss"),
         dict(schedule="ring", drop_probability=0.4, seed=5),
+        dict(schedule="ring", mode="pull"),
+        dict(schedule="random", mode="pull", pool_size=4,
+             fetch_probability=0.6, seed=9),
     ],
 )
 def test_exchange_parity_with_ici(cfg_kwargs):
@@ -246,6 +249,35 @@ def test_stacked_checkpoint_roundtrip_and_cross_layout_resume(tmp_path):
             rtol=1e-5,
             atol=1e-7,
         )
+
+
+def test_restore_without_like_returns_gossip_class_rewrappable(tmp_path):
+    # Documented corner of the cross-layout contract: without ``like`` the
+    # file records no layout, so restore returns a GossipTrainState even
+    # for a stacked save — with identical field VALUES, so rewrapping
+    # recovers the stacked class losslessly.
+    from dpwa_tpu.checkpoint import restore_checkpoint, save_checkpoint
+    from dpwa_tpu.parallel.stacked import StackedTrainState
+    from dpwa_tpu.train import GossipTrainState
+
+    n = 4
+    cfg = make_local_config(n, schedule="ring")
+    stk = StackedTransport(cfg)
+    opt = optax.sgd(1e-2)
+    state = init_stacked_state(stack_params(_mlp_init(jax.random.key(5)), n), opt, stk)
+    ckpt = str(tmp_path / "ckpt")
+    save_checkpoint(ckpt, state)
+    restored = restore_checkpoint(ckpt)
+    assert isinstance(restored, GossipTrainState)
+    rewrapped = StackedTrainState(**restored._asdict())
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)
+        ),
+        state.params,
+        rewrapped.params,
+    )
+    assert int(rewrapped.step) == int(state.step)
 
 
 def test_stacked_exchange_filter_keeps_rest_frozen():

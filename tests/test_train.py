@@ -92,6 +92,48 @@ def test_digits_convergence_smoke():
     assert accs.mean() > 0.9, accs
 
 
+def test_pull_mode_convergence_matches_pairwise():
+    """One-sided pull gossip (the reference's RumorProtocol) must reach the
+    same consensus quality as pairwise averaging on the same task/seeds."""
+    n = 8
+    import flax.linen as nn
+
+    class MLP(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            x = nn.Dense(32)(x)
+            x = nn.relu(x)
+            return nn.Dense(4)(x)
+
+    model = MLP()
+    x, y = gaussian_blobs(n_classes=4, dim=16, n_per_class=128)
+    init = lambda k: model.init(k, jnp.zeros((1, 16)))
+
+    def run(mode):
+        cfg = make_local_config(
+            n, schedule="random", mode=mode, pool_size=8, seed=2
+        )
+        transport = IciTransport(cfg, mesh=make_mesh(cfg))
+        stacked = init_params_per_peer(init, jax.random.key(0), n)
+        state = init_gossip_state(stacked, optax.adam(1e-2), transport)
+        step_fn = make_gossip_train_step(
+            _mlp_loss(model.apply), optax.adam(1e-2), transport
+        )
+        batches = peer_batches(x, y, n, batch_size=32)
+        for _ in range(60):
+            state, _, _ = step_fn(state, next(batches))
+        eval_fn = make_gossip_eval_fn(model.apply, transport)
+        return np.asarray(
+            eval_fn(state.params, jnp.asarray(x), jnp.asarray(y))
+        )
+
+    acc_pull = run("pull")
+    acc_pair = run("pairwise")
+    assert acc_pull.mean() > 0.95, acc_pull
+    assert acc_pull.min() > 0.9, acc_pull  # consensus, not divergence
+    assert abs(acc_pull.mean() - acc_pair.mean()) < 0.05
+
+
 def test_gossip_beats_isolated_training():
     """The point of dpwa: peers that gossip see (statistically) the whole
     data distribution even though each trains on a biased shard."""
