@@ -1,0 +1,107 @@
+"""Shared example-launcher plumbing: transport + device policy selection.
+
+Every example (the reference keeps one per benchmark config,
+``examples/mnist`` etc. — SURVEY.md §2) exposes the same two knobs:
+
+- ``--transport ici|stacked`` — ``ici`` runs one SPMD process over a device
+  mesh (one device per peer, the real multi-chip layout); ``stacked`` runs
+  every peer on ONE device as a stacked leading axis (the single-chip
+  benchmarking mode, SURVEY.md §7 note: the dev box has one chip).
+- ``--devices auto|cpu|native`` — device policy.  For ``ici``: ``native``
+  requires a real accelerator mesh, ``cpu`` forces the emulated host mesh,
+  ``auto`` picks.  For ``stacked``: ``auto`` keeps jax's default device
+  (the real chip when present), ``cpu`` forces the CPU backend, ``native``
+  errors rather than silently reporting a CPU fallback's steps/sec as a
+  single-chip number.
+
+:func:`build_transport` returns the transport plus the matching
+state-init / train-step constructors, so an example's training loop is
+identical across transports.
+"""
+
+from __future__ import annotations
+
+import argparse
+from typing import NamedTuple, Optional
+
+
+def add_transport_args(ap: argparse.ArgumentParser) -> None:
+    ap.add_argument(
+        "--transport", choices=("ici", "stacked"), default="ici",
+        help="'ici': SPMD over a device mesh (one device per peer); "
+        "'stacked': all peers on ONE device as a stacked axis — the "
+        "single-chip benchmarking mode",
+    )
+    ap.add_argument(
+        "--devices", default="auto", choices=("auto", "cpu", "native"),
+        help="device policy; see dpwa_tpu.utils.launch",
+    )
+
+
+class TransportBundle(NamedTuple):
+    transport: object
+    init_state: object  # (stacked_params, opt, transport, ...) -> state
+    make_step: object  # (loss_fn, opt, transport, ...) -> step_fn
+    eval_transport: Optional[object]  # None => single-device eval
+    batch_sharding: Optional[object]  # peer sharding for staged batches
+
+
+def apply_device_policy(cfg, transport: str, devices: str) -> None:
+    """Enforce the ``--devices`` policy BEFORE jax initializes a backend."""
+    from dpwa_tpu.utils.devices import ensure_devices
+
+    if transport == "ici":
+        ensure_devices(cfg.n_peers, mode=devices)
+        return
+    # Stacked needs one device and should keep jax's native pick (the
+    # real chip) — ensure_devices' auto mode would force the emulated
+    # CPU mesh, which is for multi-device ICI runs.  The policy still
+    # applies: 'cpu' forces CPU, 'native' must not silently report a
+    # CPU fallback's steps/sec as a single-chip number.
+    if devices == "cpu":
+        ensure_devices(1, mode="cpu")
+    elif devices == "native":
+        import jax
+
+        if jax.devices()[0].platform == "cpu":
+            raise RuntimeError(
+                "--devices native: no accelerator available (jax picked "
+                "cpu); drop --devices or use --devices cpu explicitly"
+            )
+
+
+def build_transport(cfg, transport: str = "ici", devices: str = "auto"):
+    """Select + construct the transport; returns a :class:`TransportBundle`.
+
+    Call before creating any arrays: the device policy may decide the JAX
+    platform, which is frozen at first backend use."""
+    apply_device_policy(cfg, transport, devices)
+    if transport == "stacked":
+        from dpwa_tpu.parallel.stacked import (
+            StackedTransport,
+            init_stacked_state,
+            make_stacked_train_step,
+        )
+
+        return TransportBundle(
+            transport=StackedTransport(cfg),
+            init_state=init_stacked_state,
+            make_step=make_stacked_train_step,
+            eval_transport=None,
+            batch_sharding=None,
+        )
+    from dpwa_tpu.parallel.ici import IciTransport
+    from dpwa_tpu.parallel.mesh import make_mesh, peer_sharding
+    from dpwa_tpu.train import init_gossip_state, make_gossip_train_step
+
+    t = IciTransport(cfg, mesh=make_mesh(cfg))
+    # Stage batches peer-sharded for the mesh path (a whole batch committed
+    # to one device would be resharded inside the jitted shard_map, which
+    # the thread-starved forced-CPU mesh cannot always service).
+    return TransportBundle(
+        transport=t,
+        init_state=init_gossip_state,
+        make_step=make_gossip_train_step,
+        eval_transport=t,
+        batch_sharding=peer_sharding(t.mesh),
+    )

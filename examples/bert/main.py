@@ -35,13 +35,12 @@ def main() -> None:
     ap.add_argument("--lr", type=float, default=1e-4)
     ap.add_argument("--tiny", action="store_true", help="tiny BERT (tests)")
     ap.add_argument("--log-every", type=int, default=20)
-    ap.add_argument(
-        "--devices", default="auto", choices=("auto", "cpu", "native")
-    )
+    from dpwa_tpu.utils.launch import add_transport_args, build_transport
+
+    add_transport_args(ap)
     args = ap.parse_args()
 
     from dpwa_tpu.config import make_local_config
-    from dpwa_tpu.utils.devices import ensure_devices
 
     cfg = make_local_config(
         args.peers,
@@ -49,7 +48,8 @@ def main() -> None:
         group_size=args.group_size,
         inter_period=args.inter_period,
     )
-    ensure_devices(cfg.n_peers, mode=args.devices)
+    bundle = build_transport(cfg, args.transport, args.devices)
+    transport = bundle.transport
 
     import jax
     import jax.numpy as jnp
@@ -63,24 +63,17 @@ def main() -> None:
         mlm_loss_fn,
         mlm_mask_batch,
     )
-    from dpwa_tpu.parallel.ici import IciTransport
-    from dpwa_tpu.parallel.mesh import make_mesh
-    from dpwa_tpu.train import (
-        init_gossip_state,
-        make_gossip_train_step,
-        stack_params,
-    )
+    from dpwa_tpu.train import stack_params
     from dpwa_tpu.utils.pytree import tree_size_bytes
 
     n = cfg.n_peers
-    transport = IciTransport(cfg, mesh=make_mesh(cfg))
     mcfg = bert_tiny_config() if args.tiny else bert_base_config()
     model = BertMLM(mcfg)
     tokens0 = jnp.zeros((1, args.seq_len), jnp.int32)
     stacked = stack_params(model.init(jax.random.key(0), tokens0), n)
     opt = optax.adamw(args.lr)
-    state = init_gossip_state(stacked, opt, transport)
-    step_fn = make_gossip_train_step(mlm_loss_fn(model), opt, transport)
+    state = bundle.init_state(stacked, opt, transport)
+    step_fn = bundle.make_step(mlm_loss_fn(model), opt, transport)
     payload = tree_size_bytes(jax.tree.map(lambda v: v[0], stacked))
     print(
         f"BERT {'tiny' if args.tiny else 'base'} x{n} peers "
@@ -112,7 +105,12 @@ def main() -> None:
         metrics.close()
     jax.block_until_ready(state.params)
     dt = time.perf_counter() - t0
-    print(f"steps/sec (all {n} peers, incl. exchange): {(args.steps-1)/dt:.3f}")
+    plat = jax.devices()[0].platform
+    ndev = 1 if args.transport == "stacked" else n
+    print(
+        f"steps/sec (all {n} peers, incl. exchange, on {plat} x{ndev}): "
+        f"{(args.steps-1)/dt:.3f}"
+    )
 
 
 if __name__ == "__main__":

@@ -83,23 +83,12 @@ def main() -> None:
     ap.add_argument("--lr", type=float, default=0.1)
     ap.add_argument("--log-every", type=int, default=50)
     ap.add_argument("--bf16", action="store_true", help="bfloat16 compute")
-    ap.add_argument(
-        "--transport", choices=("ici", "stacked"), default="ici",
-        help="'ici': SPMD over a device mesh (one device per peer); "
-        "'stacked': all peers on ONE device as a stacked axis — the "
-        "single-chip benchmarking mode",
-    )
-    ap.add_argument(
-        "--devices", default="auto", choices=("auto", "cpu", "native"),
-        help="ici: 'native' requires a real accelerator mesh, 'cpu' forces "
-        "an emulated host mesh, 'auto' picks.  stacked: 'native' errors "
-        "unless an accelerator is present, 'cpu' forces the CPU backend, "
-        "'auto' keeps jax's default device",
-    )
+    from dpwa_tpu.utils.launch import add_transport_args, build_transport
+
+    add_transport_args(ap)
     args = ap.parse_args()
 
     from dpwa_tpu.config import load_config
-    from dpwa_tpu.utils.devices import ensure_devices
 
     here = os.path.dirname(os.path.abspath(__file__))
     cfg_path = (
@@ -108,25 +97,7 @@ def main() -> None:
         else os.path.join(here, args.config)
     )
     cfg = load_config(cfg_path)
-    if args.transport == "ici":
-        ensure_devices(cfg.n_peers, mode=args.devices)
-    else:
-        # Stacked needs one device and should keep jax's native pick (the
-        # real chip) — ensure_devices' auto mode would force the emulated
-        # CPU mesh, which is for multi-device ICI runs.  The policy still
-        # applies: 'cpu' forces CPU, 'native' must not silently report a
-        # CPU fallback's steps/sec as a single-chip number.
-        if args.devices == "cpu":
-            ensure_devices(1, mode="cpu")
-        elif args.devices == "native":
-            import jax
-
-            if jax.devices()[0].platform == "cpu":
-                raise RuntimeError(
-                    "--devices native: no accelerator available (jax "
-                    "picked cpu); drop --devices or use --devices cpu "
-                    "explicitly"
-                )
+    bundle = build_transport(cfg, args.transport, args.devices)
 
     import jax
     import jax.numpy as jnp
@@ -156,32 +127,10 @@ def main() -> None:
         dataset = "synthetic-cifar-shaped"
 
     n = cfg.n_peers
-    if args.transport == "stacked":
-        from dpwa_tpu.parallel.stacked import (
-            StackedTransport,
-            init_stacked_state,
-            make_stacked_train_step,
-        )
-
-        transport = StackedTransport(cfg)
-        init_state, make_step = init_stacked_state, make_stacked_train_step
-        eval_transport = None
-    else:
-        from dpwa_tpu.parallel.ici import IciTransport
-        from dpwa_tpu.parallel.mesh import make_mesh
-        from dpwa_tpu.train import init_gossip_state, make_gossip_train_step
-
-        transport = IciTransport(cfg, mesh=make_mesh(cfg))
-        init_state, make_step = init_gossip_state, make_gossip_train_step
-        eval_transport = transport
-    # Stage batches peer-sharded for the mesh path (a whole batch committed
-    # to one device would be resharded inside the jitted shard_map, which
-    # the thread-starved forced-CPU mesh cannot always service).
-    batch_sharding = None
-    if args.transport == "ici":
-        from dpwa_tpu.parallel.mesh import peer_sharding
-
-        batch_sharding = peer_sharding(transport.mesh)
+    transport = bundle.transport
+    init_state, make_step = bundle.init_state, bundle.make_step
+    eval_transport = bundle.eval_transport
+    batch_sharding = bundle.batch_sharding
     model = ResNet20(dtype=jnp.bfloat16 if args.bf16 else jnp.float32)
     init = lambda k: model.init(k, jnp.zeros((1, 32, 32, 3)))
     stacked = init_params_per_peer(init, jax.random.key(0), n)

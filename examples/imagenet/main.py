@@ -36,20 +36,20 @@ def main() -> None:
     ap.add_argument("--bf16", action="store_true")
     ap.add_argument("--synthetic", action="store_true")
     ap.add_argument("--log-every", type=int, default=20)
-    ap.add_argument(
-        "--devices", default="auto", choices=("auto", "cpu", "native")
-    )
+    from dpwa_tpu.utils.launch import add_transport_args, build_transport
+
+    add_transport_args(ap)
     args = ap.parse_args()
 
     from dpwa_tpu.config import load_config, make_local_config
-    from dpwa_tpu.utils.devices import ensure_devices
 
     if args.config:
         cfg = load_config(args.config)
     else:
         # Programmatic equivalent of a 32-node YAML (same schema).
         cfg = make_local_config(args.peers, schedule="random", pool_size=32)
-    ensure_devices(cfg.n_peers, mode=args.devices)
+    bundle = build_transport(cfg, args.transport, args.devices)
+    transport = bundle.transport
 
     import jax
     import jax.numpy as jnp
@@ -57,30 +57,23 @@ def main() -> None:
 
     from dpwa_tpu.metrics import MetricsLogger
     from dpwa_tpu.models.resnet import ResNet50
-    from dpwa_tpu.parallel.ici import IciTransport
-    from dpwa_tpu.parallel.mesh import make_mesh
-    from dpwa_tpu.train import (
-        init_gossip_state,
-        init_params_per_peer,
-        make_gossip_train_step,
-    )
+    from dpwa_tpu.train import init_params_per_peer
     from dpwa_tpu.utils.pytree import tree_size_bytes
 
     n = cfg.n_peers
     S = args.image_size
-    transport = IciTransport(cfg, mesh=make_mesh(cfg))
     model = ResNet50(dtype=jnp.bfloat16 if args.bf16 else jnp.float32)
     init = lambda k: model.init(k, jnp.zeros((1, S, S, 3)))
     stacked = init_params_per_peer(init, jax.random.key(0), n)
     opt = optax.sgd(args.lr, momentum=0.9)
-    state = init_gossip_state(stacked, opt, transport)
+    state = bundle.init_state(stacked, opt, transport)
 
     def loss_fn(params, batch):
         x, y = batch
         logits = model.apply(params, x)
         return optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
 
-    step_fn = make_gossip_train_step(loss_fn, opt, transport)
+    step_fn = bundle.make_step(loss_fn, opt, transport)
     payload = tree_size_bytes(jax.tree.map(lambda v: v[0], stacked))
     print(
         f"ResNet-50 x{n} peers, payload {payload/1e6:.1f} MB/exchange, "
@@ -107,7 +100,12 @@ def main() -> None:
         metrics.close()
     jax.block_until_ready(state.params)
     dt = time.perf_counter() - t0
-    print(f"steps/sec (all {n} peers, incl. exchange): {(args.steps-1)/dt:.3f}")
+    plat = jax.devices()[0].platform
+    ndev = 1 if args.transport == "stacked" else n
+    print(
+        f"steps/sec (all {n} peers, incl. exchange, on {plat} x{ndev}): "
+        f"{(args.steps-1)/dt:.3f}"
+    )
 
 
 if __name__ == "__main__":

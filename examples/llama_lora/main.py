@@ -36,16 +36,16 @@ def main() -> None:
     ap.add_argument("--full-size", action="store_true",
                     help="real Llama-3-8B dims (needs real HBM)")
     ap.add_argument("--log-every", type=int, default=20)
-    ap.add_argument(
-        "--devices", default="auto", choices=("auto", "cpu", "native")
-    )
+    from dpwa_tpu.utils.launch import add_transport_args, build_transport
+
+    add_transport_args(ap)
     args = ap.parse_args()
 
     from dpwa_tpu.config import make_local_config
-    from dpwa_tpu.utils.devices import ensure_devices
 
     cfg = make_local_config(args.peers, schedule="random", pool_size=16)
-    ensure_devices(cfg.n_peers, mode=args.devices)
+    bundle = build_transport(cfg, args.transport, args.devices)
+    transport = bundle.transport
 
     import jax
     import jax.numpy as jnp
@@ -59,17 +59,10 @@ def main() -> None:
         lora_filter,
         lora_optimizer,
     )
-    from dpwa_tpu.parallel.ici import IciTransport
-    from dpwa_tpu.parallel.mesh import make_mesh
-    from dpwa_tpu.train import (
-        init_gossip_state,
-        init_params_per_peer,
-        make_gossip_train_step,
-    )
+    from dpwa_tpu.train import init_params_per_peer
     from dpwa_tpu.utils.pytree import partition, tree_size_bytes
 
     n = cfg.n_peers
-    transport = IciTransport(cfg, mesh=make_mesh(cfg))
     if args.full_size:
         mcfg = llama3_8b_config(lora_rank=args.lora_rank)
     else:
@@ -84,7 +77,7 @@ def main() -> None:
     opt = lora_optimizer(
         optax.adam(args.lr), jax.tree.map(lambda v: v[0], stacked)
     )
-    state = init_gossip_state(stacked, opt, transport)
+    state = bundle.init_state(stacked, opt, transport)
 
     def loss_fn(params, batch):
         tokens, targets = batch
@@ -93,7 +86,7 @@ def main() -> None:
             logits, targets
         ).mean()
 
-    step_fn = make_gossip_train_step(
+    step_fn = bundle.make_step(
         loss_fn, opt, transport, exchange_filter=lora_filter
     )
     one = jax.tree.map(lambda v: v[0], stacked)
@@ -135,7 +128,12 @@ def main() -> None:
         metrics.close()
     jax.block_until_ready(state.params)
     dt = time.perf_counter() - t0
-    print(f"steps/sec (all {n} peers, incl. exchange): {(args.steps-1)/dt:.3f}")
+    plat = jax.devices()[0].platform
+    ndev = 1 if args.transport == "stacked" else n
+    print(
+        f"steps/sec (all {n} peers, incl. exchange, on {plat} x{ndev}): "
+        f"{(args.steps-1)/dt:.3f}"
+    )
 
 
 if __name__ == "__main__":
