@@ -1,0 +1,109 @@
+"""Multi-process DCN smoke worker — launched by tests/test_distributed.py.
+
+Each of two OS processes owns 4 emulated CPU devices; ``jax.distributed``
+stitches them into one 8-device global mesh, exactly how a 2-host TPU pod
+launches (SURVEY.md §5 "Distributed communication backend": one process per
+host, ``jax.distributed`` + mesh axes spanning hosts).  The worker drives
+:class:`dpwa_tpu.parallel.distributed.DcnHierarchicalTransport` with REAL
+cross-process collectives: intra-group pool slots permute inside this
+process's contiguous device block (the ICI analogue), the inter-group slot
+crosses the process boundary (the DCN analogue, carried by gloo on CPU).
+
+Usage: ``python dcn_worker.py <process_id> <coordinator_port>``.
+Prints ``DCN_OK`` on success; ``DCN_SKIP: <reason>`` if distributed
+bring-up is unsupported in this environment.
+"""
+
+import os
+import sys
+
+
+def main() -> int:
+    pid, port = int(sys.argv[1]), int(sys.argv[2])
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from dpwa_tpu.parallel.distributed import (
+        DcnHierarchicalTransport,
+        hierarchical_config_for_hosts,
+        initialize_multihost,
+    )
+
+    try:
+        initialize_multihost(
+            coordinator_address=f"127.0.0.1:{port}",
+            num_processes=2,
+            process_id=pid,
+        )
+    except RuntimeError as e:  # pragma: no cover - environment-dependent
+        print(f"DCN_SKIP: {e}", flush=True)
+        return 0
+
+    assert jax.process_count() == 2
+    assert jax.local_device_count() == 4
+    assert jax.device_count() == 8
+
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    from dpwa_tpu.config import make_local_config
+    from dpwa_tpu.interpolation import PeerMeta
+    from dpwa_tpu.parallel.mesh import make_mesh, peer_sharding
+
+    # chips_per_host defaults to jax.local_device_count() == 4: the schedule
+    # groups align with the per-process device blocks.
+    cfg = hierarchical_config_for_hosts(make_local_config(8))
+    assert cfg.protocol.group_size == 4
+    mesh = make_mesh(cfg)
+    procs = [d.process_index for d in mesh.devices.flat]
+    assert procs == sorted(procs), (
+        f"mesh devices not contiguous per process: {procs}"
+    )
+    transport = DcnHierarchicalTransport(cfg, mesh=mesh)
+
+    sharding = peer_sharding(mesh)
+
+    def rows(idx):
+        return (
+            np.arange(8.0, dtype=np.float32)[idx[0]].reshape(-1, 1)
+            * np.ones((1, 64), np.float32)
+        )
+
+    params = {"w": jax.make_array_from_callback((8, 64), sharding, rows)}
+    ones = np.ones(8, np.float32)
+    meta = PeerMeta(
+        jax.make_array_from_callback((8,), sharding, lambda i: ones[i[0]]),
+        jax.make_array_from_callback((8,), sharding, lambda i: ones[i[0]]),
+    )
+
+    groups = np.arange(8) // 4
+    for step in range(transport.schedule.pool_size):
+        params, info = transport.exchange(params, meta, step)
+        partner = multihost_utils.process_allgather(info.partner, tiled=True)
+        alpha = multihost_utils.process_allgather(info.alpha, tiled=True)
+        np.testing.assert_array_equal(partner[partner], np.arange(8))
+        slot = transport.schedule.branch(step)
+        if slot == transport.schedule.pool_size - 1:
+            assert (groups[partner] != groups).all(), (
+                f"inter slot stayed intra: {partner}"
+            )
+        else:
+            assert (groups[partner] == groups).all(), (
+                f"intra slot crossed hosts: {partner}"
+            )
+        assert np.all(alpha == 0.5), alpha
+
+    w = multihost_utils.process_allgather(params["w"], tiled=True)[:, 0]
+    assert w.std() < np.arange(8.0).std(), (
+        f"no mixing after a full schedule period: {w}"
+    )
+    print(f"DCN_OK proc={pid} w={np.round(w, 3).tolist()}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
