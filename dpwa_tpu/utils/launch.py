@@ -22,7 +22,37 @@ identical across transports.
 from __future__ import annotations
 
 import argparse
-from typing import NamedTuple, Optional
+import os
+from typing import NamedTuple, Optional, Tuple
+
+
+def child_process_env(
+    repo_root: Optional[str] = None,
+    *,
+    strip: Tuple[str, ...] = (
+        "XLA_FLAGS",
+        "JAX_PLATFORMS",
+        "JAX_NUM_PROCESSES",
+    ),
+    platform: Optional[str] = "cpu",
+) -> dict:
+    """Environment for a spawned JAX worker process.
+
+    Launchers that fork multi-process legs (the TCP free-run experiment,
+    the multi-process DCN test) must not leak the parent's frozen platform
+    choices: ``XLA_FLAGS``'s forced device count and ``JAX_PLATFORMS`` are
+    parsed once at the child's first backend init, so inherited values
+    silently misconfigure it.  Strips those, pins ``platform`` (None keeps
+    the child's default resolution), and prepends ``repo_root`` to
+    ``PYTHONPATH`` so in-repo imports work from any cwd."""
+    env = {k: v for k, v in os.environ.items() if k not in strip}
+    if platform is not None:
+        env["JAX_PLATFORMS"] = platform
+    if repo_root is not None:
+        env["PYTHONPATH"] = os.pathsep.join(
+            filter(None, (repo_root, env.get("PYTHONPATH")))
+        )
+    return env
 
 
 def add_transport_args(ap: argparse.ArgumentParser) -> None:

@@ -1,0 +1,498 @@
+"""Free-running async TCP gossip vs SPMD masked emulation — convergence study.
+
+SURVEY.md §7 hard part #1: the reference's peers are truly asynchronous
+(independent processes, probabilistic fetches, drifting clocks); the SPMD
+rebuild *emulates* that with a deterministic per-step pairing plus a masked
+merge.  The lock-step bit-parity test (tests/test_parity.py) proves the easy
+half.  This experiment closes the hard half: it runs
+
+- ``tcp``   — 8 FREE-RUNNING OS processes gossiping over real sockets, no
+  lock-step driver, random pull schedule, ``fetch_probability = 0.5``, with
+  per-step timing jitter so local clocks genuinely drift;
+- ``ici``   — the SPMD masked emulation of the same protocol on a forced
+  8-device CPU mesh (one jitted program, ppermute exchange);
+- ``stacked`` — the same emulation as a single-device stacked (vmapped) step;
+
+on the same offline task (sklearn 8×8 digits, SmallNet, SGD+momentum, the
+same per-peer data streams) across the same seeds, and records per-peer
+loss/accuracy trajectories as JSONL under ``artifacts/async_convergence/``.
+``analyze`` reduces them to a summary (final accuracy, steps-to-90%,
+trajectory deviation between modes).  This doubles as the
+steps-to-target-accuracy artifact on real data (BASELINE.json metric) until
+a full CIFAR-10 is mountable offline.
+
+Usage::
+
+    python experiments/async_convergence.py run            # everything
+    python experiments/async_convergence.py run --seeds 0 --modes tcp
+    python experiments/async_convergence.py analyze        # re-summarize
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ART_DIR = os.path.join(REPO_ROOT, "artifacts", "async_convergence")
+if REPO_ROOT not in sys.path:  # direct-script invocation from anywhere
+    sys.path.insert(0, REPO_ROOT)
+
+N_PEERS = 8
+BATCH = 32
+LR = 0.05
+MOMENTUM = 0.9
+STEPS = 400
+EVAL_EVERY = 20
+FETCH_P = 0.5
+POOL_SIZE = 16
+DATA_SEED = 0  # train/test split is fixed; per-run seed varies streams+init
+JITTER_MS = 2.0  # uniform per-step sleep in the tcp workers: forces drift
+
+
+def experiment_config(seed: int, base_port: int = 0):
+    """One config drives all three transports (the BASELINE.json:5 contract).
+
+    Reference-style fully-async knobs: random schedule, one-sided pull mode
+    (each peer independently pulls a partner — SURVEY.md §3.2), fetch
+    probability 0.5."""
+    from dpwa_tpu.config import make_local_config
+
+    return make_local_config(
+        N_PEERS,
+        schedule="random",
+        fetch_probability=FETCH_P,
+        seed=seed,
+        mode="pull",
+        pool_size=POOL_SIZE,
+        base_port=base_port,
+        timeout_ms=2000,
+    )
+
+
+def _jsonl_path(mode: str, seed: int) -> str:
+    return os.path.join(ART_DIR, f"run_{mode}_s{seed}.jsonl")
+
+
+def _setup_task(seed: int):
+    """(model, stacked init params fn, batches iterator, test set, loss)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from dpwa_tpu.data import load_digits_dataset, peer_batches
+    from dpwa_tpu.models.mnist import SmallNet
+
+    x_tr, y_tr, x_te, y_te = load_digits_dataset(seed=DATA_SEED)
+    model = SmallNet()
+    params0 = model.init(jax.random.key(seed), jnp.zeros((1, 8, 8, 1)))
+    opt = optax.sgd(LR, momentum=MOMENTUM)
+    batches = peer_batches(x_tr, y_tr, N_PEERS, BATCH, seed=seed)
+
+    def loss_fn(params, batch):
+        x, y = batch
+        logits = model.apply(params, x)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, y
+        ).mean()
+
+    return model, params0, opt, batches, (x_te, y_te), loss_fn
+
+
+# ---------------------------------------------------------------- tcp worker
+
+
+def tcp_worker(args) -> int:
+    """One free-running peer process: local SGD + socket gossip, own pace."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from dpwa_tpu.parallel.tcp import TcpTransport
+    from dpwa_tpu.utils.pytree import ravel
+
+    me, seed = args.peer, args.seed
+    model, params, opt, batches, (x_te, y_te), loss_fn = _setup_task(seed)
+    opt_state = opt.init(params)
+    cfg = experiment_config(seed, base_port=args.base_port)
+    transport = TcpTransport(cfg, f"node{me}")
+
+    @jax.jit
+    def local_step(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return jax.tree.map(
+            lambda p, u: p + u, params, updates
+        ), opt_state, loss
+
+    @jax.jit
+    def accuracy(params):
+        logits = model.apply(params, x_te)
+        return jnp.mean(jnp.argmax(logits, -1) == y_te)
+
+    _, unravel = ravel(params)
+    rng = np.random.default_rng(seed * 1000 + me)
+    records = []
+    clock = 0.0
+    # Rendezvous: publish the initial weights (the Rx server serves nothing
+    # until the first publish), then wait until every peer's Rx server
+    # answers, so early workers don't burn their first fetches on peers
+    # still compiling.
+    transport.publish(np.asarray(ravel(params)[0], np.float32), clock, 0.0)
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        if all(
+            transport.fetch(i, timeout_ms=200) is not None
+            for i in range(N_PEERS)
+            if i != me
+        ):
+            break
+        time.sleep(0.1)
+
+    for k in range(args.steps):
+        stacked = next(batches)  # identical streams across modes
+        batch = (stacked[0][me], stacked[1][me])
+        params, opt_state, loss = local_step(params, opt_state, batch)
+        clock += 1.0
+        vec = np.asarray(ravel(params)[0], np.float32)
+        merged, alpha, partner = transport.exchange(
+            vec, clock, float(loss), k
+        )
+        if alpha != 0.0:
+            params = unravel(jnp.asarray(merged))
+        if k % EVAL_EVERY == 0 or k == args.steps - 1:
+            records.append(
+                {
+                    "mode": "tcp",
+                    "seed": seed,
+                    "peer": me,
+                    "step": k,
+                    "clock": clock,
+                    "loss": float(loss),
+                    "acc": float(accuracy(params)),
+                    "alpha": float(alpha),
+                    "partner": int(partner),
+                }
+            )
+        if JITTER_MS > 0:
+            time.sleep(rng.uniform(0, JITTER_MS / 1000.0))
+
+    with open(args.out, "w") as f:
+        for r in records:
+            f.write(json.dumps(r) + "\n")
+    print(f"WORKER_DONE {me}", flush=True)
+    # Keep serving the Rx thread for laggards, then exit.
+    time.sleep(args.grace)
+    transport.close()
+    return 0
+
+
+def run_tcp(seed: int, steps: int) -> None:
+    """Spawn N free-running worker processes; merge their JSONL shards."""
+    # Below the Linux ephemeral range (32768+): a transient outgoing
+    # connection can never squat one of the workers' listening ports.
+    base_port = 17000 + seed * 20
+    os.makedirs(ART_DIR, exist_ok=True)
+    shard_paths = [
+        os.path.join(ART_DIR, f".tcp_s{seed}_p{i}.jsonl")
+        for i in range(N_PEERS)
+    ]
+    from dpwa_tpu.utils.launch import child_process_env
+
+    env = child_process_env(REPO_ROOT)
+    procs = [
+        subprocess.Popen(
+            [
+                sys.executable,
+                os.path.abspath(__file__),
+                "worker",
+                "--peer", str(i),
+                "--seed", str(seed),
+                "--steps", str(steps),
+                "--base-port", str(base_port),
+                "--out", shard_paths[i],
+                "--grace", "20",
+            ],
+            env=env,
+            cwd=REPO_ROOT,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for i in range(N_PEERS)
+    ]
+    # Workers exit on their own after steps + grace (the grace sleep keeps
+    # each Rx server alive for laggards' fetches).  The wait is wall-clock
+    # bounded so one wedged worker aborts the leg instead of hanging the
+    # whole multi-seed study; a dead or hung worker never leaks the others
+    # (they hold the port range).
+    budget = 120 + steps * 1.0  # rendezvous + jit startup + generous step time
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=max(30, budget))
+            if "WORKER_DONE" not in out:
+                raise RuntimeError(
+                    f"tcp worker rc={p.returncode} without DONE:\n{out}"
+                )
+            outs.append(out)
+    except subprocess.TimeoutExpired as e:
+        raise RuntimeError(f"tcp worker hung past {budget:.0f}s") from e
+    finally:
+        for p in procs:
+            p.kill()
+        for p in procs:
+            p.wait(timeout=30)
+    with open(_jsonl_path("tcp", seed), "w") as out:
+        for sp in shard_paths:
+            with open(sp) as f:
+                out.write(f.read())
+            os.remove(sp)
+    print(f"tcp seed={seed}: {len(outs)} workers done")
+
+
+# ------------------------------------------------------------- spmd runners
+
+
+def run_spmd(transport_kind: str, seed: int, steps: int) -> None:
+    """The SPMD masked emulation: ici (8-dev CPU mesh) or stacked (1 dev)."""
+    import numpy as np
+
+    if transport_kind == "ici":
+        from dpwa_tpu.utils.devices import repoint_to_host_mesh
+
+        repoint_to_host_mesh(N_PEERS)
+    else:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from dpwa_tpu.train import (
+        make_gossip_eval_fn,
+        stack_params,
+    )
+
+    model, params0, opt, batches, (x_te, y_te), loss_fn = _setup_task(seed)
+    stacked = stack_params(params0, N_PEERS)
+    cfg = experiment_config(seed)
+
+    if transport_kind == "ici":
+        from dpwa_tpu.parallel.ici import IciTransport
+        from dpwa_tpu.parallel.mesh import make_mesh, peer_sharding
+        from dpwa_tpu.train import init_gossip_state, make_gossip_train_step
+
+        transport = IciTransport(cfg, mesh=make_mesh(cfg))
+        state = init_gossip_state(stacked, opt, transport)
+        step_fn = make_gossip_train_step(loss_fn, opt, transport)
+        eval_fn = make_gossip_eval_fn(model.apply, transport)
+        sharding = peer_sharding(transport.mesh)
+    else:
+        from dpwa_tpu.parallel.stacked import (
+            StackedTransport,
+            init_stacked_state,
+            make_stacked_train_step,
+        )
+
+        transport = StackedTransport(cfg)
+        state = init_stacked_state(stacked, opt, transport)
+        step_fn = make_stacked_train_step(loss_fn, opt, transport)
+        eval_fn = make_gossip_eval_fn(model.apply)
+        sharding = None
+
+    records = []
+    for k in range(steps):
+        bx, by = next(batches)
+        batch = (
+            jax.device_put(bx, sharding),
+            jax.device_put(by, sharding),
+        )
+        state, losses, info = step_fn(state, batch)
+        if k % EVAL_EVERY == 0 or k == steps - 1:
+            accs = np.asarray(eval_fn(state.params, x_te, y_te))
+            losses = np.asarray(losses)
+            alphas = np.asarray(info.alpha)
+            partners = np.asarray(info.partner)
+            for i in range(N_PEERS):
+                records.append(
+                    {
+                        "mode": transport_kind,
+                        "seed": seed,
+                        "peer": i,
+                        "step": k,
+                        "clock": float(k + 1),
+                        "loss": float(losses[i]),
+                        "acc": float(accs[i]),
+                        "alpha": float(alphas[i]),
+                        "partner": int(partners[i]),
+                    }
+                )
+    os.makedirs(ART_DIR, exist_ok=True)
+    with open(_jsonl_path(transport_kind, seed), "w") as f:
+        for r in records:
+            f.write(json.dumps(r) + "\n")
+    final = np.mean([r["acc"] for r in records if r["step"] == steps - 1])
+    print(f"{transport_kind} seed={seed}: final mean acc {final:.4f}")
+
+
+# ----------------------------------------------------------------- analysis
+
+
+def analyze() -> dict:
+    """Reduce the JSONL runs to the committed summary."""
+    import numpy as np
+
+    runs = {}  # (mode, seed) -> {step -> [accs]}
+    for name in sorted(os.listdir(ART_DIR)):
+        if not name.startswith("run_") or not name.endswith(".jsonl"):
+            continue
+        with open(os.path.join(ART_DIR, name)) as f:
+            for line in f:
+                r = json.loads(line)
+                key = (r["mode"], r["seed"])
+                runs.setdefault(key, {}).setdefault(r["step"], []).append(
+                    r["acc"]
+                )
+
+    def curve(mode, seed):
+        steps = sorted(runs[(mode, seed)])
+        return steps, [float(np.mean(runs[(mode, seed)][s])) for s in steps]
+
+    modes = sorted({m for m, _ in runs})
+    seeds = sorted({s for _, s in runs})
+    # The step count the runs ACTUALLY used (curves end at steps-1), not
+    # the module default, which a --steps override may differ from.  Runs
+    # of different lengths in one artifact dir mean stale JSONL from an
+    # earlier invocation is being compared against fresh curves — surface
+    # that in the summary instead of silently averaging across lengths.
+    per_run_steps = {
+        f"{m}_s{s}": 1 + max(per) for (m, s), per in sorted(runs.items())
+    }
+    actual_steps = max(per_run_steps.values())
+    mixed = len(set(per_run_steps.values())) > 1
+    summary = {
+        "task": "sklearn digits 8x8, SmallNet, SGD(0.05, m=0.9), batch 32",
+        "protocol": {
+            "n_peers": N_PEERS,
+            "schedule": "random",
+            "mode": "pull",
+            "fetch_probability": FETCH_P,
+            "steps": actual_steps,
+            "tcp_jitter_ms": JITTER_MS,
+        },
+        "seeds": seeds,
+        "modes": {},
+    }
+    if mixed:
+        summary["WARNING_mixed_step_counts"] = per_run_steps
+        print(
+            f"WARNING: runs of different lengths in {ART_DIR} — "
+            f"{per_run_steps}; rerun the stale modes or clear the dir",
+            file=sys.stderr,
+        )
+    for mode in modes:
+        finals, to90 = [], []
+        for seed in seeds:
+            if (mode, seed) not in runs:
+                continue
+            steps, accs = curve(mode, seed)
+            finals.append(accs[-1])
+            hit = [s for s, a in zip(steps, accs) if a >= 0.9]
+            to90.append(hit[0] if hit else None)
+        summary["modes"][mode] = {
+            "final_acc_mean": float(np.mean(finals)),
+            "final_acc_std": float(np.std(finals)),
+            "steps_to_90pct": to90,
+        }
+    # Trajectory deviation between the free-running truth and the emulation.
+    for emu in ("ici", "stacked"):
+        if "tcp" not in modes or emu not in modes:
+            continue
+        devs = []
+        for seed in seeds:
+            if ("tcp", seed) not in runs or (emu, seed) not in runs:
+                continue
+            st, at = curve("tcp", seed)
+            se, ae = curve(emu, seed)
+            common = sorted(set(st) & set(se))
+            at_m = dict(zip(st, at))
+            ae_m = dict(zip(se, ae))
+            devs.append(max(abs(at_m[s] - ae_m[s]) for s in common))
+        summary[f"max_traj_dev_tcp_vs_{emu}"] = (
+            float(np.max(devs)) if devs else None
+        )
+    out = os.path.join(ART_DIR, "summary.json")
+    with open(out, "w") as f:
+        json.dump(summary, f, indent=2)
+    print(json.dumps(summary, indent=2))
+    return summary
+
+
+# --------------------------------------------------------------------- main
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    w = sub.add_parser("worker")
+    w.add_argument("--peer", type=int, required=True)
+    w.add_argument("--seed", type=int, required=True)
+    w.add_argument("--steps", type=int, default=STEPS)
+    w.add_argument("--base-port", type=int, required=True)
+    w.add_argument("--out", required=True)
+    w.add_argument("--grace", type=float, default=20.0)
+
+    r = sub.add_parser("run")
+    r.add_argument("--modes", default="tcp,ici,stacked")
+    r.add_argument("--seeds", default="0,1,2")
+    r.add_argument("--steps", type=int, default=STEPS)
+
+    s = sub.add_parser("spmd")
+    s.add_argument("--transport", choices=("ici", "stacked"), required=True)
+    s.add_argument("--seed", type=int, required=True)
+    s.add_argument("--steps", type=int, default=STEPS)
+
+    sub.add_parser("analyze")
+
+    args = ap.parse_args()
+    if args.cmd == "worker":
+        return tcp_worker(args)
+    if args.cmd == "spmd":
+        run_spmd(args.transport, args.seed, args.steps)
+        return 0
+    if args.cmd == "analyze":
+        analyze()
+        return 0
+
+    # run: each (mode, seed) leg in its own subprocess so jax's frozen
+    # platform/device-count choices never leak across legs.
+    from dpwa_tpu.utils.launch import child_process_env
+
+    env = child_process_env(REPO_ROOT)
+    for seed in [int(x) for x in args.seeds.split(",")]:
+        for mode in args.modes.split(","):
+            t0 = time.time()
+            if mode == "tcp":
+                run_tcp(seed, args.steps)
+                continue
+            cmd = [
+                sys.executable, os.path.abspath(__file__), "spmd",
+                "--transport", mode, "--seed", str(seed),
+                "--steps", str(args.steps),
+            ]
+            subprocess.run(cmd, check=True, env=env, cwd=REPO_ROOT)
+            print(f"[{mode} s{seed}] {time.time() - t0:.1f}s")
+    analyze()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -68,15 +68,12 @@ def test_multiprocess_dcn_smoke():
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         port = s.getsockname()[1]
-    env = {
-        k: v
-        for k, v in os.environ.items()
-        if k not in ("XLA_FLAGS", "JAX_PLATFORMS", "JAX_NUM_PROCESSES")
-    }
+    from dpwa_tpu.utils.launch import child_process_env
+
     repo_root = os.path.dirname(os.path.dirname(worker))
-    env["PYTHONPATH"] = os.pathsep.join(
-        filter(None, (repo_root, env.get("PYTHONPATH")))
-    )
+    # platform=None: the worker pins its own platform after distributed
+    # init; pre-setting JAX_PLATFORMS here would be redundant.
+    env = child_process_env(repo_root, platform=None)
     procs = [
         subprocess.Popen(
             [sys.executable, worker, str(i), str(port)],
