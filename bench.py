@@ -55,8 +55,12 @@ def bench_device(d: int, n_peers: int, iters: int) -> float:
     import jax
     import jax.numpy as jnp
 
+    from dpwa_tpu.utils.profiling import measure_sync_rtt, timed_loop
+
     devices = jax.devices()
     log(f"device backend: {devices[0].platform} x{len(devices)}")
+    sync_rtt = measure_sync_rtt()
+    log(f"sync readback RTT: {sync_rtt*1e3:.1f} ms (subtracted once/loop)")
 
     if len(devices) >= n_peers:
         # Real multi-device path: the actual transport collective.
@@ -77,19 +81,17 @@ def bench_device(d: int, n_peers: int, iters: int) -> float:
         meta = PeerMeta(
             jnp.ones(n_peers, jnp.float32), jnp.ones(n_peers, jnp.float32)
         )
-        params = {"v": x}
-        merged, _ = transport.exchange(params, meta, 0)  # warmup/compile
-        float(merged["v"].sum())
-        t0 = time.perf_counter()
-        for step in range(iters):
-            params, _ = transport.exchange(params, meta, step)
-        # Host readback forces real completion (async dispatch would
-        # otherwise let timing observe only the enqueue).
-        float(params["v"].sum())
-        dt = time.perf_counter() - t0
+        per_iter, _ = timed_loop(
+            lambda p, step: transport.exchange(p, meta, step)[0],
+            lambda p: float(p["v"].sum()),
+            {"v": x},
+            iters,
+            warmup=1,
+            sync_rtt=sync_rtt,
+            label="ici-exchange",
+        )
         # Per chip: each chip receives d*4 bytes and writes d*4 bytes.
-        bytes_per_chip = 2 * d * 4 * iters
-        return bytes_per_chip / dt / 1e9
+        return 2 * d * 4 / per_iter / 1e9
 
     # Single-chip path: stacked virtual peers (SURVEY.md §7 note), ring
     # pairing resolved as data by the fused merge.  On TPU this is the
@@ -119,15 +121,17 @@ def bench_device(d: int, n_peers: int, iters: int) -> float:
         # 3D layout: the donated buffer aliases straight into the kernel
         # (a 2D buffer would pay a reshape copy every step).
         x = x.reshape(n_peers, d // 128, 128)
-        x = pallas_pair_merge(x, lefts[0], rights[0], alphas)  # compile
-        float(x.sum())
-        t0 = time.perf_counter()
-        for step in range(iters):
-            i = step % 2
-            x = pallas_pair_merge(x, lefts[i], rights[i], alphas)
-        # Host readback forces real completion (see multi-device note).
-        float(x.sum())
-        dt = time.perf_counter() - t0
+        per_iter, _ = timed_loop(
+            lambda b, step: pallas_pair_merge(
+                b, lefts[step % 2], rights[step % 2], alphas
+            ),
+            lambda b: float(b.sum()),
+            x,
+            iters,
+            warmup=2,
+            sync_rtt=sync_rtt,
+            label="pallas-pair-merge",
+        )
         # Honest accounting: count only the per-pool *actual* pairs over the
         # iteration sequence, each row read once + written once.  Pools
         # padded to max(n_pairs) do DMA the pad self-pair rows, but those
@@ -135,21 +139,21 @@ def bench_device(d: int, n_peers: int, iters: int) -> float:
         total_bytes = sum(
             2 * actual_pairs[step % 2] * 2 * d * 4 for step in range(iters)
         )
-        return total_bytes / dt / 1e9
+        return total_bytes / (per_iter * iters) / 1e9
 
     perms = jnp.asarray(np.stack(pools), jnp.int32)
-    x2 = pairwise_merge(x, perms[0], alphas)
-    float(x2.sum())
-    t0 = time.perf_counter()
-    for step in range(iters):
-        x = pairwise_merge(x, perms[step % 2], alphas)
-    # Host readback forces real completion (see multi-device note above).
-    float(x.sum())
-    dt = time.perf_counter() - t0
+    per_iter, _ = timed_loop(
+        lambda b, step: pairwise_merge(b, perms[step % 2], alphas),
+        lambda b: float(b.sum()),
+        x,
+        iters,
+        warmup=2,
+        sync_rtt=sync_rtt,
+        label="xla-merge",
+    )
     # All n virtual peers live on the one chip: it reads the permuted
     # partner vector and writes the merge for each -> 2*d*4 bytes per peer.
-    total_bytes = n_peers * 2 * d * 4 * iters
-    return total_bytes / dt / 1e9
+    return n_peers * 2 * d * 4 / per_iter / 1e9
 
 
 def bench_tcp(d: int, iters: int, timeout_ms: int = 10000) -> float:
@@ -270,7 +274,12 @@ def main() -> None:
         "(multiple of 1024 so the Pallas fast path applies)",
     )
     ap.add_argument("--peers", type=int, default=8)
-    ap.add_argument("--iters", type=int, default=30)
+    ap.add_argument(
+        "--iters", type=int, default=200,
+        help="device-leg exchange iterations; high enough that per-loop "
+        "fixed costs (~60 ms tunnel sync RTT, also measured and "
+        "subtracted) are noise next to device time",
+    )
     ap.add_argument("--tcp-iters", type=int, default=5)
     ap.add_argument(
         "--tcp-size", type=int, default=0,

@@ -360,8 +360,17 @@ def pairwise_merge(
 
     Functional (non-donating) API keyed by the involution ``partner``.  The
     in-place bandwidth-optimal path is :func:`pallas_pair_merge`; callers
-    that own their buffer and know the pair lists (the bench, the stacked
-    virtual-peer trainer) should call it directly.
+    that hold their payload as one flat resident ``[n, d/128, 128]`` buffer
+    (the bandwidth bench, flat-vector adapters) should call it directly.
+
+    The stacked TRAIN step deliberately does not: measured on a v5e chip
+    (experiments/stacked_exchange_profile.py, committed in
+    artifacts/stacked_exchange_profile.json), the XLA gather merge is 9 %
+    of a ResNet-50-scale step, and the kernel's 3→2 HBM-pass saving (a
+    2.45× faster exchange in isolation) caps the end-to-end gain at ~5 %
+    — less than the cost of carrying the params pytree as a flat buffer
+    (ravel/unravel passes) or of the per-leaf retiling reshapes that
+    leaf-wise grafting would add.
     """
     if prefer_pallas is None:
         prefer_pallas = jax.default_backend() == "tpu"

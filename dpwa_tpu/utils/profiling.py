@@ -7,13 +7,18 @@ here).
 - :func:`measure_exchange_bandwidth` — the GB/s/chip counter around the
   averaging collective, the headline metric (BASELINE.json:2).  Used by
   ``bench.py`` and available to users against their own models.
+- :func:`measure_sync_rtt` / :func:`timed_loop` — the one correct timing
+  idiom for this box's tunneled chip, shared by the bench and the
+  experiments (see ``timed_loop``'s docstring for why naive timing lies
+  twice here).
 """
 
 from __future__ import annotations
 
 import contextlib
+import sys
 import time
-from typing import Iterator, Optional
+from typing import Callable, Iterator, Optional
 
 import jax
 import numpy as np
@@ -27,6 +32,77 @@ def trace(log_dir: str) -> Iterator[None]:
         yield
     finally:
         jax.profiler.stop_trace()
+
+
+def measure_sync_rtt(samples: int = 10) -> float:
+    """Median seconds of one scalar host readback (the timing sync).
+
+    On a tunneled/async backend a ``float(x.sum())`` readback — the only
+    reliable completion barrier (``block_until_ready`` can return at
+    enqueue) — costs a fixed round trip (~63 ms through this box's chip
+    tunnel).  Timed loops end in exactly one such readback; subtracting
+    this constant removes a pure measurement artifact without touching
+    device-side time."""
+    import jax.numpy as jnp
+
+    s = jnp.float32(1.0)
+    for _ in range(3):
+        float(s.sum())
+    times = []
+    for _ in range(samples):
+        t0 = time.perf_counter()
+        float(s.sum())
+        times.append(time.perf_counter() - t0)
+    return float(np.median(times))
+
+
+def timed_loop(
+    run_iter: Callable,
+    sync: Callable,
+    carry,
+    iters: int,
+    *,
+    warmup: int = 3,
+    sync_rtt: Optional[float] = None,
+    label: str = "timed_loop",
+):
+    """Mean wall seconds per iteration of ``carry = run_iter(carry, k)``.
+
+    Correct timing on this box needs two things at once:
+
+    1. ``sync(carry)`` must force REAL completion via a host readback of an
+       on-device reduction — ``jax.block_until_ready`` returns at enqueue
+       time through the chip tunnel, so naive per-call timing observes only
+       the dispatch.
+    2. That readback costs a fixed round trip (``sync_rtt``; measured via
+       :func:`measure_sync_rtt` when not supplied), paid exactly once per
+       loop, which must be subtracted or short loops are dominated by it.
+
+    When the RTT exceeds half the raw measurement the corrected figure is
+    mostly noise; a warning is printed to stderr so an absurd number never
+    passes silently (clamped at a 1 ns floor).
+
+    Returns ``(seconds_per_iter, final_carry)``.
+    """
+    if sync_rtt is None:
+        sync_rtt = measure_sync_rtt()
+    for k in range(warmup):
+        carry = run_iter(carry, k)
+    sync(carry)
+    t0 = time.perf_counter()
+    for k in range(iters):
+        carry = run_iter(carry, k)
+    sync(carry)
+    dt_raw = time.perf_counter() - t0
+    if sync_rtt > 0.5 * dt_raw:
+        print(
+            f"WARNING [{label}]: sync RTT {sync_rtt*1e3:.1f} ms exceeds "
+            f"half the raw measurement {dt_raw*1e3:.1f} ms over {iters} "
+            "iters — the corrected time is noise-dominated; raise iters",
+            file=sys.stderr,
+            flush=True,
+        )
+    return max(dt_raw - sync_rtt, 1e-9) / iters, carry
 
 
 def measure_exchange_bandwidth(
