@@ -136,13 +136,19 @@ class StackedTransport:
 
 
 class StackedTrainState(NamedTuple):
-    """Stacked training state; every leaf's leading axis is n_peers."""
+    """Stacked training state; every leaf's leading axis is n_peers.
+
+    ``loss`` is each peer's most recent training loss — what the
+    reference's Rx thread serves alongside the published vector; overlapped
+    exchanges ship it as the metadata (see
+    :class:`dpwa_tpu.train.GossipTrainState`)."""
 
     params: PyTree
     opt_state: PyTree
     clock: jnp.ndarray  # float32[n]
     step: jnp.ndarray  # int32 scalar
     model_state: PyTree = None
+    loss: jnp.ndarray = None  # float32[n] — last step's per-peer loss
 
 
 def init_stacked_state(
@@ -169,6 +175,7 @@ def init_stacked_state(
         model_state=own(stacked_model_state)
         if stacked_model_state is not None
         else None,
+        loss=jnp.zeros(n, jnp.float32),
     )
 
 
@@ -178,6 +185,7 @@ def make_stacked_train_step(
     transport: StackedTransport,
     exchange_filter: Optional[Callable[[str], bool]] = None,
     with_state: bool = False,
+    overlap: bool = False,
 ):
     """Jitted ``train_step(state, batch) -> (state, losses, info)`` on one
     device: vmapped per-peer forward/backward/optimizer followed by the
@@ -194,6 +202,14 @@ def make_stacked_train_step(
     — the standard loop).  Without donation every in-flight step holds a
     full fresh copy of params + optimizer state, and a deep async dispatch
     queue (hundreds of steps) can swamp the HBM allocator.
+
+    ``overlap=True`` exchanges the PRE-update replicas (with the previous
+    step's losses as metadata) and applies the local updates to the merged
+    result, exactly as :func:`dpwa_tpu.train.make_gossip_train_step`
+    documents.  On one chip the gain is small (~1 % — a single core has
+    no second engine to hide the gather behind); the mode exists here for
+    layout parity with the ICI path, where the dependency-free collective
+    genuinely overlaps compute.
     """
     grad_fn = jax.value_and_grad(loss_fn, has_aux=with_state)
     schedule, interp = transport.schedule, transport.interp
@@ -222,28 +238,54 @@ def make_stacked_train_step(
             loss, grads = grad_fn(params, batch)
             new_model_state = ()
         updates, opt_state = optimizer.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
-        return params, opt_state, new_model_state, loss
+        new_params = optax.apply_updates(params, updates)
+        return new_params, updates, opt_state, new_model_state, loss
 
     @functools.partial(jax.jit, donate_argnums=(0,))
     def _step(state: StackedTrainState, batch):
         model_state = state.model_state if with_state else ()
-        params, opt_state, new_model_state, losses = jax.vmap(per_peer)(
-            state.params, state.opt_state, model_state, batch
-        )
+        params, updates, opt_state, new_model_state, losses = jax.vmap(
+            per_peer
+        )(state.params, state.opt_state, model_state, batch)
         clock = state.clock + 1.0
-        meta = PeerMeta(clock, losses.astype(jnp.float32))
+        # Overlap mode exchanges the pre-update replicas (state.params)
+        # with the PREVIOUS step's losses — every exchanged operand is
+        # ready at step entry, so the exchange's HBM reads never wait on
+        # this step's fwd/bwd/optimizer; the local updates (and the
+        # model-state delta) land on the merged result afterwards.
+        if overlap:
+            prev_loss = (
+                state.loss
+                if state.loss is not None
+                else jnp.zeros_like(clock)
+            )
+            meta = PeerMeta(clock, prev_loss)
+            exchange_params, exchange_state = state.params, model_state
+        else:
+            meta = PeerMeta(clock, losses.astype(jnp.float32))
+            exchange_params, exchange_state = params, new_model_state
         if exchange_filter is not None:
-            selected, rest = pytree_partition(params, exchange_filter)
+            selected, _ = pytree_partition(exchange_params, exchange_filter)
             (merged_sel, merged_state), info = stacked_gossip_exchange(
-                (selected, new_model_state), meta, state.step,
+                (selected, exchange_state), meta, state.step,
                 schedule=schedule, interp=interp,
             )
+            if overlap:
+                sel_updates, _ = pytree_partition(updates, exchange_filter)
+                merged_sel = optax.apply_updates(merged_sel, sel_updates)
+            _, rest = pytree_partition(params, exchange_filter)
             merged = pytree_combine(merged_sel, rest)
         else:
             (merged, merged_state), info = stacked_gossip_exchange(
-                (params, new_model_state), meta, state.step,
+                (exchange_params, exchange_state), meta, state.step,
                 schedule=schedule, interp=interp,
+            )
+            if overlap:
+                merged = optax.apply_updates(merged, updates)
+        if overlap:
+            merged_state = jax.tree.map(
+                lambda m, new, old: m + (new - old),
+                merged_state, new_model_state, model_state,
             )
         new_state = StackedTrainState(
             params=merged,
@@ -251,6 +293,7 @@ def make_stacked_train_step(
             clock=clock,
             step=state.step + 1,
             model_state=merged_state if with_state else state.model_state,
+            loss=losses,
         )
         return new_state, losses, info
 

@@ -47,13 +47,19 @@ class GossipTrainState(NamedTuple):
     ``model_state`` carries non-parameter model variables (e.g. BatchNorm
     ``batch_stats``); it is exchanged alongside params — running statistics
     are part of the replica and must gossip with the same α — but never
-    touched by the optimizer."""
+    touched by the optimizer.
+
+    ``loss`` is each peer's most recent training loss — the value the
+    reference's Rx thread serves alongside the published vector
+    (SURVEY.md §3.3).  Overlapped exchanges ship it as the metadata so the
+    collective has no dependency on the current step's forward pass."""
 
     params: PyTree
     opt_state: PyTree
     clock: jnp.ndarray  # float32[n] — steps trained, rides with exchanges
     step: jnp.ndarray  # int32 scalar — global schedule position
     model_state: PyTree = None
+    loss: jnp.ndarray = None  # float32[n] — last step's per-peer loss
 
 
 def init_gossip_state(
@@ -88,6 +94,7 @@ def init_gossip_state(
         model_state=put(stacked_model_state)
         if stacked_model_state is not None
         else None,
+        loss=jax.device_put(jnp.zeros(n, jnp.float32), sh),
     )
 
 
@@ -113,23 +120,30 @@ def _make_step(
     transport: IciTransport,
     exchange_filter: Optional[Callable[[str], bool]],
     with_state: bool,
+    overlap: bool = False,
 ):
     """Shared builder behind both public step factories.
 
     When ``with_state`` is False, ``model_state`` is threaded through as an
     empty pytree ``()`` — zero leaves, so it adds nothing to the compiled
-    program — keeping one body/shard_map/_step implementation for both."""
+    program — keeping one body/shard_map/_step implementation for both.
+
+    ``overlap`` selects which params the exchange ships (see
+    :func:`make_gossip_train_step`): post-update (default, the lock-step
+    emulation) or pre-update ``x_k`` (the collective overlaps fwd/bwd)."""
     grad_fn = jax.value_and_grad(loss_fn, has_aux=with_state)
     schedule, interp = transport.schedule, transport.interp
     axis, mesh = transport.axis_name, transport.mesh
     shard = lambda t: jax.tree.map(lambda v: v[0], t)
     unshard = lambda t: jax.tree.map(lambda v: v[None], t)
 
-    def body(params, opt_state, model_state, clock, step, batch):
+    def body(params, opt_state, model_state, clock, prev_loss, step, batch):
         # Local (per-device) values: strip the size-1 peer block axis.
         params, opt_state = shard(params), shard(opt_state)
+        old_params, old_model_state = params, model_state
         if with_state:
             model_state = shard(model_state)
+            old_model_state = model_state
             (loss, new_model_state), grads = grad_fn(
                 params, model_state, shard(batch)
             )
@@ -139,23 +153,56 @@ def _make_step(
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         clock = clock[0] + 1.0
-        meta = PeerMeta(clock, loss.astype(jnp.float32))
+        if overlap:
+            # Exchange the PRE-update replica with the PREVIOUS step's
+            # loss (the last value this peer "published", exactly what the
+            # reference's Rx thread would serve, SURVEY.md §3.3).  Every
+            # collective operand — x_k, clock, stale loss — is ready at
+            # step entry, so nothing gates the ppermute on this step's
+            # fwd/bwd and XLA can overlap the DMA with compute.  The
+            # model_state (fwd-produced) is also shipped stale; its
+            # this-step delta is re-applied to the merge below.
+            exchange_params, exchange_state = old_params, old_model_state
+            meta = PeerMeta(clock, prev_loss[0])
+        else:
+            exchange_params, exchange_state = params, new_model_state
+            meta = PeerMeta(clock, loss.astype(jnp.float32))
         if exchange_filter is not None:
-            selected, rest = pytree_partition(params, exchange_filter)
+            selected, _ = pytree_partition(exchange_params, exchange_filter)
             (merged_sel, merged_state), (partner, alpha, part) = (
                 gossip_exchange_local(
-                    (selected, new_model_state), meta, step,
+                    (selected, exchange_state), meta, step,
                     schedule=schedule, interp=interp, axis_name=axis,
                 )
             )
+        else:
+            (merged_sel, merged_state), (partner, alpha, part) = (
+                gossip_exchange_local(
+                    (exchange_params, exchange_state), meta, step,
+                    schedule=schedule, interp=interp, axis_name=axis,
+                )
+            )
+        if overlap:
+            # x_{k+1} = merge(x_k) + own update: the merge contributed the
+            # partner's pre-update replica (exactly what a free-running
+            # reference peer would have pulled from a partner that had not
+            # finished its step yet), the local gradient is never lost.
+            # Model state gets the same treatment: merge(ms_k) + this
+            # step's statistics delta.
+            if exchange_filter is not None:
+                sel_updates, _ = pytree_partition(updates, exchange_filter)
+                merged_sel = optax.apply_updates(merged_sel, sel_updates)
+            else:
+                merged_sel = optax.apply_updates(merged_sel, updates)
+            merged_state = jax.tree.map(
+                lambda m, new, old: m + (new - old),
+                merged_state, new_model_state, old_model_state,
+            )
+        if exchange_filter is not None:
+            _, rest = pytree_partition(params, exchange_filter)
             merged = pytree_combine(merged_sel, rest)
         else:
-            (merged, merged_state), (partner, alpha, part) = (
-                gossip_exchange_local(
-                    (params, new_model_state), meta, step,
-                    schedule=schedule, interp=interp, axis_name=axis,
-                )
-            )
+            merged = merged_sel
         return (
             unshard(merged),
             unshard(opt_state),
@@ -168,7 +215,9 @@ def _make_step(
     mapped = shard_map(
         body,
         mesh=mesh,
-        in_specs=(P(axis), P(axis), P(axis), P(axis), P(), P(axis)),
+        in_specs=(
+            P(axis), P(axis), P(axis), P(axis), P(axis), P(), P(axis),
+        ),
         out_specs=(
             P(axis), P(axis), P(axis), P(axis), P(axis), P(axis),
         ),
@@ -180,11 +229,17 @@ def _make_step(
     # dispatch queue can swamp the HBM allocator.
     @functools.partial(jax.jit, donate_argnums=(0,))
     def _step(state: GossipTrainState, batch):
+        prev_loss = (
+            state.loss
+            if state.loss is not None
+            else jnp.zeros_like(state.clock)
+        )
         params, opt_state, model_state, clock, losses, info = mapped(
             state.params,
             state.opt_state,
             state.model_state if with_state else (),
             state.clock,
+            prev_loss,
             state.step,
             batch,
         )
@@ -194,6 +249,7 @@ def _make_step(
             clock=clock,
             step=state.step + 1,
             model_state=model_state if with_state else state.model_state,
+            loss=losses,
         )
         return new_state, losses, ExchangeInfo(*info)
 
@@ -228,6 +284,7 @@ def make_gossip_train_step(
     optimizer: optax.GradientTransformation,
     transport: IciTransport,
     exchange_filter: Optional[Callable[[str], bool]] = None,
+    overlap: bool = False,
 ):
     """Returns jitted ``train_step(state, batch) -> (state, losses, info)``.
 
@@ -240,10 +297,27 @@ def make_gossip_train_step(
     LoRA config): only leaves whose path matches the predicate enter the
     collective; everything else never moves — neither over ICI nor DCN.
 
+    ``overlap=True`` ships the PRE-update replica ``x_k`` through the
+    collective with the PREVIOUS step's loss as metadata, and applies the
+    local update to the merged result (``x_{k+1} = merge(x_k) +
+    update_k``).  Every collective operand is then ready at step entry —
+    nothing gates the ppermute on this step's fwd/bwd — so on a real
+    multi-device mesh XLA can schedule the collective-permute's ICI DMA
+    concurrently with compute instead of serializing it after the
+    optimizer.  (On the single-chip stacked twin there is no second
+    engine to hide the gather behind; measured recovery there is ~1 % —
+    artifacts/stacked_exchange_profile.json.)  Semantically this is one
+    step of partner staleness: exactly what a free-running reference
+    process sees when it pulls from a peer that has not finished its
+    current step (SURVEY.md §3.2/§3.3 — the Rx thread serves the last
+    *published* vector and loss).  The doubly-stochastic
+    mean-preservation property is unchanged.
+
     Raises at call time if ``state.model_state`` is set — that state would
     silently stop updating; use :func:`make_gossip_train_step_with_state`."""
     return _make_step(
-        loss_fn, optimizer, transport, exchange_filter, with_state=False
+        loss_fn, optimizer, transport, exchange_filter, with_state=False,
+        overlap=overlap,
     )
 
 
@@ -252,6 +326,7 @@ def make_gossip_train_step_with_state(
     optimizer: optax.GradientTransformation,
     transport: IciTransport,
     exchange_filter: Optional[Callable[[str], bool]] = None,
+    overlap: bool = False,
 ):
     """Like :func:`make_gossip_train_step`, for models with non-parameter
     variables (BatchNorm running stats etc., the reference's stock torch
@@ -260,9 +335,13 @@ def make_gossip_train_step_with_state(
     ``loss_fn(params, model_state, batch) -> (loss, new_model_state)``.
     ``model_state`` is exchanged together with the (filtered) params —
     running statistics belong to the replica, so they merge with the same
-    α — but the optimizer never sees it."""
+    α — but the optimizer never sees it.  ``overlap`` as in
+    :func:`make_gossip_train_step` (model_state still ships post-update —
+    it is produced by the forward pass the collective overlaps with, and
+    running statistics carry no optimizer update to re-apply)."""
     return _make_step(
-        loss_fn, optimizer, transport, exchange_filter, with_state=True
+        loss_fn, optimizer, transport, exchange_filter, with_state=True,
+        overlap=overlap,
     )
 
 

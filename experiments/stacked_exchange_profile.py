@@ -114,6 +114,17 @@ def profile_config(name, init_fn, loss_fn, batch_fn, n, exchange_filter,
         (state, jnp.zeros(n)), iters, label=f"{name}:full",
     )
     del state, out
+    # (a') overlap mode: exchange of x_k runs concurrently with fwd/bwd.
+    overlap_step = make_stacked_train_step(
+        loss_fn, opt, transport, exchange_filter=exchange_filter,
+        overlap=True,
+    )
+    state_o = init_stacked_state(stacked, opt, transport)
+    t_overlap, out = timed_loop(
+        lambda c, k: overlap_step(c[0], batch)[:2], sync_losses,
+        (state_o, jnp.zeros(n)), iters, label=f"{name}:overlap",
+    )
+    del state_o, out
     state2 = init_stacked_state(stacked, opt, transport)
     t_local, out = timed_loop(
         lambda c, k: local_step(c[0], batch), sync_losses,
@@ -163,11 +174,16 @@ def profile_config(name, init_fn, loss_fn, batch_fn, n, exchange_filter,
         "n_peers": n,
         "payload_mb_per_peer": payload / 1e6,
         "t_full_step_ms": t_full * 1e3,
+        "t_overlap_step_ms": t_overlap * 1e3,
         "t_local_step_ms": t_local * 1e3,
         "t_exchange_in_step_ms": exch_in_step * 1e3,
         "t_exchange_alone_ms": t_exch * 1e3,
         "t_pallas_flat_ms": t_pallas * 1e3,
         "exchange_fraction_of_step": exch_in_step / t_full if t_full else 0,
+        # Fraction of the step the overlap mode actually recovers.
+        "overlap_recovered_fraction": max(t_full - t_overlap, 0.0) / t_full
+        if t_full
+        else 0,
         # If the exchange ran at the Pallas kernel's rate instead, the step
         # would shrink by at most this fraction (flat-buffer best case).
         "pallas_endtoend_ceiling": max(exch_in_step - t_pallas, 0.0)
