@@ -26,6 +26,11 @@ from dpwa_tpu.train import GossipTrainState
 
 PyTree = Any
 
+# State fields that post-date the first checkpoint format; restores of
+# checkpoints written before a field existed backfill it from ``like``
+# (or leave it defaulted when restoring without ``like``).
+_OPTIONAL_FIELDS = ("loss",)
+
 
 def save_checkpoint(path: str, state) -> None:
     """Atomically save a training state to ``path`` (a directory).
@@ -58,7 +63,26 @@ def restore_checkpoint(path: str, like: Optional[Any] = None):
             target = jax.tree.map(
                 ocp.utils.to_shape_dtype_struct, dict(like._asdict())
             )
-            restored = ckptr.restore(path, target)
+            # Fields added to the state AFTER a checkpoint was written
+            # (round 2 added per-peer ``loss``) are absent from old saves,
+            # and Orbax refuses a target whose structure disagrees with
+            # the save.  On mismatch, retry with the optional fields
+            # dropped from the target and backfill them from ``like``, so
+            # old checkpoints keep restoring.
+            try:
+                restored = ckptr.restore(path, target)
+            except (ValueError, KeyError):
+                backfill = {
+                    f: getattr(like, f)
+                    for f in _OPTIONAL_FIELDS
+                    if f in target
+                }
+                if not backfill:
+                    raise
+                for f in backfill:
+                    del target[f]
+                restored = ckptr.restore(path, target)
+                restored.update(backfill)
             # ``step`` is a host-scalar in spirit: leave it uncommitted so
             # it can join a jitted computation under ANY sharding layout (a
             # restored committed-to-one-device scalar would conflict with
@@ -69,4 +93,6 @@ def restore_checkpoint(path: str, like: Optional[Any] = None):
         else:
             restored = ckptr.restore(path)
     cls = type(like) if like is not None else GossipTrainState
+    # Old checkpoints simply lack optional fields here; the state classes
+    # default them (loss=None is accepted by both train steps).
     return cls(**restored)

@@ -56,6 +56,13 @@ class ProtocolConfig:
     group_size: int = 0
     inter_period: int = 4
     drop_probability: float = 0.0  # fault injection: drop pairs at this rate
+    # Wire precision of the SHIPPED replica: "f32" (exact, the reference's
+    # format) or "bf16" — halves exchange traffic (ICI/DCN bytes, TCP wire
+    # bytes); the local replica and the merge arithmetic stay f32, only
+    # the partner's contribution is rounded.  Pairwise-averaging tolerates
+    # this well: quantization error enters scaled by alpha and is averaged
+    # away across rounds.
+    wire_dtype: str = "f32"
 
     def __post_init__(self) -> None:
         if not 0.0 <= self.fetch_probability <= 1.0:
@@ -70,6 +77,8 @@ class ProtocolConfig:
             raise ValueError(f"unknown schedule {self.schedule!r}")
         if self.mode not in ("pairwise", "pull"):
             raise ValueError(f"unknown protocol mode {self.mode!r}")
+        if self.wire_dtype not in ("f32", "bf16"):
+            raise ValueError(f"unknown wire_dtype {self.wire_dtype!r}")
 
 
 @dataclasses.dataclass(frozen=True)

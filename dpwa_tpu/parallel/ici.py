@@ -87,10 +87,22 @@ def gossip_exchange_local(
 
         return apply
 
+    # wire_dtype=bf16: only the SHIPPED copy is compressed — the collective
+    # moves half the ICI/DCN bytes; the local replica and the merge math
+    # stay f32 (the partner's contribution arrives rounded, scaled by α).
+    if schedule.wire_dtype == "bf16":
+        wire_params = jax.tree.map(
+            lambda v: v.astype(jnp.bfloat16)
+            if v.dtype == jnp.float32
+            else v,
+            params,
+        )
+    else:
+        wire_params = params
     remote_params, remote_meta = lax.switch(
         branch,
         [make_branch(p) for p in schedule.pool],
-        (params, meta),
+        (wire_params, meta),
     )
 
     # Pull mode: the pull is one-sided, so the puller draws alone (the

@@ -233,6 +233,7 @@ class Schedule:
     name: str
     drop_probability: float = 0.0
     mode: str = "pairwise"  # pairwise (involutions) | pull (one-sided maps)
+    wire_dtype: str = "f32"  # precision of the shipped replica (f32 | bf16)
 
     @property
     def pool_size(self) -> int:
@@ -344,6 +345,7 @@ def build_schedule(config: DpwaConfig) -> Schedule:
         name=proto.schedule,
         drop_probability=proto.drop_probability,
         mode=proto.mode,
+        wire_dtype=proto.wire_dtype,
     )
 
 

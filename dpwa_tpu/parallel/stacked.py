@@ -90,7 +90,13 @@ def stacked_gossip_exchange(
         a = alpha.reshape((n,) + (1,) * (x.ndim - 1)).astype(
             jnp.promote_types(x.dtype, jnp.float32)
         )
-        return ((1.0 - a) * x.astype(a.dtype) + a * x[partner].astype(a.dtype)).astype(
+        y = x[partner]
+        if schedule.wire_dtype == "bf16" and x.dtype == jnp.float32:
+            # Emulate the wire: the partner's contribution is what would
+            # have arrived over the fabric — bf16-rounded.  Keeps the
+            # stacked path bit-matched to the ICI transport's merges.
+            y = y.astype(jnp.bfloat16)
+        return ((1.0 - a) * x.astype(a.dtype) + a * y.astype(a.dtype)).astype(
             x.dtype
         )
 

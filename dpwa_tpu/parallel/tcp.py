@@ -48,6 +48,12 @@ _REQ = b"DPWA?"
 _MAGIC = b"DPWA"
 _HDR = struct.Struct("<4sBBddQ")
 _DTYPES = {0: np.dtype("<f4"), 1: np.dtype("<f8"), 2: np.dtype("<u2")}
+try:  # bf16 wire code (protocol.wire_dtype: bf16) — ml_dtypes ships w/ jax
+    import ml_dtypes
+
+    _DTYPES[3] = np.dtype(ml_dtypes.bfloat16)
+except ImportError:  # pragma: no cover - ml_dtypes is a jax dependency
+    ml_dtypes = None
 _DTYPE_CODES = {v: k for k, v in _DTYPES.items()}
 _MAX_BLOB = 1 << 34  # 16 GiB sanity bound on advertised payload size
 
@@ -85,8 +91,15 @@ class PeerServer:
 
     def publish(self, vec: np.ndarray, clock: float, loss: float) -> None:
         vec = np.ascontiguousarray(vec)
-        dtype = vec.dtype.newbyteorder("<")
-        code = _DTYPE_CODES.get(np.dtype(dtype))
+        # Exact-dtype lookup first (covers bf16, whose custom numpy dtype
+        # has no byte-order variants), then the byte-order-normalized
+        # form, then an f32 fallback.
+        code = _DTYPE_CODES.get(vec.dtype)
+        if code is None:
+            try:
+                code = _DTYPE_CODES.get(np.dtype(vec.dtype.newbyteorder("<")))
+            except (TypeError, ValueError):  # pragma: no cover
+                code = None
         if code is None:
             vec = vec.astype("<f4")
             code = _DTYPE_CODES[np.dtype("<f4")]
@@ -171,6 +184,9 @@ class TcpTransport:
         self.me = config.node_index(name)
         self.schedule: Schedule = build_schedule(config)
         self.interp = make_interpolation(config.interpolation)
+        self._wire_bf16 = config.protocol.wire_dtype == "bf16"
+        if self._wire_bf16 and ml_dtypes is None:  # pragma: no cover
+            raise RuntimeError("wire_dtype bf16 requires ml_dtypes")
         spec = config.nodes[self.me]
         self.server = PeerServer(spec.host, spec.port)
         self._ports = {
@@ -187,6 +203,12 @@ class TcpTransport:
         self._ports[index] = (host, port)
 
     def publish(self, vec: np.ndarray, clock: float, loss: float) -> None:
+        # wire_dtype bf16: only the PUBLISHED (served) copy is compressed —
+        # half the wire bytes; the local replica stays f32 (mirrors the
+        # ICI transport, which casts the shipped copy before the
+        # collective).
+        if self._wire_bf16 and vec.dtype == np.float32:
+            vec = vec.astype(_DTYPES[3])
         self.server.publish(vec, clock, loss)
 
     def fetch(
@@ -215,6 +237,10 @@ class TcpTransport:
         local = PeerMeta(np.float32(clock), np.float32(loss))
         remote = PeerMeta(np.float32(remote_clock), np.float32(remote_loss))
         alpha = float(self.interp(local, remote))
+        if ml_dtypes is not None and remote_vec.dtype == _DTYPES[3]:
+            # bf16 off the wire: upcast once, merge in f32 (same math as
+            # the ICI transport's bf16-wire merge).
+            remote_vec = remote_vec.astype(np.float32)
         if vec.dtype == np.float32 and remote_vec.dtype == np.float32:
             # Native single-pass axpy (numpy takes three passes + temps).
             merged = native.merge_out(

@@ -336,9 +336,10 @@ def make_gossip_train_step_with_state(
     ``model_state`` is exchanged together with the (filtered) params —
     running statistics belong to the replica, so they merge with the same
     α — but the optimizer never sees it.  ``overlap`` as in
-    :func:`make_gossip_train_step` (model_state still ships post-update —
-    it is produced by the forward pass the collective overlaps with, and
-    running statistics carry no optimizer update to re-apply)."""
+    :func:`make_gossip_train_step`: the PRE-step model_state ships (the
+    post-step one is produced by the forward pass the collective must not
+    wait on) and this step's statistics delta is re-applied to the merged
+    result, mirroring the params' merge-then-update rule."""
     return _make_step(
         loss_fn, optimizer, transport, exchange_filter, with_state=True,
         overlap=overlap,

@@ -36,7 +36,12 @@ def main() -> None:
     ap.add_argument("--image-size", type=int, default=224)
     ap.add_argument("--lr", type=float, default=0.1)
     ap.add_argument("--bf16", action="store_true")
-    ap.add_argument("--synthetic", action="store_true")
+    ap.add_argument(
+        "--synthetic", action="store_true",
+        help="(implied) train on ImageNet-shaped synthetic data; this "
+        "example has no real-data loader — wire one through "
+        "dpwa_tpu.data.peer_batches when a dataset directory exists",
+    )
     ap.add_argument("--log-every", type=int, default=20)
     from dpwa_tpu.utils.launch import add_transport_args, build_transport
 

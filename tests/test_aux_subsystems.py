@@ -115,6 +115,41 @@ def test_checkpoint_roundtrip(tmp_path):
     )
 
 
+def test_checkpoint_restores_pre_loss_field_format(tmp_path):
+    """Checkpoints written before the state carried ``loss`` (round 1
+    format) must keep restoring: the missing optional field is backfilled
+    from ``like`` (and left defaulted without ``like``)."""
+    import orbax.checkpoint as ocp
+
+    from dpwa_tpu.checkpoint import restore_checkpoint
+    from dpwa_tpu.parallel.stacked import StackedTransport, init_stacked_state
+
+    n = 4
+    cfg = make_local_config(n, schedule="ring")
+    transport = StackedTransport(cfg)
+    stacked = {"w": jnp.arange(float(n))[:, None] * jnp.ones((n, 3))}
+    state = init_stacked_state(stacked, optax.sgd(0.1), transport)
+
+    # Simulate the old on-disk format: the state dict minus 'loss'.
+    old_format = dict(state._asdict())
+    del old_format["loss"]
+    ckpt_dir = str(tmp_path / "old_ckpt")
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(ckpt_dir, old_format, force=True)
+
+    restored = restore_checkpoint(ckpt_dir, like=state)
+    assert type(restored) is type(state)
+    np.testing.assert_array_equal(
+        np.asarray(restored.params["w"]), np.asarray(state.params["w"])
+    )
+    np.testing.assert_array_equal(  # backfilled from like
+        np.asarray(restored.loss), np.asarray(state.loss)
+    )
+    # Without like: the field stays at its class default.
+    bare = restore_checkpoint(ckpt_dir)
+    assert bare.loss is None
+
+
 def test_metrics_logger_jsonl(tmp_path):
     path = str(tmp_path / "metrics.jsonl")
     m = MetricsLogger(path=path, every=2)

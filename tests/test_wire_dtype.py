@@ -1,0 +1,136 @@
+"""bf16 wire format: `protocol.wire_dtype: bf16`.
+
+Only the SHIPPED replica is compressed — the collective (ICI), the gather
+emulation (stacked), and the TCP wire all move half the bytes; the local
+replica and the merge arithmetic stay f32.  The partner's contribution
+arrives bf16-rounded, scaled by alpha.  These tests pin the exact
+quantization semantics, cross-transport agreement, the wire size, and
+convergence under compression.
+"""
+
+import jax
+import jax.numpy as jnp
+import ml_dtypes
+import numpy as np
+import optax
+import pytest
+
+from dpwa_tpu.config import make_local_config
+from dpwa_tpu.interpolation import PeerMeta
+from dpwa_tpu.parallel.ici import IciTransport
+from dpwa_tpu.parallel.mesh import make_mesh
+from dpwa_tpu.parallel.stacked import StackedTransport
+from dpwa_tpu.parallel.tcp import TcpTransport
+
+N = 8
+
+
+def _payload(seed=0):
+    rng = np.random.default_rng(seed)
+    # Values with plenty of mantissa beyond bf16's 8 bits, so rounding is
+    # actually observable.
+    return rng.standard_normal((N, 256)).astype(np.float32) * 1.2345678
+
+
+def test_config_validates_wire_dtype():
+    with pytest.raises(ValueError):
+        make_local_config(4, wire_dtype="fp8")
+    cfg = make_local_config(4, wire_dtype="bf16")
+    assert cfg.protocol.wire_dtype == "bf16"
+
+
+def test_ici_bf16_wire_quantizes_remote_only():
+    cfg = make_local_config(N, schedule="ring", wire_dtype="bf16")
+    t = IciTransport(cfg, mesh=make_mesh(cfg))
+    x = _payload()
+    meta = PeerMeta(jnp.ones(N), jnp.ones(N))
+    merged, info = t.exchange({"w": jnp.asarray(x)}, meta, 0)
+    partner = np.asarray(info.partner)
+    remote = x[partner].astype(ml_dtypes.bfloat16).astype(np.float32)
+    expect = 0.5 * x + 0.5 * remote
+    np.testing.assert_allclose(
+        np.asarray(merged["w"]), expect, rtol=1e-6, atol=1e-7
+    )
+    # And it must NOT equal the exact-f32 merge (rounding is real).
+    exact = 0.5 * x + 0.5 * x[partner]
+    assert not np.allclose(np.asarray(merged["w"]), exact, atol=1e-7)
+
+
+def test_stacked_matches_ici_bf16():
+    cfg = make_local_config(
+        N, schedule="random", fetch_probability=0.6, wire_dtype="bf16"
+    )
+    x = _payload(seed=2)
+    meta = PeerMeta(jnp.ones(N), jnp.ones(N))
+    ici = IciTransport(cfg, mesh=make_mesh(cfg))
+    st = StackedTransport(cfg)
+    a, ia = ici.exchange({"w": jnp.asarray(x)}, meta, 5)
+    b, ib = st.exchange({"w": jnp.asarray(x)}, meta, 5)
+    np.testing.assert_array_equal(
+        np.asarray(ia.partner), np.asarray(ib.partner)
+    )
+    np.testing.assert_allclose(
+        np.asarray(a["w"]), np.asarray(b["w"]), rtol=1e-6, atol=1e-7
+    )
+
+
+def test_tcp_bf16_wire_roundtrip_and_merge():
+    cfg = make_local_config(
+        2, base_port=0, schedule="ring", wire_dtype="bf16"
+    )
+    ts = [TcpTransport(cfg, f"node{i}") for i in range(2)]
+    for t in ts:
+        for i, other in enumerate(ts):
+            t.set_peer_port(i, other.port)
+    try:
+        vecs = [_payload(seed=i)[0] for i in range(2)]
+        for i, t in enumerate(ts):
+            t.publish(vecs[i], 1.0, 0.5)
+        # The served blob is bf16: half the bytes, bf16 dtype on fetch.
+        got = ts[0].fetch(1)
+        assert got is not None
+        remote, clock, loss = got
+        assert remote.dtype == np.dtype(ml_dtypes.bfloat16)
+        assert remote.nbytes == vecs[1].nbytes // 2
+        merged, alpha, partner = ts[0].exchange(vecs[0], 2.0, 0.5, 0)
+        assert alpha == 0.5 and partner == 1
+        expect = 0.5 * vecs[0] + 0.5 * vecs[1].astype(
+            ml_dtypes.bfloat16
+        ).astype(np.float32)
+        np.testing.assert_allclose(merged, expect, rtol=1e-6, atol=1e-7)
+    finally:
+        for t in ts:
+            t.close()
+
+
+def test_bf16_wire_training_converges():
+    from dpwa_tpu.data import load_digits_dataset, peer_batches
+    from dpwa_tpu.models.mnist import SmallNet
+    from dpwa_tpu.parallel.stacked import (
+        init_stacked_state,
+        make_stacked_train_step,
+    )
+    from dpwa_tpu.train import make_gossip_eval_fn, stack_params
+
+    x_tr, y_tr, x_te, y_te = load_digits_dataset()
+    model = SmallNet()
+    params0 = model.init(jax.random.key(0), jnp.zeros((1, 8, 8, 1)))
+    cfg = make_local_config(N, schedule="ring", wire_dtype="bf16")
+    transport = StackedTransport(cfg)
+    opt = optax.sgd(0.05, momentum=0.9)
+    state = init_stacked_state(stack_params(params0, N), opt, transport)
+
+    def loss_fn(params, batch):
+        x, y = batch
+        logits = model.apply(params, x)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, y
+        ).mean()
+
+    step = make_stacked_train_step(loss_fn, opt, transport)
+    batches = peer_batches(x_tr, y_tr, N, 32, seed=0)
+    for _ in range(80):
+        state, _, _ = step(state, next(batches))
+    eval_fn = make_gossip_eval_fn(model.apply)
+    accs = np.asarray(eval_fn(state.params, x_te, y_te))
+    assert accs.min() > 0.85, accs
