@@ -15,7 +15,7 @@ Schema::
       - {name: node0, host: 127.0.0.1, port: 45000}
       - {name: node1, host: 127.0.0.1, port: 45001}
     protocol:
-      schedule: ring            # ring | random | hierarchical
+      schedule: ring            # ring | random | hierarchical | exponential
       mode: pairwise            # pairwise (mutual merge) | pull (one-sided)
       fetch_probability: 1.0    # per-step chance a pair actually exchanges
       timeout_ms: 500           # TCP transport only: fetch timeout
@@ -23,6 +23,8 @@ Schema::
       pool_size: 16             # random schedule: # static pairings compiled
       group_size: 0             # hierarchical: peers per host group (0 = auto)
       inter_period: 4           # hierarchical: cross-group exchange cadence
+      drop_probability: 0.0     # fault injection: drop pairs at this rate
+      wire_dtype: f32           # f32 | bf16 (shipped replica compressed)
     interpolation:
       type: constant            # constant | clock | loss
       factor: 0.5               # constant alpha (0.5 == (local+remote)/2)
@@ -73,7 +75,9 @@ class ProtocolConfig:
             raise ValueError(
                 f"drop_probability must be in [0, 1], got {self.drop_probability}"
             )
-        if self.schedule not in ("ring", "random", "hierarchical"):
+        if self.schedule not in (
+            "ring", "random", "hierarchical", "exponential"
+        ):
             raise ValueError(f"unknown schedule {self.schedule!r}")
         if self.mode not in ("pairwise", "pull"):
             raise ValueError(f"unknown protocol mode {self.mode!r}")

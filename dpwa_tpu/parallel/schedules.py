@@ -130,6 +130,25 @@ def _ring_pull(n: int, phase: int) -> np.ndarray:
     return (np.arange(n) + (1 if phase % 2 == 0 else -1)) % n
 
 
+def _exponential_pool(n: int) -> np.ndarray:
+    """Hypercube (recursive-doubling) pool: slot k pairs ``i ↔ i XOR 2^k``.
+
+    The fastest-mixing pairing sequence there is: with α = 0.5 and full
+    participation, one pass over the log2(n) slots IS an exact all-reduce
+    — every replica equals the global mean after log2(n) pairwise merges
+    (each slot averages across one hypercube dimension; property-tested).
+    Under probabilistic participation it degrades gracefully to gossip
+    with an O(log n) mixing time, vs O(n²) for the ring.  XOR pairings
+    are involutions by construction.  Requires n a power of two."""
+    if n & (n - 1) != 0:
+        raise ValueError(
+            f"exponential schedule needs a power-of-two peer count, got {n}"
+        )
+    bits = n.bit_length() - 1
+    idx = np.arange(n)
+    return np.stack([idx ^ (1 << k) for k in range(bits)])
+
+
 def _random_pull(n: int, rng: np.random.Generator) -> np.ndarray:
     """Random pull map: every peer pulls a distinct source != itself.
 
@@ -312,6 +331,11 @@ def build_schedule(config: DpwaConfig) -> Schedule:
         elif proto.schedule == "hierarchical":
             group = proto.group_size or _auto_group_size(n)
             pool = _hierarchical_pull_pool(n, group, max(2, proto.inter_period))
+        elif proto.schedule == "exponential":
+            # XOR pairings are their own pull maps (involutions with no
+            # fixed points) — identical pool in both modes; only the
+            # participation-draw keying differs.
+            pool = _exponential_pool(n)
         else:  # pragma: no cover - config validates earlier
             raise ValueError(proto.schedule)
     elif proto.schedule == "ring":
@@ -324,6 +348,8 @@ def build_schedule(config: DpwaConfig) -> Schedule:
     elif proto.schedule == "hierarchical":
         group = proto.group_size or _auto_group_size(n)
         pool = _hierarchical_pool(n, group, max(2, proto.inter_period))
+    elif proto.schedule == "exponential":
+        pool = _exponential_pool(n)
     else:  # pragma: no cover - config validates earlier
         raise ValueError(proto.schedule)
     pool = pool.astype(np.int32)

@@ -1,0 +1,84 @@
+"""The exponential schedule's defining property: gossip = all-reduce.
+
+With ``schedule: exponential``, α = 0.5, and full participation, one pass
+over the log2(n) pool slots is recursive doubling — after slot k every
+replica equals the mean of its 2^(k+1)-sized hypercube face, and after the
+full period EVERY replica equals the global mean exactly.  The reference
+has nothing like this (ring mixes in O(n²) rounds); it is what pairwise
+averaging looks like when designed around a fabric instead of sockets.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dpwa_tpu.config import make_local_config
+from dpwa_tpu.interpolation import PeerMeta
+from dpwa_tpu.parallel.ici import IciTransport
+from dpwa_tpu.parallel.mesh import make_mesh
+from dpwa_tpu.parallel.stacked import StackedTransport
+
+N = 8
+
+
+def _transport(kind, cfg):
+    if kind == "ici":
+        return IciTransport(cfg, mesh=make_mesh(cfg))
+    return StackedTransport(cfg)
+
+
+@pytest.mark.parametrize("kind", ["ici", "stacked"])
+def test_full_period_equals_allreduce(kind):
+    cfg = make_local_config(N, schedule="exponential", factor=0.5)
+    t = _transport(kind, cfg)
+    rng = np.random.default_rng(0)
+    x0 = rng.standard_normal((N, 33)).astype(np.float32)
+    params = {"w": jnp.asarray(x0)}
+    meta = PeerMeta(jnp.ones(N), jnp.ones(N))
+    for step in range(t.schedule.pool_size):
+        params, info = t.exchange(params, meta, step)
+        assert np.asarray(info.participated).all()
+    mean = x0.mean(axis=0)
+    out = np.asarray(params["w"])
+    for i in range(N):
+        np.testing.assert_allclose(out[i], mean, rtol=1e-5, atol=1e-6)
+
+
+def test_partial_period_averages_hypercube_faces():
+    cfg = make_local_config(N, schedule="exponential", factor=0.5)
+    t = _transport("stacked", cfg)
+    x0 = np.arange(N, dtype=np.float32)[:, None] * np.ones(
+        (N, 4), np.float32
+    )
+    params = {"w": jnp.asarray(x0)}
+    meta = PeerMeta(jnp.ones(N), jnp.ones(N))
+    # After slot 0 (pairs i ^ 1): replicas equal their pair mean.
+    params, _ = t.exchange(params, meta, 0)
+    out = np.asarray(params["w"])[:, 0]
+    np.testing.assert_allclose(
+        out, np.repeat([0.5, 2.5, 4.5, 6.5], 2), rtol=1e-6
+    )
+    # After slot 1 (pairs i ^ 2): means over aligned groups of 4.
+    params, _ = t.exchange(params, meta, 1)
+    out = np.asarray(params["w"])[:, 0]
+    np.testing.assert_allclose(out, np.repeat([1.5, 5.5], 4), rtol=1e-6)
+
+
+def test_exponential_mixes_faster_than_ring():
+    """Consensus error after log2(n) rounds: exponential reaches exact
+    consensus; the ring provably cannot (information has only traveled
+    log2(n) hops)."""
+    rng = np.random.default_rng(1)
+    x0 = rng.standard_normal((N, 16)).astype(np.float32)
+    meta = PeerMeta(jnp.ones(N), jnp.ones(N))
+    spreads = {}
+    for schedule in ("exponential", "ring"):
+        cfg = make_local_config(N, schedule=schedule, factor=0.5)
+        t = _transport("stacked", cfg)
+        params = {"w": jnp.asarray(x0)}
+        for step in range(3):  # log2(8) rounds
+            params, _ = t.exchange(params, meta, step)
+        spreads[schedule] = float(np.asarray(params["w"]).std(axis=0).max())
+    assert spreads["exponential"] < 1e-6
+    assert spreads["ring"] > 100 * max(spreads["exponential"], 1e-12)

@@ -170,3 +170,27 @@ def test_hierarchical_pull_structure():
     for slot in range(3):
         assert np.all(groups[sched.pool[slot]] == groups)  # intra-group
     assert np.all(groups[sched.pool[3]] != groups)  # inter-group slot
+
+
+def test_exponential_pool_is_hypercube():
+    sched = build_schedule(make_local_config(8, schedule="exponential"))
+    assert sched.pool_size == 3  # log2(8)
+    idx = np.arange(8)
+    for k, perm in enumerate(sched.pool):
+        np.testing.assert_array_equal(perm, idx ^ (1 << k))
+        assert np.all(perm != idx)  # no fixed points ever
+
+
+def test_exponential_requires_power_of_two():
+    import pytest
+
+    with pytest.raises(ValueError, match="power-of-two"):
+        build_schedule(make_local_config(6, schedule="exponential"))
+
+
+def test_exponential_pull_mode_same_pool():
+    pairwise = build_schedule(make_local_config(8, schedule="exponential"))
+    pull = build_schedule(
+        make_local_config(8, schedule="exponential", mode="pull")
+    )
+    np.testing.assert_array_equal(pairwise.pool, pull.pool)
