@@ -37,6 +37,12 @@ class LlamaConfig:
     lora_rank: int = 0  # 0 = no LoRA
     lora_alpha: float = 16.0
     dtype: jnp.dtype = jnp.float32
+    # Sequence-parallel: name of the mesh axis the sequence is sharded
+    # over.  When set, the model must run INSIDE shard_map over that axis
+    # (each device holds a contiguous T_local block); attention becomes
+    # exact ring attention over the axis and rope positions are globally
+    # offset by the device's block index.  None = single-device attention.
+    sp_axis: Optional[str] = None
 
     @property
     def kv_heads(self) -> int:
@@ -147,6 +153,20 @@ class Attention(nn.Module):
         v = dense(KV * D, "wv")(x).reshape(B, T, KV, D)
         q = rope(q, positions, cfg.rope_theta)
         k = rope(k, positions, cfg.rope_theta)
+        if cfg.sp_axis is not None:
+            # Sequence-parallel: exact ring attention over the sp mesh
+            # axis — K/V blocks rotate by ppermute, online softmax
+            # accumulates; causality is enforced on GLOBAL positions
+            # inside the kernel (long-context path; SURVEY.md §5).  K/V
+            # stay GROUPED (KV heads) through the ring — expanded per
+            # block inside the kernel — so GQA's bandwidth saving holds
+            # on the fabric.
+            from dpwa_tpu.ops.ring_attention import ring_attention_local
+
+            out = ring_attention_local(
+                q, k, v, axis_name=cfg.sp_axis, causal=True
+            ).reshape(B, T, H * D)
+            return dense(cfg.d_model, "wo")(out)
         if KV != H:  # GQA: repeat kv heads
             rep = H // KV
             k = jnp.repeat(k, rep, axis=2)
@@ -203,6 +223,10 @@ class Llama(nn.Module):
             cfg.vocab_size, cfg.d_model, dtype=cfg.dtype, name="embed"
         )(tokens)
         positions = jnp.arange(T)
+        if cfg.sp_axis is not None:
+            # Inside shard_map: ``tokens`` is this device's contiguous
+            # sequence block; rope needs the GLOBAL positions.
+            positions = positions + jax.lax.axis_index(cfg.sp_axis) * T
         for i in range(cfg.n_layers):
             x = Block(cfg, name=f"layer_{i}")(x, positions)
         x = RMSNorm(dtype=cfg.dtype, name="final_norm")(x)

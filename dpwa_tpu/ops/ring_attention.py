@@ -27,7 +27,16 @@ from jax import lax
 
 def _block_attn(q, k, v, scale, qpos, kpos, causal):
     """One Q-block × K-block partial attention. Returns (scores_max, exp
-    scores @ v, exp scores row-sums)."""
+    scores @ v, exp scores row-sums).
+
+    Grouped-query attention: ``k``/``v`` may carry fewer heads than ``q``
+    (H % KV == 0); they are expanded HERE, per block, so the ring carries
+    (and each hop ppermutes) only the small grouped K/V — GQA's whole
+    point on a long-context fabric."""
+    if k.shape[2] != q.shape[2]:
+        rep = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
     s = jnp.einsum("bthd,bshd->bhts", q, k) * scale
     if causal:
         mask = kpos[None, None, None, :] <= qpos[None, None, :, None]

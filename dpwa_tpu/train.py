@@ -253,10 +253,9 @@ def _make_step(
         )
         return new_state, losses, ExchangeInfo(*info)
 
-    # Same CPU run-ahead bound as IciTransport.exchange: the in-process
-    # collective rendezvous deadlocks a thread-starved host if many steps'
-    # collectives are in flight.  TPU meshes stay fully async.
-    block_per_call = all(d.platform == "cpu" for d in mesh.devices.flat)
+    # Same CPU run-ahead bound as IciTransport.exchange (see the rationale
+    # comment there) — reuse its detection so the rule lives in one place.
+    block_per_call = transport._block_per_call
 
     def train_step(state: GossipTrainState, batch):
         if not with_state and state.model_state is not None:

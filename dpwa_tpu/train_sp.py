@@ -1,0 +1,200 @@
+"""Gossip + sequence-parallel training: one SPMD program on a 2-D mesh.
+
+Long-context is first-class (SURVEY.md §5): a ``(peers, sp)`` mesh runs
+gossip data-parallelism across replicas while EACH replica's sequences
+span its ``sp`` sub-axis via exact ring attention
+(:mod:`dpwa_tpu.ops.ring_attention`).  The whole step — sp-sharded
+forward/backward (ring-attention ppermutes inside), gradient ``psum``
+over ``sp``, optax update, and the gossip ``ppermute`` over ``peers`` —
+is ONE ``shard_map`` program.  Layout:
+
+- params: ``P(peers)`` — sharded over replicas, replicated over ``sp``;
+- batch:  ``[n_peers, B, T]`` with ``P(peers, None, sp)`` — every device
+  holds its replica's contiguous sequence block;
+- collectives: ring-attention ``ppermute`` + gradient ``psum`` ride the
+  ``sp`` sub-axis (ICI-local when sp maps to intra-host chips), the
+  pairing ``ppermute`` rides ``peers``.
+
+The gossip semantics (schedule pools, participation/fault draws,
+interpolation, pull mode, bf16 wire) are exactly
+:func:`dpwa_tpu.parallel.ici.gossip_exchange_local` — replicated over the
+``sp`` axis, every sp rank of a replica executes the identical exchange.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import lax, shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dpwa_tpu.config import DpwaConfig
+from dpwa_tpu.interpolation import PeerMeta
+from dpwa_tpu.parallel.ici import (
+    ExchangeInfo,
+    IciTransport,
+    gossip_exchange_local,
+)
+from dpwa_tpu.parallel.mesh import PEER_AXIS
+from dpwa_tpu.train import GossipTrainState
+
+PyTree = Any
+SP_AXIS = "sp"
+
+# loss_fn(single_replica_params, local_batch_block) -> (loss_sum, count):
+# the SUM of token losses over this device's sequence block and the
+# number of tokens it covers; the step psums both over ``sp``.
+SpLossFn = Callable[[PyTree, Any], Tuple[jnp.ndarray, jnp.ndarray]]
+
+
+def make_sp_mesh(
+    config: DpwaConfig, sp: int, devices=None, sp_axis: str = SP_AXIS
+) -> Mesh:
+    """A ``(peers, sp)`` mesh: ``len(config.nodes) * sp`` devices.
+
+    The sp axis is innermost, so a replica's sequence blocks sit on
+    CONTIGUOUS devices — on real hardware that keeps the per-hop
+    ring-attention ppermute on neighboring chips (ICI)."""
+    n = config.n_peers
+    if devices is None:
+        devices = jax.devices()
+    if len(devices) < n * sp:
+        raise RuntimeError(
+            f"(peers={n}) x (sp={sp}) needs {n * sp} devices, have "
+            f"{len(devices)}"
+        )
+    arr = np.asarray(devices[: n * sp]).reshape(n, sp)
+    return Mesh(arr, (PEER_AXIS, sp_axis))
+
+
+def init_gossip_sp_state(
+    stacked_params: PyTree,
+    optimizer: optax.GradientTransformation,
+    transport: IciTransport,
+) -> GossipTrainState:
+    """Identical to :func:`dpwa_tpu.train.init_gossip_state` — the peer
+    sharding on a 2-D mesh replicates every leaf over ``sp`` for free."""
+    from dpwa_tpu.train import init_gossip_state
+
+    return init_gossip_state(stacked_params, optimizer, transport)
+
+
+def make_gossip_sp_train_step(
+    loss_fn: SpLossFn,
+    optimizer: optax.GradientTransformation,
+    transport: IciTransport,
+    sp_axis: str = SP_AXIS,
+):
+    """Jitted ``train_step(state, batch) -> (state, losses, info)`` on a
+    ``(peers, sp)`` mesh.
+
+    ``transport`` must be an :class:`IciTransport` built over a 2-D mesh
+    from :func:`make_sp_mesh`.  ``batch`` is ``(inputs, targets)`` of
+    shape ``[n_peers, B, T]`` (the host pre-shifts targets, so block
+    boundaries need no cross-shard fix-up); ``T`` is sharded over ``sp``.
+    ``losses`` is the per-replica mean token loss, float32[n_peers].
+    """
+    mesh, peers_axis = transport.mesh, transport.axis_name
+    if sp_axis not in mesh.shape:
+        raise ValueError(
+            f"transport mesh {dict(mesh.shape)} has no {sp_axis!r} axis; "
+            "build it with make_sp_mesh"
+        )
+    schedule, interp = transport.schedule, transport.interp
+    grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+    shard = lambda t: jax.tree.map(lambda v: v[0], t)
+    unshard = lambda t: jax.tree.map(lambda v: v[None], t)
+
+    def body(params, opt_state, clock, step, batch):
+        params, opt_state = shard(params), shard(opt_state)
+        inputs, targets = jax.tree.map(lambda v: v[0], batch)
+        (loss_sum, count), grads = grad_fn(params, (inputs, targets))
+        # NO manual psum on grads: ``params`` enter replicated over
+        # ``sp`` (spec names only ``peers``), and the transpose rule for
+        # a replicated operand ALREADY sums its cotangents across the
+        # axis — ``grads`` comes back sp-invariant and equal to
+        # d(sum of all blocks' losses)/d(params).  (Ring-attention
+        # cross-block terms flow through the transposed ppermutes.)  A
+        # manual psum here would multiply the gradient by sp.
+        loss_sum = lax.psum(loss_sum, sp_axis)
+        count = lax.psum(count, sp_axis)
+        loss = (loss_sum / jnp.maximum(count, 1.0)).astype(jnp.float32)
+        grads = jax.tree.map(
+            lambda g: g / jnp.maximum(count, 1.0).astype(g.dtype), grads
+        )
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        clock = clock[0] + 1.0
+        meta = PeerMeta(clock, loss)
+        # Gossip across replicas: every sp rank of a replica holds the
+        # identical post-update params and runs the identical ppermute
+        # over ``peers`` — the exchange stays sp-replicated by
+        # construction.
+        merged, (partner, alpha, part) = gossip_exchange_local(
+            params, meta, step,
+            schedule=schedule, interp=interp, axis_name=peers_axis,
+        )
+        return (
+            unshard(merged),
+            unshard(opt_state),
+            clock[None],
+            loss[None],
+            (partner[None], alpha[None], part[None]),
+        )
+
+    batch_spec = P(peers_axis, None, sp_axis)
+    mapped = shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(
+            P(peers_axis),
+            P(peers_axis),
+            P(peers_axis),
+            P(),
+            (batch_spec, batch_spec),
+        ),
+        out_specs=(
+            P(peers_axis),
+            P(peers_axis),
+            P(peers_axis),
+            P(peers_axis),
+            (P(peers_axis), P(peers_axis), P(peers_axis)),
+        ),
+    )
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def _step(state: GossipTrainState, batch):
+        params, opt_state, clock, losses, info = mapped(
+            state.params, state.opt_state, state.clock, state.step, batch
+        )
+        new_state = GossipTrainState(
+            params=params,
+            opt_state=opt_state,
+            clock=clock,
+            step=state.step + 1,
+            model_state=state.model_state,
+            loss=losses,
+        )
+        return new_state, losses, ExchangeInfo(*info)
+
+    # CPU run-ahead bound: reuse the transport's detection (see the
+    # rationale comment in IciTransport.__init__).
+    block_per_call = transport._block_per_call
+
+    def train_step(state: GossipTrainState, batch):
+        out = _step(state, batch)
+        if block_per_call:
+            jax.block_until_ready(out)
+        return out
+
+    return train_step
+
+
+def sp_batch_sharding(mesh: Mesh, sp_axis: str = SP_AXIS) -> NamedSharding:
+    """Sharding for ``[n_peers, B, T]`` batches: peers x sequence blocks."""
+    return NamedSharding(mesh, P(PEER_AXIS, None, sp_axis))

@@ -117,3 +117,19 @@ def test_composes_with_gossip_peer_axis():
     np.testing.assert_allclose(
         np.asarray(out[1]), np.asarray(merged), rtol=2e-4, atol=2e-5
     )
+
+
+def test_grouped_kv_matches_repeated():
+    """GQA: grouped K/V stay small through the ring (expanded per block
+    inside the kernel) and must equal attention over pre-repeated K/V."""
+    B, T, H, KV, D = 2, 32, 8, 2, 16
+    ks = jax.random.split(jax.random.key(5), 3)
+    q = jax.random.normal(ks[0], (B, T, H, D), jnp.float32)
+    k = jax.random.normal(ks[1], (B, T, KV, D), jnp.float32)
+    v = jax.random.normal(ks[2], (B, T, KV, D), jnp.float32)
+    mesh = sp_mesh(4)
+    got = np.asarray(ring_attention(q, k, v, mesh))
+    k_rep = jnp.repeat(k, H // KV, axis=2)
+    v_rep = jnp.repeat(v, H // KV, axis=2)
+    want = np.asarray(full_attention_reference(q, k_rep, v_rep))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
