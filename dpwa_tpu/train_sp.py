@@ -187,6 +187,15 @@ def make_gossip_sp_train_step(
     block_per_call = transport._block_per_call
 
     def train_step(state: GossipTrainState, batch):
+        if state.model_state is not None:
+            # Same misuse guard as the 1-D step factories: this step
+            # would neither update nor exchange model_state, silently
+            # freezing BatchNorm-style statistics at init.
+            raise ValueError(
+                "state carries model_state but the sp train step does not "
+                "support non-parameter model variables yet; use a "
+                "stateless model (e.g. GroupNorm/RMSNorm) on the sp path"
+            )
         out = _step(state, batch)
         if block_per_call:
             jax.block_until_ready(out)

@@ -153,3 +153,15 @@ def test_sp_rope_positions_are_global():
     np.testing.assert_allclose(
         np.asarray(out[0]), np.asarray(want), rtol=2e-3, atol=2e-3
     )
+
+
+def test_sp_step_rejects_model_state():
+    cfg = make_local_config(N_PEERS, schedule="ring")
+    mesh = make_sp_mesh(cfg, SP)
+    t = IciTransport(cfg, mesh=mesh)
+    opt = optax.sgd(0.1)
+    state = init_gossip_sp_state(_init_params(), opt, t)
+    state = state._replace(model_state={"stats": jnp.zeros(3)})
+    step = make_gossip_sp_train_step(lambda p, b: (0.0, 1.0), opt, t)
+    with pytest.raises(ValueError, match="model_state"):
+        step(state, (jnp.zeros((N_PEERS, B, T), jnp.int32),) * 2)
