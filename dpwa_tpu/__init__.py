@@ -65,6 +65,12 @@ def __getattr__(name):
         ),
         "init_gossip_state": ("dpwa_tpu.train", "init_gossip_state"),
         "GossipTrainState": ("dpwa_tpu.train", "GossipTrainState"),
+        # Long-context 2-D (peers x sp) training.
+        "make_gossip_sp_train_step": (
+            "dpwa_tpu.train_sp", "make_gossip_sp_train_step",
+        ),
+        "make_sp_mesh": ("dpwa_tpu.train_sp", "make_sp_mesh"),
+        "ring_attention": ("dpwa_tpu.ops.ring_attention", "ring_attention"),
     }
     if name in lazy:
         import importlib

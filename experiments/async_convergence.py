@@ -38,7 +38,14 @@ import sys
 import time
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-ART_DIR = os.path.join(REPO_ROOT, "artifacts", "async_convergence")
+# Variant runs (e.g. the bf16-wire validation) redirect artifacts and set
+# the wire dtype through the environment so every spawned leg inherits
+# them; the committed default study uses f32 + the default dir.
+WIRE_DTYPE = os.environ.get("DPWA_EXP_WIRE_DTYPE", "f32")
+ART_DIR = os.environ.get(
+    "DPWA_EXP_ART_DIR",
+    os.path.join(REPO_ROOT, "artifacts", "async_convergence"),
+)
 if REPO_ROOT not in sys.path:  # direct-script invocation from anywhere
     sys.path.insert(0, REPO_ROOT)
 
@@ -71,6 +78,7 @@ def experiment_config(seed: int, base_port: int = 0):
         pool_size=POOL_SIZE,
         base_port=base_port,
         timeout_ms=2000,
+        wire_dtype=WIRE_DTYPE,
     )
 
 
@@ -179,6 +187,7 @@ def tcp_worker(args) -> int:
                     "acc": float(accuracy(params)),
                     "alpha": float(alpha),
                     "partner": int(partner),
+                    "wire": WIRE_DTYPE,
                 }
             )
         if JITTER_MS > 0:
@@ -332,6 +341,7 @@ def run_spmd(transport_kind: str, seed: int, steps: int) -> None:
                         "acc": float(accs[i]),
                         "alpha": float(alphas[i]),
                         "partner": int(partners[i]),
+                        "wire": WIRE_DTYPE,
                     }
                 )
     os.makedirs(ART_DIR, exist_ok=True)
@@ -350,6 +360,7 @@ def analyze() -> dict:
     import numpy as np
 
     runs = {}  # (mode, seed) -> {step -> [accs]}
+    wires = set()
     for name in sorted(os.listdir(ART_DIR)):
         if not name.startswith("run_") or not name.endswith(".jsonl"):
             continue
@@ -357,6 +368,8 @@ def analyze() -> dict:
             for line in f:
                 r = json.loads(line)
                 key = (r["mode"], r["seed"])
+                # Pre-field records were all produced with the f32 wire.
+                wires.add(r.get("wire", "f32"))
                 runs.setdefault(key, {}).setdefault(r["step"], []).append(
                     r["acc"]
                 )
@@ -386,6 +399,10 @@ def analyze() -> dict:
             "fetch_probability": FETCH_P,
             "steps": actual_steps,
             "tcp_jitter_ms": JITTER_MS,
+            # Provenance comes from the RECORDS, not this process's env.
+            "wire_dtype": sorted(wires)[0]
+            if len(wires) == 1
+            else f"MIXED: {sorted(wires)}",
         },
         "seeds": seeds,
         "modes": {},
@@ -454,6 +471,11 @@ def main() -> int:
     r.add_argument("--modes", default="tcp,ici,stacked")
     r.add_argument("--seeds", default="0,1,2")
     r.add_argument("--steps", type=int, default=STEPS)
+    r.add_argument(
+        "--wire-dtype", choices=("f32", "bf16"), default=None,
+        help="bf16 runs the whole study with the compressed wire and "
+        "writes artifacts to artifacts/async_convergence_bf16w/",
+    )
 
     s = sub.add_parser("spmd")
     s.add_argument("--transport", choices=("ici", "stacked"), required=True)
@@ -475,6 +497,17 @@ def main() -> int:
     # run: each (mode, seed) leg in its own subprocess so jax's frozen
     # platform/device-count choices never leak across legs.
     from dpwa_tpu.utils.launch import child_process_env
+
+    if args.wire_dtype is not None:
+        global WIRE_DTYPE, ART_DIR
+        WIRE_DTYPE = args.wire_dtype
+        os.environ["DPWA_EXP_WIRE_DTYPE"] = args.wire_dtype
+        if args.wire_dtype != "f32":
+            ART_DIR = os.path.join(
+                REPO_ROOT, "artifacts",
+                f"async_convergence_{args.wire_dtype}w",
+            )
+            os.environ["DPWA_EXP_ART_DIR"] = ART_DIR
 
     env = child_process_env(REPO_ROOT)
     for seed in [int(x) for x in args.seeds.split(",")]:
