@@ -69,7 +69,14 @@ def __getattr__(name):
         "make_gossip_sp_train_step": (
             "dpwa_tpu.train_sp", "make_gossip_sp_train_step",
         ),
+        "make_gossip_sp_train_step_with_state": (
+            "dpwa_tpu.train_sp", "make_gossip_sp_train_step_with_state",
+        ),
+        "init_gossip_sp_state": ("dpwa_tpu.train_sp", "init_gossip_sp_state"),
         "make_sp_mesh": ("dpwa_tpu.train_sp", "make_sp_mesh"),
+        "PeerBatchStream": ("dpwa_tpu.data", "PeerBatchStream"),
+        "save_checkpoint": ("dpwa_tpu.checkpoint", "save_checkpoint"),
+        "restore_checkpoint": ("dpwa_tpu.checkpoint", "restore_checkpoint"),
         "ring_attention": ("dpwa_tpu.ops.ring_attention", "ring_attention"),
     }
     if name in lazy:

@@ -14,6 +14,7 @@ canonical copy."""
 
 from __future__ import annotations
 
+import json
 import os
 from typing import Any, Optional
 
@@ -32,7 +33,13 @@ PyTree = Any
 _OPTIONAL_FIELDS = ("loss",)
 
 
-def save_checkpoint(path: str, state) -> None:
+def _data_state_path(path: str) -> str:
+    """Sidecar for the data-stream state — a sibling of the Orbax dir
+    (never inside it: Orbax owns that directory's contents)."""
+    return path.rstrip(os.sep) + "-data.json"
+
+
+def save_checkpoint(path: str, state, data_stream=None) -> None:
     """Atomically save a training state to ``path`` (a directory).
 
     Accepts either peer-layout: :class:`~dpwa_tpu.train.GossipTrainState`
@@ -40,13 +47,42 @@ def save_checkpoint(path: str, state) -> None:
     :class:`~dpwa_tpu.parallel.stacked.StackedTrainState` (single-device
     virtual peers) — both carry the same fields, so a run can even be
     saved from one layout and resumed in the other (pass the matching
-    ``like`` at restore)."""
+    ``like`` at restore).
+
+    ``data_stream`` (anything with ``state_dict()``, e.g.
+    :class:`~dpwa_tpu.data.PeerBatchStream`) additionally captures the
+    per-peer dataset cursor/RNG position in a JSON sidecar next to the
+    Orbax directory, so a resumed run replays the EXACT batch sequence —
+    without it, saving ``step`` pins the exchange schedule but the data
+    trajectory diverges on resume."""
     path = os.path.abspath(path)
+    sidecar = _data_state_path(path)
+    if os.path.exists(sidecar):
+        # Drop any PREVIOUS save's sidecar up front — also before a save
+        # WITH a stream, so a crash between the Orbax write and the new
+        # sidecar write fails safe (restore raises FileNotFoundError)
+        # instead of pairing the new state with a stale stream position
+        # and silently replaying the wrong batches.
+        os.remove(sidecar)
     with ocp.StandardCheckpointer() as ckptr:
         ckptr.save(path, dict(state._asdict()), force=True)
+    if data_stream is not None:
+        tmp = sidecar + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(data_stream.state_dict(), f)
+        os.replace(tmp, sidecar)  # atomic write
 
 
-def restore_checkpoint(path: str, like: Optional[Any] = None):
+def _saved_keys(ckptr, path) -> Optional[set]:
+    """Top-level keys recorded in the checkpoint's metadata, or None if
+    the metadata cannot be read (older Orbax layouts)."""
+    try:
+        return set(ckptr.metadata(path).item_metadata.tree.keys())
+    except Exception:
+        return None
+
+
+def restore_checkpoint(path: str, like: Optional[Any] = None, data_stream=None):
     """Restore a state saved by :func:`save_checkpoint`.
 
     ``like`` (same treedef/shapes/shardings as the saved state) restores
@@ -56,7 +92,12 @@ def restore_checkpoint(path: str, like: Optional[Any] = None):
     checkpoint (the file records no layout; the two state classes carry
     identical fields).  To re-label, rewrap:
     ``StackedTrainState(**restored._asdict())``.  Pass ``like`` whenever
-    the class identity matters."""
+    the class identity matters.
+
+    ``data_stream`` (``load_state_dict()``-capable): restore the dataset
+    position saved alongside this checkpoint.  Raises if the checkpoint
+    has no data sidecar — silently continuing with a fresh stream would
+    replay different batches, the exact bug the sidecar exists to stop."""
     path = os.path.abspath(path)
     with ocp.StandardCheckpointer() as ckptr:
         if like is not None:
@@ -66,22 +107,29 @@ def restore_checkpoint(path: str, like: Optional[Any] = None):
             # Fields added to the state AFTER a checkpoint was written
             # (round 2 added per-peer ``loss``) are absent from old saves,
             # and Orbax refuses a target whose structure disagrees with
-            # the save.  On mismatch, retry with the optional fields
-            # dropped from the target and backfill them from ``like``, so
-            # old checkpoints keep restoring.
+            # the save.  Before retrying, check the save's OWN metadata:
+            # only a genuinely absent optional field justifies dropping it
+            # from the target — any other mismatch re-raises the original
+            # Orbax diagnostic untouched (an unrelated error retried
+            # against a mutated target would mask it).
             try:
                 restored = ckptr.restore(path, target)
-            except (ValueError, KeyError):
-                backfill = {
-                    f: getattr(like, f)
+            except (ValueError, KeyError) as first_err:
+                saved = _saved_keys(ckptr, path)
+                missing = [
+                    f
                     for f in _OPTIONAL_FIELDS
-                    if f in target
-                }
-                if not backfill:
+                    if f in target and (saved is None or f not in saved)
+                ]
+                if not missing:
                     raise
-                for f in backfill:
+                backfill = {f: getattr(like, f) for f in missing}
+                for f in missing:
                     del target[f]
-                restored = ckptr.restore(path, target)
+                try:
+                    restored = ckptr.restore(path, target)
+                except (ValueError, KeyError):
+                    raise first_err from None
                 restored.update(backfill)
             # ``step`` is a host-scalar in spirit: leave it uncommitted so
             # it can join a jitted computation under ANY sharding layout (a
@@ -92,6 +140,16 @@ def restore_checkpoint(path: str, like: Optional[Any] = None):
             restored["step"] = jnp.asarray(np.asarray(restored["step"]))
         else:
             restored = ckptr.restore(path)
+    if data_stream is not None:
+        sidecar = _data_state_path(path)
+        if not os.path.exists(sidecar):
+            raise FileNotFoundError(
+                f"checkpoint {path} has no data-stream sidecar ({sidecar}); "
+                "it was saved without data_stream= — resuming this stream "
+                "would replay different batches"
+            )
+        with open(sidecar) as f:
+            data_stream.load_state_dict(json.load(f))
     cls = type(like) if like is not None else GossipTrainState
     # Old checkpoints simply lack optional fields here; the state classes
     # default them (loss=None is accepted by both train steps).

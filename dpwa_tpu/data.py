@@ -101,34 +101,113 @@ def peer_split(
     return xs, ys
 
 
+class PeerBatchStream:
+    """Endless stream of peer-stacked batches ``([n, b, ...], [n, b])``.
+
+    Each peer cycles its own shard with an independent shuffle — the
+    SPMD stand-in for the reference's N independent data loaders.
+
+    The stream is **checkpointable**: :meth:`state_dict` captures every
+    peer's RNG state and epoch cursor (JSON-serializable), and
+    :meth:`load_state_dict` restores them, so a resumed run reproduces
+    the original batch sequence exactly — the data-side counterpart of
+    saving the gossip schedule position (``GossipTrainState.step``).
+    The dataset itself is not saved; reconstruct the stream with the
+    same ``(x, y, n_peers, batch_size, seed)`` before restoring."""
+
+    def __init__(
+        self,
+        x: Array,
+        y: Array,
+        n_peers: int,
+        batch_size: int,
+        seed: int = 0,
+    ):
+        self.n_peers = n_peers
+        self.batch_size = batch_size
+        self.xs, self.ys = peer_split(x, y, n_peers, seed)
+        self._rngs = [
+            np.random.default_rng(seed + 1000 + i) for i in range(n_peers)
+        ]
+        self._cursors = [np.array([], dtype=np.int64)] * n_peers
+        self.batch_count = 0
+
+    def __iter__(self) -> "PeerBatchStream":
+        return self
+
+    def __next__(self) -> Tuple[Array, Array]:
+        bx, by = [], []
+        for i in range(self.n_peers):
+            while len(self._cursors[i]) < self.batch_size:
+                self._cursors[i] = np.concatenate(
+                    [self._cursors[i], self._rngs[i].permutation(len(self.xs[i]))]
+                )
+            take, self._cursors[i] = (
+                self._cursors[i][: self.batch_size],
+                self._cursors[i][self.batch_size :],
+            )
+            bx.append(self.xs[i][take])
+            by.append(self.ys[i][take])
+        self.batch_count += 1
+        return np.stack(bx), np.stack(by)
+
+    def state_dict(self) -> dict:
+        """JSON-serializable snapshot of the stream position."""
+        return {
+            "n_peers": self.n_peers,
+            "batch_size": self.batch_size,
+            "batch_count": self.batch_count,
+            "cursors": [c.tolist() for c in self._cursors],
+            # PCG64 state is a pair of (arbitrary-precision) ints plus two
+            # small fields — all JSON-safe in Python.
+            "rng_states": [r.bit_generator.state for r in self._rngs],
+        }
+
+    def load_state_dict(self, state: dict) -> None:
+        """Restore a :meth:`state_dict` snapshot.
+
+        Raises on any stream-parameter mismatch: restoring into a stream
+        built with a different peer count or batch size would replay a
+        DIFFERENT sequence than the original run — the silent divergence
+        this whole mechanism exists to prevent."""
+        for field, mine in (
+            ("n_peers", self.n_peers),
+            ("batch_size", self.batch_size),
+        ):
+            # Older snapshots (no recorded batch_size) skip that check.
+            if field in state and int(state[field]) != mine:
+                raise ValueError(
+                    f"stream state was saved with {field}="
+                    f"{int(state[field])}, this stream has {field}={mine}"
+                )
+        if (
+            len(state["cursors"]) != self.n_peers
+            or len(state["rng_states"]) != self.n_peers
+        ):
+            raise ValueError(
+                f"stream state covers {len(state['cursors'])} peers "
+                f"({len(state['rng_states'])} rng states), this stream "
+                f"has {self.n_peers}"
+            )
+        self.batch_count = int(state["batch_count"])
+        self._cursors = [
+            np.asarray(c, dtype=np.int64) for c in state["cursors"]
+        ]
+        for r, s in zip(self._rngs, state["rng_states"]):
+            r.bit_generator.state = s
+
+
 def peer_batches(
     x: Array,
     y: Array,
     n_peers: int,
     batch_size: int,
     seed: int = 0,
-) -> Iterator[Tuple[Array, Array]]:
-    """Endless stream of peer-stacked batches ``([n, b, ...], [n, b])``.
-
-    Each peer cycles its own shard with an independent shuffle — the
-    SPMD stand-in for the reference's N independent data loaders."""
-    xs, ys = peer_split(x, y, n_peers, seed)
-    rngs = [np.random.default_rng(seed + 1000 + i) for i in range(n_peers)]
-    cursors = [np.array([], dtype=np.int64)] * n_peers
-    while True:
-        bx, by = [], []
-        for i in range(n_peers):
-            while len(cursors[i]) < batch_size:
-                cursors[i] = np.concatenate(
-                    [cursors[i], rngs[i].permutation(len(xs[i]))]
-                )
-            take, cursors[i] = (
-                cursors[i][:batch_size],
-                cursors[i][batch_size:],
-            )
-            bx.append(xs[i][take])
-            by.append(ys[i][take])
-        yield np.stack(bx), np.stack(by)
+) -> PeerBatchStream:
+    """Build a :class:`PeerBatchStream` (kept as the historical
+    functional entry point; the returned object is a plain iterator that
+    additionally supports ``state_dict``/``load_state_dict``)."""
+    return PeerBatchStream(x, y, n_peers, batch_size, seed)
 
 
 def device_prefetch(

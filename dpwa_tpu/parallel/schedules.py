@@ -23,6 +23,7 @@ round-trip in the hot loop.
 from __future__ import annotations
 
 import dataclasses
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -140,9 +141,12 @@ def _exponential_pool(n: int) -> np.ndarray:
     Under probabilistic participation it degrades gracefully to gossip
     with an O(log n) mixing time, vs O(n²) for the ring.  XOR pairings
     are involutions by construction.  Requires n a power of two."""
-    if n & (n - 1) != 0:
+    if n < 2 or n & (n - 1) != 0:
+        # n == 1 would pass the bit test (1 & 0 == 0) but has zero hypercube
+        # dimensions — reject it with the same clear message instead of
+        # letting np.stack([]) raise something opaque.
         raise ValueError(
-            f"exponential schedule needs a power-of-two peer count, got {n}"
+            f"exponential schedule needs a power-of-two peer count >= 2, got {n}"
         )
     bits = n.bit_length() - 1
     idx = np.arange(n)
@@ -195,41 +199,86 @@ def _hierarchical_pull_pool(
     return np.stack(pool)
 
 
+def _group_round_robin(n_groups: int) -> list[np.ndarray]:
+    """Round-robin tournament (circle method) over groups.
+
+    Returns a list of group-level perfect matchings (involutions over
+    ``range(n_groups)``) that together visit **every unordered group pair**:
+    ``n_groups - 1`` rounds for even counts, ``n_groups`` rounds for odd
+    (one group sits out per round, left as a masked self-pair).  Standard
+    circle method: pin item 0, rotate the rest one position per round, pair
+    position ``i`` with position ``m-1-i``."""
+    if n_groups == 1:
+        return [np.array([0])]
+    m = n_groups if n_groups % 2 == 0 else n_groups + 1  # m-1 = bye dummy
+    arr = list(range(m))
+    rounds = []
+    for _ in range(m - 1):
+        gperm = np.arange(n_groups)
+        for i in range(m // 2):
+            a, b = arr[i], arr[m - 1 - i]
+            if a < n_groups and b < n_groups:  # skip the odd-count dummy
+                gperm[a], gperm[b] = b, a
+        rounds.append(gperm)
+        arr = [arr[0], arr[-1]] + arr[1:-1]
+    return rounds
+
+
 def _hierarchical_pool(
     n: int, group_size: int, inter_period: int
 ) -> np.ndarray:
     """Two-level pool: intra-group ring pairings, with every
     ``inter_period``-th slot exchanging across groups instead.
 
-    Intra slots alternate the two ring phases *within each group*; the inter
-    slot pairs peer ``i`` of group ``g`` with peer ``i`` of a partner group
-    (groups themselves ring-paired, phase rotating so all group pairs are
-    visited).  This is the intra-host-ICI / inter-host-DCN split of
-    BASELINE.json:10 (config 4, hierarchical averaging).
+    The inter slots cycle through a **round-robin tournament over groups**
+    (:func:`_group_round_robin`): block ``b`` of the pool ends with peer
+    ``i`` of group ``g`` paired with peer ``i`` of round ``b``'s partner
+    group, so over one pool period every group meets every other group —
+    the gossip graph is connected for any ``n_groups`` (a single rotating
+    ring phase is NOT enough: with a fixed ``_ring_even(n_groups)`` inter
+    pairing, 4 groups split into two components {0↔1, 2↔3} forever).
+    Pool length = ``inter_period × n_rounds``.
+
+    Intra slots alternate the two ring phases on a *global* intra-slot
+    counter — per-block parity would pin ``inter_period == 2`` pools to
+    the even phase only, disconnecting groups of size ≥ 4 internally.
+    This is the intra-host-ICI / inter-host-DCN split of BASELINE.json:10
+    (config 4, hierarchical averaging).
     """
     if n % group_size != 0:
         raise ValueError(f"n_peers {n} not divisible by group_size {group_size}")
     n_groups = n // group_size
+    rounds = _group_round_robin(n_groups) if n_groups > 1 else [None]
+    n_blocks = len(rounds)
+    # Guarantee both intra ring phases appear in the pool (needed to connect
+    # groups of size > 2) even when there is only one intra slot per block.
+    if group_size > 2 and n_blocks * (inter_period - 1) < 2:
+        rounds = rounds * 2
+        n_blocks *= 2
     pool = []
-    inter_phase = 0
-    for slot in range(inter_period):
-        if slot == inter_period - 1 and n_groups > 1:
-            # Inter-group slot: ring-pair the groups, alternating phase.
-            gperm = (_ring_even if inter_phase % 2 == 0 else _ring_odd)(n_groups)
-            inter_phase += 1
-            perm = np.arange(n)
-            for g in range(n_groups):
-                pg = gperm[g]
-                for i in range(group_size):
-                    perm[g * group_size + i] = pg * group_size + i
-            pool.append(perm)
-        else:
-            # Intra-group slot: ring phase alternates by slot.
-            base = (_ring_even if slot % 2 == 0 else _ring_odd)(group_size)
-            perm = np.concatenate(
-                [base + g * group_size for g in range(n_groups)]
-            )
-            pool.append(perm)
+    intra_count = 0
+    for block in range(n_blocks):
+        for slot in range(inter_period):
+            if slot == inter_period - 1 and n_groups > 1:
+                # Inter-group slot: this block's tournament-round pairing.
+                gperm = rounds[block]
+                perm = np.arange(n)
+                for g in range(n_groups):
+                    pg = gperm[g]
+                    perm[g * group_size : (g + 1) * group_size] = (
+                        np.arange(group_size) + pg * group_size
+                    )
+                pool.append(perm)
+            else:
+                # Intra-group slot: ring phase alternates globally.
+                base = (
+                    _ring_even if intra_count % 2 == 0 else _ring_odd
+                )(group_size)
+                intra_count += 1
+                perm = np.concatenate(
+                    [base + g * group_size for g in range(n_groups)]
+                )
+                pool.append(perm)
     return np.stack(pool)
 
 
@@ -253,10 +302,23 @@ class Schedule:
     drop_probability: float = 0.0
     mode: str = "pairwise"  # pairwise (involutions) | pull (one-sided maps)
     wire_dtype: str = "f32"  # precision of the shipped replica (f32 | bf16)
+    # Optional [period] map from step-in-period to pool row.  The
+    # hierarchical schedule's cycle repeats the two intra ring phases many
+    # times (period = inter_period × n_tournament_rounds slots, but only
+    # n_rounds + 2 DISTINCT pairings) — deduping keeps the jit path's
+    # lax.switch at one branch per distinct pairing instead of one per
+    # slot, bounding compile time as group count grows.  None ⇒ identity.
+    branch_map: Optional[np.ndarray] = None
 
     @property
     def pool_size(self) -> int:
         return len(self.pool)
+
+    @property
+    def period(self) -> int:
+        """Length of the schedule's repeating cycle in steps (for periodic
+        schedules; the random schedule draws i.i.d. and has no cycle)."""
+        return len(self.branch_map) if self.branch_map is not None else len(self.pool)
 
     @property
     def periodic(self) -> bool:
@@ -266,12 +328,16 @@ class Schedule:
 
     def branch_traced(self, step):
         """Pool index at ``step`` as a traced int32 (the jit-path form)."""
-        return pool_branch_draw(self.seed, step, self.pool_size, self.periodic)
+        idx = pool_branch_draw(self.seed, step, self.period, self.periodic)
+        if self.branch_map is not None:
+            idx = jnp.asarray(self.branch_map, jnp.int32)[idx]
+        return idx
 
     def branch(self, step: int) -> int:
         """Host-side pool index for ``step`` — same stream as the jit path."""
         if self.periodic or self.pool_size <= 1:
-            return int(step) % self.pool_size
+            idx = int(step) % self.period
+            return int(self.branch_map[idx]) if self.branch_map is not None else idx
         return int(self.branch_traced(step))
 
     def pair_id(self, i: int, partner: int):
@@ -353,6 +419,12 @@ def build_schedule(config: DpwaConfig) -> Schedule:
     else:  # pragma: no cover - config validates earlier
         raise ValueError(proto.schedule)
     pool = pool.astype(np.int32)
+    branch_map = None
+    if not pull and proto.schedule == "hierarchical" and len(pool) > 1:
+        # Dedupe repeated slots (the intra ring phases recur every block):
+        # pool keeps only distinct pairings, branch_map restores the cycle.
+        pool, inverse = np.unique(pool, axis=0, return_inverse=True)
+        branch_map = inverse.astype(np.int32).reshape(-1)
     for k, perm in enumerate(pool):
         if pull:
             # Pull maps must be permutations (ppermute: unique sources AND
@@ -372,6 +444,7 @@ def build_schedule(config: DpwaConfig) -> Schedule:
         drop_probability=proto.drop_probability,
         mode=proto.mode,
         wire_dtype=proto.wire_dtype,
+        branch_map=branch_map,
     )
 
 

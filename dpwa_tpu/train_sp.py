@@ -19,12 +19,17 @@ The gossip semantics (schedule pools, participation/fault draws,
 interpolation, pull mode, bf16 wire) are exactly
 :func:`dpwa_tpu.parallel.ici.gossip_exchange_local` — replicated over the
 ``sp`` axis, every sp rank of a replica executes the identical exchange.
+The step composes with the full 1-D feature set
+(:mod:`dpwa_tpu.train`): ``exchange_filter`` (config 5's long-context
+LoRA layout — adapters gossip over ``peers`` while the frozen base rides
+only the sp collectives), ``model_state`` (sp-reduced so replicas stay
+consistent), and ``overlap`` (ship the pre-update replica).
 """
 
 from __future__ import annotations
 
 import functools
-from typing import Any, Callable, Tuple
+from typing import Any, Callable, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -42,6 +47,8 @@ from dpwa_tpu.parallel.ici import (
 )
 from dpwa_tpu.parallel.mesh import PEER_AXIS
 from dpwa_tpu.train import GossipTrainState
+from dpwa_tpu.utils.pytree import combine as pytree_combine
+from dpwa_tpu.utils.pytree import partition as pytree_partition
 
 PyTree = Any
 SP_AXIS = "sp"
@@ -76,29 +83,36 @@ def init_gossip_sp_state(
     stacked_params: PyTree,
     optimizer: optax.GradientTransformation,
     transport: IciTransport,
+    stacked_model_state: PyTree = None,
 ) -> GossipTrainState:
     """Identical to :func:`dpwa_tpu.train.init_gossip_state` — the peer
     sharding on a 2-D mesh replicates every leaf over ``sp`` for free."""
     from dpwa_tpu.train import init_gossip_state
 
-    return init_gossip_state(stacked_params, optimizer, transport)
+    return init_gossip_state(
+        stacked_params, optimizer, transport, stacked_model_state
+    )
 
 
-def make_gossip_sp_train_step(
-    loss_fn: SpLossFn,
+def _make_sp_step(
+    loss_fn,
     optimizer: optax.GradientTransformation,
     transport: IciTransport,
-    sp_axis: str = SP_AXIS,
+    exchange_filter: Optional[Callable[[str], bool]],
+    with_state: bool,
+    overlap: bool,
+    sp_axis: str,
+    debug_sp_invariance: bool,
 ):
-    """Jitted ``train_step(state, batch) -> (state, losses, info)`` on a
-    ``(peers, sp)`` mesh.
+    """Shared builder behind both public sp step factories.
 
-    ``transport`` must be an :class:`IciTransport` built over a 2-D mesh
-    from :func:`make_sp_mesh`.  ``batch`` is ``(inputs, targets)`` of
-    shape ``[n_peers, B, T]`` (the host pre-shifts targets, so block
-    boundaries need no cross-shard fix-up); ``T`` is sharded over ``sp``.
-    ``losses`` is the per-replica mean token loss, float32[n_peers].
-    """
+    Mirrors :func:`dpwa_tpu.train._make_step` with the sp additions: the
+    loss arrives as a (sum, count) pair psummed over ``sp``; gradients
+    come back sp-invariant through the replicated-operand transpose; and
+    ``model_state`` is ``pmean``-ed over ``sp`` after the forward pass
+    (each sp rank computes statistics on its own sequence block — the
+    reduction is what keeps every rank of a replica bit-identical before
+    the exchange)."""
     mesh, peers_axis = transport.mesh, transport.axis_name
     if sp_axis not in mesh.shape:
         raise ValueError(
@@ -106,14 +120,37 @@ def make_gossip_sp_train_step(
             "build it with make_sp_mesh"
         )
     schedule, interp = transport.schedule, transport.interp
-    grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+    if with_state:
+        # loss_fn returns ((loss_sum, count), new_model_state); grad needs
+        # a scalar primal, so fold count in with the aux.
+        def _scalarized(params, model_state, batch):
+            (loss_sum, count), new_ms = loss_fn(params, model_state, batch)
+            return loss_sum, (count, new_ms)
+
+        grad_fn = jax.value_and_grad(_scalarized, has_aux=True)
+    else:
+        grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
     shard = lambda t: jax.tree.map(lambda v: v[0], t)
     unshard = lambda t: jax.tree.map(lambda v: v[None], t)
 
-    def body(params, opt_state, clock, step, batch):
+    def body(params, opt_state, model_state, clock, prev_loss, step, batch):
         params, opt_state = shard(params), shard(opt_state)
-        inputs, targets = jax.tree.map(lambda v: v[0], batch)
-        (loss_sum, count), grads = grad_fn(params, (inputs, targets))
+        old_params, old_model_state = params, model_state
+        local_batch = shard(batch)
+        if with_state:
+            model_state = shard(model_state)
+            (loss_sum, (count, new_model_state)), grads = grad_fn(
+                params, model_state, local_batch
+            )
+            # Each sp rank saw only its sequence block: reduce the updated
+            # statistics across ``sp`` so the replica stays consistent.
+            new_model_state = jax.tree.map(
+                lambda v: lax.pmean(v, sp_axis), new_model_state
+            )
+            old_model_state = model_state
+        else:
+            (loss_sum, count), grads = grad_fn(params, local_batch)
+            new_model_state = ()
         # NO manual psum on grads: ``params`` enter replicated over
         # ``sp`` (spec names only ``peers``), and the transpose rule for
         # a replicated operand ALREADY sums its cotangents across the
@@ -121,6 +158,22 @@ def make_gossip_sp_train_step(
         # d(sum of all blocks' losses)/d(params).  (Ring-attention
         # cross-block terms flow through the transposed ppermutes.)  A
         # manual psum here would multiply the gradient by sp.
+        if debug_sp_invariance:
+            # Pin the no-manual-psum rule explicitly (ADVICE r2): the
+            # max relative deviation of this rank's grads from the sp
+            # mean must be ~0.  Exposed to the caller per peer; a JAX
+            # upgrade that breaks the transpose rule trips the gate
+            # test before it silently mistrains.
+            devs = [
+                jnp.max(
+                    jnp.abs(g - lax.pmean(g, sp_axis))
+                    / (jnp.abs(lax.pmean(g, sp_axis)) + 1e-8)
+                )
+                for g in jax.tree.leaves(grads)
+            ]
+            sp_grad_dev = jnp.max(jnp.stack(devs)).astype(jnp.float32)
+        else:
+            sp_grad_dev = jnp.float32(0.0)
         loss_sum = lax.psum(loss_sum, sp_axis)
         count = lax.psum(count, sp_axis)
         loss = (loss_sum / jnp.maximum(count, 1.0)).astype(jnp.float32)
@@ -130,23 +183,56 @@ def make_gossip_sp_train_step(
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         clock = clock[0] + 1.0
-        meta = PeerMeta(clock, loss)
-        # Gossip across replicas: every sp rank of a replica holds the
-        # identical post-update params and runs the identical ppermute
-        # over ``peers`` — the exchange stays sp-replicated by
-        # construction.
-        merged, (partner, alpha, part) = gossip_exchange_local(
-            params, meta, step,
-            schedule=schedule, interp=interp, axis_name=peers_axis,
+        if overlap:
+            # Ship the PRE-update replica with the PREVIOUS step's loss —
+            # every collective operand is ready at step entry, so the
+            # peers-axis ppermute needs nothing from this step's fwd/bwd
+            # (same semantics as the 1-D overlap: one step of partner
+            # staleness, exactly the reference's stale Rx publish).
+            exchange_params, exchange_state = old_params, old_model_state
+            meta = PeerMeta(clock, prev_loss[0])
+        else:
+            exchange_params, exchange_state = params, new_model_state
+            meta = PeerMeta(clock, loss)
+        if exchange_filter is not None:
+            exchange_params, _ = pytree_partition(
+                exchange_params, exchange_filter
+            )
+        (merged_sel, merged_state), (partner, alpha, part) = (
+            gossip_exchange_local(
+                (exchange_params, exchange_state), meta, step,
+                schedule=schedule, interp=interp, axis_name=peers_axis,
+            )
         )
+        if overlap:
+            # x_{k+1} = merge(x_k) + own update; model_state analogously
+            # re-applies this step's statistics delta to the merge.
+            if exchange_filter is not None:
+                sel_updates, _ = pytree_partition(updates, exchange_filter)
+                merged_sel = optax.apply_updates(merged_sel, sel_updates)
+            else:
+                merged_sel = optax.apply_updates(merged_sel, updates)
+            merged_state = jax.tree.map(
+                lambda m, new, old: m + (new - old),
+                merged_state, new_model_state, old_model_state,
+            )
+        if exchange_filter is not None:
+            _, rest = pytree_partition(params, exchange_filter)
+            merged = pytree_combine(merged_sel, rest)
+        else:
+            merged = merged_sel
         return (
             unshard(merged),
             unshard(opt_state),
+            unshard(merged_state),
             clock[None],
             loss[None],
             (partner[None], alpha[None], part[None]),
+            sp_grad_dev[None],
         )
 
+    # A single spec is a valid pytree prefix for any batch structure whose
+    # leaves are [n_peers, B, T] blocks.
     batch_spec = P(peers_axis, None, sp_axis)
     mapped = shard_map(
         body,
@@ -155,53 +241,134 @@ def make_gossip_sp_train_step(
             P(peers_axis),
             P(peers_axis),
             P(peers_axis),
+            P(peers_axis),
+            P(peers_axis),
             P(),
-            (batch_spec, batch_spec),
+            batch_spec,
         ),
         out_specs=(
             P(peers_axis),
             P(peers_axis),
             P(peers_axis),
             P(peers_axis),
+            P(peers_axis),
             (P(peers_axis), P(peers_axis), P(peers_axis)),
+            P(peers_axis),
         ),
     )
 
     @functools.partial(jax.jit, donate_argnums=(0,))
     def _step(state: GossipTrainState, batch):
-        params, opt_state, clock, losses, info = mapped(
-            state.params, state.opt_state, state.clock, state.step, batch
+        prev_loss = (
+            state.loss
+            if state.loss is not None
+            else jnp.zeros_like(state.clock)
+        )
+        params, opt_state, model_state, clock, losses, info, sp_dev = mapped(
+            state.params,
+            state.opt_state,
+            state.model_state if with_state else (),
+            state.clock,
+            prev_loss,
+            state.step,
+            batch,
         )
         new_state = GossipTrainState(
             params=params,
             opt_state=opt_state,
             clock=clock,
             step=state.step + 1,
-            model_state=state.model_state,
+            model_state=model_state if with_state else state.model_state,
             loss=losses,
         )
-        return new_state, losses, ExchangeInfo(*info)
+        return new_state, losses, ExchangeInfo(*info), sp_dev
 
     # CPU run-ahead bound: reuse the transport's detection (see the
     # rationale comment in IciTransport.__init__).
     block_per_call = transport._block_per_call
 
     def train_step(state: GossipTrainState, batch):
-        if state.model_state is not None:
-            # Same misuse guard as the 1-D step factories: this step
-            # would neither update nor exchange model_state, silently
-            # freezing BatchNorm-style statistics at init.
+        if not with_state and state.model_state is not None:
             raise ValueError(
-                "state carries model_state but the sp train step does not "
-                "support non-parameter model variables yet; use a "
-                "stateless model (e.g. GroupNorm/RMSNorm) on the sp path"
+                "state carries model_state but this step was built with "
+                "make_gossip_sp_train_step, which would never update it; "
+                "use make_gossip_sp_train_step_with_state instead"
             )
-        out = _step(state, batch)
+        if with_state and state.model_state is None:
+            raise ValueError(
+                "step built with make_gossip_sp_train_step_with_state but "
+                "state.model_state is None; pass stacked_model_state to "
+                "init_gossip_sp_state"
+            )
+        new_state, losses, info, sp_dev = _step(state, batch)
         if block_per_call:
-            jax.block_until_ready(out)
-        return out
+            jax.block_until_ready((new_state, losses, info, sp_dev))
+        if debug_sp_invariance:
+            return new_state, losses, info, sp_dev
+        return new_state, losses, info
 
     return train_step
+
+
+def make_gossip_sp_train_step(
+    loss_fn: SpLossFn,
+    optimizer: optax.GradientTransformation,
+    transport: IciTransport,
+    exchange_filter: Optional[Callable[[str], bool]] = None,
+    overlap: bool = False,
+    sp_axis: str = SP_AXIS,
+    debug_sp_invariance: bool = False,
+):
+    """Jitted ``train_step(state, batch) -> (state, losses, info)`` on a
+    ``(peers, sp)`` mesh.
+
+    ``transport`` must be an :class:`IciTransport` built over a 2-D mesh
+    from :func:`make_sp_mesh`.  ``batch`` is a pytree of ``[n_peers, B,
+    T]`` leaves (e.g. ``(inputs, targets)``; the host pre-shifts targets,
+    so block boundaries need no cross-shard fix-up); ``T`` is sharded
+    over ``sp``.  ``losses`` is the per-replica mean token loss,
+    float32[n_peers].
+
+    ``exchange_filter`` composes subset-pytree gossip with sp — config
+    5's actual long-context layout (BASELINE.json:11): LoRA adapters
+    gossip over ``peers`` while the frozen base weights never enter the
+    collective.  ``overlap`` ships the pre-update replica exactly as in
+    :func:`dpwa_tpu.train.make_gossip_train_step`.
+
+    ``debug_sp_invariance=True`` adds a fourth return — per-peer max
+    relative deviation of this step's gradients across sp ranks, which
+    must be ~0 (the no-manual-psum correctness invariant, pinned by
+    ``tests/test_sp_train.py``)."""
+    return _make_sp_step(
+        loss_fn, optimizer, transport, exchange_filter, with_state=False,
+        overlap=overlap, sp_axis=sp_axis,
+        debug_sp_invariance=debug_sp_invariance,
+    )
+
+
+def make_gossip_sp_train_step_with_state(
+    loss_fn,
+    optimizer: optax.GradientTransformation,
+    transport: IciTransport,
+    exchange_filter: Optional[Callable[[str], bool]] = None,
+    overlap: bool = False,
+    sp_axis: str = SP_AXIS,
+    debug_sp_invariance: bool = False,
+):
+    """Like :func:`make_gossip_sp_train_step`, for models with
+    non-parameter variables.
+
+    ``loss_fn(params, model_state, batch) -> ((loss_sum, count),
+    new_model_state)``.  Each sp rank computes statistics on its own
+    sequence block; the step ``pmean``s ``new_model_state`` over ``sp``
+    so every rank of a replica stays bit-identical, then exchanges it
+    alongside the (filtered) params with the same α, exactly as the 1-D
+    :func:`dpwa_tpu.train.make_gossip_train_step_with_state`."""
+    return _make_sp_step(
+        loss_fn, optimizer, transport, exchange_filter, with_state=True,
+        overlap=overlap, sp_axis=sp_axis,
+        debug_sp_invariance=debug_sp_invariance,
+    )
 
 
 def sp_batch_sharding(mesh: Mesh, sp_axis: str = SP_AXIS) -> NamedSharding:

@@ -56,6 +56,27 @@ def measure_sync_rtt(samples: int = 10) -> float:
     return float(np.median(times))
 
 
+class TimedResult(float):
+    """Seconds-per-iteration that also carries measurement validity.
+
+    A plain float to every existing consumer; ``valid`` is False when the
+    subtracted sync RTT exceeded half the raw loop time — the corrected
+    figure is then noise-dominated and must not be recorded as a
+    benchmark number (``bench.py`` refuses and retries with more iters).
+    ``dt_raw``/``sync_rtt`` preserve the inputs for diagnostics."""
+
+    valid: bool
+    dt_raw: float
+    sync_rtt: float
+
+    def __new__(cls, seconds: float, valid: bool, dt_raw: float, rtt: float):
+        self = super().__new__(cls, seconds)
+        self.valid = valid
+        self.dt_raw = dt_raw
+        self.sync_rtt = rtt
+        return self
+
+
 def timed_loop(
     run_iter: Callable,
     sync: Callable,
@@ -79,10 +100,12 @@ def timed_loop(
        loop, which must be subtracted or short loops are dominated by it.
 
     When the RTT exceeds half the raw measurement the corrected figure is
-    mostly noise; a warning is printed to stderr so an absurd number never
-    passes silently (clamped at a 1 ns floor).
+    mostly noise; the returned :class:`TimedResult` carries
+    ``valid=False`` (and a warning is printed to stderr) so callers can
+    refuse to record it rather than publish an absurd number (clamped at
+    a 1 ns floor).
 
-    Returns ``(seconds_per_iter, final_carry)``.
+    Returns ``(seconds_per_iter: TimedResult, final_carry)``.
     """
     if sync_rtt is None:
         sync_rtt = measure_sync_rtt()
@@ -94,7 +117,8 @@ def timed_loop(
         carry = run_iter(carry, k)
     sync(carry)
     dt_raw = time.perf_counter() - t0
-    if sync_rtt > 0.5 * dt_raw:
+    valid = sync_rtt <= 0.5 * dt_raw
+    if not valid:
         print(
             f"WARNING [{label}]: sync RTT {sync_rtt*1e3:.1f} ms exceeds "
             f"half the raw measurement {dt_raw*1e3:.1f} ms over {iters} "
@@ -102,7 +126,12 @@ def timed_loop(
             file=sys.stderr,
             flush=True,
         )
-    return max(dt_raw - sync_rtt, 1e-9) / iters, carry
+    return (
+        TimedResult(
+            max(dt_raw - sync_rtt, 1e-9) / iters, valid, dt_raw, sync_rtt
+        ),
+        carry,
+    )
 
 
 def measure_exchange_bandwidth(

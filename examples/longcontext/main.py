@@ -40,6 +40,12 @@ def main() -> None:
     ap.add_argument("--n-layers", type=int, default=4)
     ap.add_argument("--lr", type=float, default=3e-3)
     ap.add_argument("--log-every", type=int, default=20)
+    ap.add_argument(
+        "--lora", type=int, default=0, metavar="RANK",
+        help="config 5's long-context layout: freeze the base weights, "
+        "train rank-RANK LoRA adapters, and gossip ONLY the adapters "
+        "over the peers axis (0 = full-weight gossip)",
+    )
     args = ap.parse_args()
 
     from dpwa_tpu.config import make_local_config
@@ -72,6 +78,7 @@ def main() -> None:
         n_kv_heads=4,
         d_ff=args.d_model * 3,
         max_seq_len=T,
+        lora_rank=args.lora,
     )
     model = Llama(LlamaConfig(**base, sp_axis="sp"))
     init_model = Llama(LlamaConfig(**base))  # init runs outside shard_map
@@ -83,7 +90,16 @@ def main() -> None:
         jax.random.key(0),
         n,
     )
-    opt = optax.adam(args.lr)
+    if args.lora:
+        from dpwa_tpu.models.llama import lora_filter, lora_optimizer
+
+        opt = lora_optimizer(
+            optax.adam(args.lr), jax.tree.map(lambda v: v[0], stacked)
+        )
+        exchange_filter = lora_filter
+    else:
+        opt = optax.adam(args.lr)
+        exchange_filter = None
     state = init_gossip_state(stacked, opt, transport)
 
     def sp_loss(params, batch):
@@ -93,7 +109,9 @@ def main() -> None:
         )
         return losses.sum(), jnp.float32(losses.size)
 
-    step_fn = make_gossip_sp_train_step(sp_loss, opt, transport)
+    step_fn = make_gossip_sp_train_step(
+        sp_loss, opt, transport, exchange_filter=exchange_filter
+    )
     sh = sp_batch_sharding(mesh)
 
     # Deterministic synthetic language: next token = f(prev) — learnable.

@@ -150,6 +150,99 @@ def test_checkpoint_restores_pre_loss_field_format(tmp_path):
     assert bare.loss is None
 
 
+def test_checkpoint_data_stream_resume_exact(tmp_path):
+    """VERDICT r2 #7: a resumed run must reproduce the original batch
+    sequence exactly — step k's batch after resume equals step k's batch
+    in an uninterrupted run."""
+    from dpwa_tpu.checkpoint import restore_checkpoint, save_checkpoint
+    from dpwa_tpu.data import PeerBatchStream, gaussian_blobs
+    from dpwa_tpu.parallel.stacked import StackedTransport, init_stacked_state
+
+    n = 4
+    x, y = gaussian_blobs(n_per_class=40, seed=2)
+    stream = PeerBatchStream(x, y, n, batch_size=8, seed=7)
+    for _ in range(5):  # advance past one shard epoch boundary region
+        next(stream)
+
+    cfg = make_local_config(n, schedule="ring")
+    transport = StackedTransport(cfg)
+    state = init_stacked_state(
+        {"w": jnp.ones((n, 3))}, optax.sgd(0.1), transport
+    )
+    ckpt = str(tmp_path / "ck")
+    save_checkpoint(ckpt, state, data_stream=stream)
+
+    # Uninterrupted continuation.
+    want = [next(stream) for _ in range(6)]
+
+    # Resume into a FRESH stream built with the same constructor args.
+    fresh = PeerBatchStream(x, y, n, batch_size=8, seed=7)
+    restore_checkpoint(ckpt, like=state, data_stream=fresh)
+    assert fresh.batch_count == 5
+    got = [next(fresh) for _ in range(6)]
+    for (wx, wy), (gx, gy) in zip(want, got):
+        np.testing.assert_array_equal(wx, gx)
+        np.testing.assert_array_equal(wy, gy)
+
+
+def test_checkpoint_without_data_sidecar_refuses_stream(tmp_path):
+    from dpwa_tpu.checkpoint import restore_checkpoint, save_checkpoint
+    from dpwa_tpu.data import PeerBatchStream, gaussian_blobs
+    from dpwa_tpu.parallel.stacked import StackedTransport, init_stacked_state
+
+    n = 2
+    cfg = make_local_config(n, schedule="ring")
+    state = init_stacked_state(
+        {"w": jnp.ones((n, 3))}, optax.sgd(0.1), StackedTransport(cfg)
+    )
+    ckpt = str(tmp_path / "ck")
+    save_checkpoint(ckpt, state)  # no data_stream
+    x, y = gaussian_blobs(n_per_class=20)
+    stream = PeerBatchStream(x, y, n, batch_size=4)
+    with pytest.raises(FileNotFoundError, match="data-stream sidecar"):
+        restore_checkpoint(ckpt, like=state, data_stream=stream)
+    # Plain restore (no stream requested) still works.
+    restored = restore_checkpoint(ckpt, like=state)
+    assert int(restored.step) == 0
+
+
+def test_checkpoint_resave_clears_stale_data_sidecar(tmp_path):
+    """A re-save at the same path WITHOUT data_stream must remove the
+    previous save's sidecar — restoring the new state against the old
+    stream position would silently replay the wrong batches."""
+    from dpwa_tpu.checkpoint import restore_checkpoint, save_checkpoint
+    from dpwa_tpu.data import PeerBatchStream, gaussian_blobs
+    from dpwa_tpu.parallel.stacked import StackedTransport, init_stacked_state
+
+    n = 2
+    x, y = gaussian_blobs(n_per_class=20)
+    stream = PeerBatchStream(x, y, n, batch_size=4)
+    next(stream)
+    cfg = make_local_config(n, schedule="ring")
+    state = init_stacked_state(
+        {"w": jnp.ones((n, 3))}, optax.sgd(0.1), StackedTransport(cfg)
+    )
+    ckpt = str(tmp_path / "ck")
+    save_checkpoint(ckpt, state, data_stream=stream)
+    save_checkpoint(ckpt, state)  # re-save, no stream
+    fresh = PeerBatchStream(x, y, n, batch_size=4)
+    with pytest.raises(FileNotFoundError, match="data-stream sidecar"):
+        restore_checkpoint(ckpt, like=state, data_stream=fresh)
+
+
+def test_data_stream_state_rejects_mismatched_parameters():
+    from dpwa_tpu.data import PeerBatchStream, gaussian_blobs
+
+    x, y = gaussian_blobs(n_per_class=20)
+    stream = PeerBatchStream(x, y, 4, batch_size=8, seed=1)
+    next(stream)
+    snap = stream.state_dict()
+    with pytest.raises(ValueError, match="batch_size"):
+        PeerBatchStream(x, y, 4, batch_size=16, seed=1).load_state_dict(snap)
+    with pytest.raises(ValueError, match="n_peers"):
+        PeerBatchStream(x, y, 2, batch_size=8, seed=1).load_state_dict(snap)
+
+
 def test_metrics_logger_jsonl(tmp_path):
     path = str(tmp_path / "metrics.jsonl")
     m = MetricsLogger(path=path, every=2)

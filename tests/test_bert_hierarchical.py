@@ -60,7 +60,10 @@ def test_bert_hierarchical_gossip_trains():
         n, schedule="hierarchical", group_size=4, inter_period=4
     )
     transport = IciTransport(cfg, mesh=make_mesh(cfg))
-    assert transport.schedule.pool_size == 4
+    # 2 groups -> one tournament round of inter_period slots; the pool
+    # holds the 3 DISTINCT pairings (2 intra ring phases + 1 inter).
+    assert transport.schedule.period == 4
+    assert transport.schedule.pool_size == 3
 
     mcfg = bert_tiny_config()
     model = BertMLM(mcfg)

@@ -64,15 +64,74 @@ def test_hierarchical_structure():
         16, schedule="hierarchical", group_size=4, inter_period=4
     )
     sched = build_schedule(cfg)
-    assert sched.pool_size == 4
+    # 4 groups -> 3 tournament rounds x inter_period slots per block; the
+    # compiled pool holds only the DISTINCT pairings (2 intra phases + 3
+    # inter rounds) with branch_map restoring the 12-slot cycle.
+    assert sched.period == 12
+    assert sched.pool_size == 5
     groups = np.arange(16) // 4
-    # Slots 0..2 stay within a group (intra-host / ICI)...
-    for slot in range(3):
-        perm = sched.pool[slot]
-        assert np.all(groups[perm] == groups)
-    # ...slot 3 crosses groups (inter-host / DCN) for every peer.
-    perm = sched.pool[3]
-    assert np.all(groups[perm] != groups)
+    seen_group_pairs = set()
+    for slot in range(sched.period):
+        perm = sched.pairing(slot)
+        if slot % 4 == 3:
+            # Inter slot: crosses groups for every peer, index-preserving.
+            assert np.all(groups[perm] != groups)
+            np.testing.assert_array_equal(perm % 4, np.arange(16) % 4)
+            for g in range(4):
+                pg = groups[perm[g * 4]]
+                seen_group_pairs.add(frozenset((g, int(pg))))
+        else:
+            # Intra slot: stays within a group (intra-host / ICI).
+            assert np.all(groups[perm] == groups)
+    # The tournament visits EVERY unordered group pair (connectivity).
+    assert seen_group_pairs == {
+        frozenset((a, b)) for a in range(4) for b in range(4) if a < b
+    }
+
+
+def _consensus_rounds(sched, n, cycles):
+    """Apply the schedule's pairwise merges (alpha=0.5, full participation)
+    to values 0..n-1 and return the final vector."""
+    x = np.arange(n, dtype=np.float64)
+    for step in range(cycles * sched.period):
+        perm = sched.pairing(step)
+        x = np.where(perm == np.arange(n), x, 0.5 * (x + x[perm]))
+    return x
+
+
+@pytest.mark.parametrize("n_groups,group_size", [(3, 4), (4, 4), (8, 2), (8, 4)])
+def test_hierarchical_reaches_global_consensus(n_groups, group_size):
+    # Regression for the round-2 bug: a fixed inter-group ring pairing left
+    # the gossip graph permanently disconnected for n_groups >= 3 (4 groups
+    # split {0<->1, 2<->3}; at 3 groups, group 2 never exchanged at all).
+    n = n_groups * group_size
+    cfg = make_local_config(
+        n,
+        schedule="hierarchical",
+        group_size=group_size,
+        inter_period=3,
+        fetch_probability=1.0,
+    )
+    sched = build_schedule(cfg)
+    x = _consensus_rounds(sched, n, cycles=40)
+    target = (n - 1) / 2.0
+    np.testing.assert_allclose(x, target, atol=1e-6)
+
+
+def test_hierarchical_consensus_min_inter_period():
+    # inter_period=2 leaves ONE intra slot per block; the global phase
+    # counter must still alternate ring phases so groups of size >= 4
+    # connect internally.
+    for n_groups, group_size in [(2, 4), (3, 4), (4, 6)]:
+        n = n_groups * group_size
+        sched = build_schedule(
+            make_local_config(
+                n, schedule="hierarchical", group_size=group_size,
+                inter_period=2, fetch_probability=1.0,
+            )
+        )
+        x = _consensus_rounds(sched, n, cycles=60)
+        np.testing.assert_allclose(x, (n - 1) / 2.0, atol=1e-6)
 
 
 def test_hierarchical_rejects_indivisible():
@@ -170,6 +229,60 @@ def test_hierarchical_pull_structure():
     for slot in range(3):
         assert np.all(groups[sched.pool[slot]] == groups)  # intra-group
     assert np.all(groups[sched.pool[3]] != groups)  # inter-group slot
+
+
+@pytest.mark.parametrize(
+    "schedule,kwargs,cycles",
+    [
+        ("ring", {}, 1000),  # ring mixes in O(n^2) rounds; n=128 is slow
+        ("random", {"pool_size": 64}, 30),
+        ("hierarchical", {"group_size": 16, "inter_period": 4}, 12),
+        ("hierarchical", {"group_size": 8, "inter_period": 2}, 12),
+        ("exponential", {}, 1),
+    ],
+)
+def test_spec_scale_mixing_128_peers(schedule, kwargs, cycles):
+    # BASELINE.json configs name 32/64/128 peers; the round-2 hierarchical
+    # bug only showed past the tested scale.  Simulate the actual merge
+    # dynamics at n=128 (full participation, alpha=0.5) and require
+    # contraction toward the global mean for every schedule family.
+    n = 128
+    sched = build_schedule(
+        make_local_config(n, schedule=schedule, fetch_probability=1.0, **kwargs)
+    )
+    x = np.arange(n, dtype=np.float64)
+    target = (n - 1) / 2.0
+    std0 = x.std()
+    for step in range(cycles * sched.period):
+        perm = sched.pairing(step)
+        x = np.where(perm == np.arange(n), x, 0.5 * (x + x[perm]))
+    if schedule == "exponential":
+        # One hypercube pass IS an exact allreduce.
+        np.testing.assert_allclose(x, target, atol=1e-9)
+    elif schedule == "ring":
+        # Ring is the slowest mixer; require an order of magnitude.
+        assert x.std() < std0 / 10, x.std()
+    else:
+        np.testing.assert_allclose(x, target, atol=1e-3)
+        assert x.std() < std0 / 1e4
+
+
+def test_hierarchical_pool_dedupes_distinct_pairings():
+    # Compile cost guard: the jit path builds one lax.switch branch per
+    # pool row, so the pool must hold only DISTINCT pairings.  32 groups of
+    # 2 -> 31 tournament rounds x inter_period 4 = 124-slot cycle, but at
+    # group_size 2 both intra ring phases coincide: 32 distinct pairings.
+    cfg = make_local_config(
+        64, schedule="hierarchical", group_size=2, inter_period=4
+    )
+    sched = build_schedule(cfg)
+    assert sched.period == 31 * 4
+    assert sched.pool_size == 32
+    # Host and traced branch paths agree through the branch_map.
+    import jax
+
+    traced = [int(jax.jit(sched.branch_traced)(s)) for s in range(12)]
+    assert traced == [sched.branch(s) for s in range(12)]
 
 
 def test_exponential_pool_is_hypercube():

@@ -165,3 +165,262 @@ def test_sp_step_rejects_model_state():
     step = make_gossip_sp_train_step(lambda p, b: (0.0, 1.0), opt, t)
     with pytest.raises(ValueError, match="model_state"):
         step(state, (jnp.zeros((N_PEERS, B, T), jnp.int32),) * 2)
+
+
+def test_sp_lora_subset_exchange_matches_1d():
+    """Config 5's actual long-context layout (BASELINE.json:11): LoRA
+    adapters gossip over ``peers`` while sequences shard over ``sp``.
+    Base weights must stay bit-identical to init (frozen AND never
+    exchanged), and the whole trajectory must match the 1-D LoRA step."""
+    from dpwa_tpu.models.llama import lora_filter, lora_optimizer
+    from dpwa_tpu.train import init_params_per_peer
+    from dpwa_tpu.utils.pytree import partition
+
+    lcfg = dict(BASE_CFG, lora_rank=4)
+    inputs, targets = _data(seed=5)
+    cfg = make_local_config(N_PEERS, schedule="ring")
+
+    init = lambda k: Llama(LlamaConfig(**lcfg)).init(
+        k, jnp.zeros((1, 8), jnp.int32)
+    )
+    stacked = init_params_per_peer(init, jax.random.key(4), N_PEERS)
+    opt = lora_optimizer(
+        optax.adam(1e-2), jax.tree.map(lambda v: v[0], stacked)
+    )
+
+    # --- 1-D reference: full attention, LoRA-only exchange.
+    ref_model = Llama(LlamaConfig(**lcfg))
+    ref_transport = IciTransport(
+        cfg, mesh=make_mesh(cfg, devices=jax.devices()[:N_PEERS])
+    )
+    ref_state = init_gossip_state(stacked, opt, ref_transport)
+
+    def ref_loss(params, batch):
+        x, y = batch
+        logits = ref_model.apply(params, x)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, y
+        ).mean()
+
+    ref_step = make_gossip_train_step(
+        ref_loss, opt, ref_transport, exchange_filter=lora_filter
+    )
+
+    # --- 2-D: ring attention over sp, LoRA-only exchange over peers.
+    sp_model = Llama(LlamaConfig(**lcfg, sp_axis="sp"))
+    mesh = make_sp_mesh(cfg, SP)
+    sp_transport = IciTransport(cfg, mesh=mesh)
+    sp_state = init_gossip_sp_state(stacked, opt, sp_transport)
+
+    def sp_loss(params, batch):
+        x, y = batch
+        logits = sp_model.apply(params, x)
+        losses = optax.softmax_cross_entropy_with_integer_labels(logits, y)
+        return losses.sum(), jnp.float32(losses.size)
+
+    sp_step = make_gossip_sp_train_step(
+        sp_loss, opt, sp_transport, exchange_filter=lora_filter
+    )
+    sh = sp_batch_sharding(mesh)
+
+    initial = jax.tree.map(np.asarray, stacked)
+    for _ in range(3):
+        ref_state, ref_losses, _ = ref_step(
+            ref_state, (jnp.asarray(inputs), jnp.asarray(targets))
+        )
+        sp_state, sp_losses, _ = sp_step(
+            sp_state,
+            (jax.device_put(inputs, sh), jax.device_put(targets, sh)),
+        )
+        np.testing.assert_allclose(
+            np.asarray(ref_losses), np.asarray(sp_losses),
+            rtol=2e-4, atol=2e-5,
+        )
+    final = jax.tree.map(np.asarray, sp_state.params)
+    _, init_rest = partition(initial, lora_filter)
+    fin_sel, fin_rest = partition(final, lora_filter)
+    # Base weights bit-identical on every peer.
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(a, b), init_rest, fin_rest
+    )
+    # Trajectory parity with the 1-D LoRA step (fp tolerance: the sp
+    # forward sums in a different order).
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=3e-3, atol=3e-4
+        ),
+        ref_state.params,
+        sp_state.params,
+    )
+    assert any(
+        not np.array_equal(a, b)
+        for a, b in zip(
+            jax.tree.leaves(partition(initial, lora_filter)[0]),
+            jax.tree.leaves(fin_sel),
+        )
+    )
+
+
+def test_sp_grad_invariance_pinned():
+    """ADVICE r2: the no-manual-psum gradient rule rests on shard_map's
+    replicated-operand transpose inserting the sp-sum.  Pin it: grads must
+    be sp-invariant to fp tolerance (deviation reported per peer)."""
+    inputs, targets = _data(seed=7)
+    cfg = make_local_config(N_PEERS, schedule="ring")
+    sp_model = Llama(LlamaConfig(**BASE_CFG, sp_axis="sp"))
+    mesh = make_sp_mesh(cfg, SP)
+    transport = IciTransport(cfg, mesh=mesh)
+    state = init_gossip_sp_state(_init_params(), optax.sgd(0.1), transport)
+
+    def sp_loss(params, batch):
+        x, y = batch
+        losses = optax.softmax_cross_entropy_with_integer_labels(
+            sp_model.apply(params, x), y
+        )
+        return losses.sum(), jnp.float32(losses.size)
+
+    step = make_gossip_sp_train_step(
+        sp_loss, optax.sgd(0.1), transport, debug_sp_invariance=True
+    )
+    sh = sp_batch_sharding(mesh)
+    state, losses, info, sp_dev = step(
+        state, (jax.device_put(inputs, sh), jax.device_put(targets, sh))
+    )
+    assert np.all(np.isfinite(np.asarray(losses)))
+    # Relative deviation across sp ranks: zero up to collective fp noise.
+    assert np.max(np.asarray(sp_dev)) < 1e-3, np.asarray(sp_dev)
+
+
+def test_sp_overlap_matches_unsharded_overlap():
+    """overlap=True on the 2-D step: same trajectory as the 1-D overlap
+    step (stale-publish exchange), sequences sharded over sp."""
+    inputs, targets = _data(seed=9)
+    cfg = make_local_config(N_PEERS, schedule="ring")
+    opt = optax.sgd(0.1, momentum=0.9)
+    stacked = _init_params()
+
+    ref_model = Llama(LlamaConfig(**BASE_CFG))
+    ref_transport = IciTransport(
+        cfg, mesh=make_mesh(cfg, devices=jax.devices()[:N_PEERS])
+    )
+    ref_state = init_gossip_state(stacked, opt, ref_transport)
+
+    def ref_loss(params, batch):
+        x, y = batch
+        return optax.softmax_cross_entropy_with_integer_labels(
+            ref_model.apply(params, x), y
+        ).mean()
+
+    ref_step = make_gossip_train_step(
+        ref_loss, opt, ref_transport, overlap=True
+    )
+
+    sp_model = Llama(LlamaConfig(**BASE_CFG, sp_axis="sp"))
+    mesh = make_sp_mesh(cfg, SP)
+    sp_transport = IciTransport(cfg, mesh=mesh)
+    sp_state = init_gossip_sp_state(stacked, opt, sp_transport)
+
+    def sp_loss(params, batch):
+        x, y = batch
+        losses = optax.softmax_cross_entropy_with_integer_labels(
+            sp_model.apply(params, x), y
+        )
+        return losses.sum(), jnp.float32(losses.size)
+
+    sp_step = make_gossip_sp_train_step(
+        sp_loss, opt, sp_transport, overlap=True
+    )
+    sh = sp_batch_sharding(mesh)
+    for _ in range(3):
+        ref_state, ref_losses, _ = ref_step(
+            ref_state, (jnp.asarray(inputs), jnp.asarray(targets))
+        )
+        sp_state, sp_losses, _ = sp_step(
+            sp_state,
+            (jax.device_put(inputs, sh), jax.device_put(targets, sh)),
+        )
+        np.testing.assert_allclose(
+            np.asarray(ref_losses), np.asarray(sp_losses),
+            rtol=2e-4, atol=2e-5,
+        )
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=3e-3, atol=3e-4
+        ),
+        ref_state.params,
+        sp_state.params,
+    )
+
+
+def test_sp_model_state_matches_1d():
+    """model_state on the sp path: each sp rank computes statistics on its
+    own block, the step pmeans them over sp — the trajectory (params AND
+    state) must match the 1-D with_state step on full sequences."""
+    from dpwa_tpu.train import make_gossip_train_step_with_state
+    from dpwa_tpu.train_sp import make_gossip_sp_train_step_with_state
+
+    V, D = 64, 16
+    inputs, targets = _data(seed=11)
+    cfg = make_local_config(N_PEERS, schedule="ring")
+    opt = optax.sgd(0.1)
+
+    k = jax.random.key(13)
+    w0 = jax.random.normal(k, (V, D)) * 0.05
+    stacked = stack_params({"w": w0}, N_PEERS)
+    stacked_ms = stack_params({"h_mean": jnp.zeros(D)}, N_PEERS)
+
+    def fwd(params, x):
+        h = params["w"][x]  # [B, T_loc, D]
+        logits = h @ params["w"].T
+        return h, logits
+
+    # --- 1-D reference on full sequences.
+    ref_transport = IciTransport(
+        cfg, mesh=make_mesh(cfg, devices=jax.devices()[:N_PEERS])
+    )
+    ref_state = init_gossip_state(stacked, opt, ref_transport, stacked_ms)
+
+    def ref_loss(params, model_state, batch):
+        x, y = batch
+        h, logits = fwd(params, x)
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, y
+        ).mean()
+        new_ms = {"h_mean": 0.9 * model_state["h_mean"] + 0.1 * h.mean((0, 1))}
+        return loss, new_ms
+
+    ref_step = make_gossip_train_step_with_state(ref_loss, opt, ref_transport)
+
+    # --- 2-D: same math per block, stats pmean'd over sp.
+    mesh = make_sp_mesh(cfg, SP)
+    sp_transport = IciTransport(cfg, mesh=mesh)
+    sp_state = init_gossip_sp_state(stacked, opt, sp_transport, stacked_ms)
+
+    def sp_loss(params, model_state, batch):
+        x, y = batch
+        h, logits = fwd(params, x)
+        losses = optax.softmax_cross_entropy_with_integer_labels(logits, y)
+        new_ms = {"h_mean": 0.9 * model_state["h_mean"] + 0.1 * h.mean((0, 1))}
+        return (losses.sum(), jnp.float32(losses.size)), new_ms
+
+    sp_step = make_gossip_sp_train_step_with_state(sp_loss, opt, sp_transport)
+    sh = sp_batch_sharding(mesh)
+    for _ in range(3):
+        ref_state, ref_losses, _ = ref_step(
+            ref_state, (jnp.asarray(inputs), jnp.asarray(targets))
+        )
+        sp_state, sp_losses, _ = sp_step(
+            sp_state,
+            (jax.device_put(inputs, sh), jax.device_put(targets, sh)),
+        )
+        np.testing.assert_allclose(
+            np.asarray(ref_losses), np.asarray(sp_losses),
+            rtol=2e-4, atol=2e-5,
+        )
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5
+        ),
+        (ref_state.params, ref_state.model_state),
+        (sp_state.params, sp_state.model_state),
+    )
