@@ -43,6 +43,12 @@ class LlamaConfig:
     # exact ring attention over the axis and rope positions are globally
     # offset by the device's block index.  None = single-device attention.
     sp_axis: Optional[str] = None
+    # Single-device attention implementation: "auto" uses the Pallas TPU
+    # flash kernel when the backend is TPU and the shapes fit its tiling
+    # (T and head_dim multiples of 128), else the dense O(T^2) einsum;
+    # "flash" forces the kernel (raises off-TPU), "dense" forces einsum.
+    # The sp path is unaffected (ring attention is already blockwise).
+    attn_impl: str = "auto"
 
     @property
     def kv_heads(self) -> int:
@@ -171,6 +177,31 @@ class Attention(nn.Module):
             rep = H // KV
             k = jnp.repeat(k, rep, axis=2)
             v = jnp.repeat(v, rep, axis=2)
+        use_flash = cfg.attn_impl == "flash" or (
+            cfg.attn_impl == "auto"
+            and jax.default_backend() == "tpu"
+            and D % 128 == 0
+            and T % 128 == 0
+        )
+        if use_flash:
+            # Pallas TPU flash attention (jax.experimental.pallas.ops):
+            # O(T) memory — score panels live in VMEM tiles, never HBM —
+            # which is what makes long single-device sequences fit at all
+            # (the dense path materializes [B,H,T,T] f32; see
+            # artifacts/attention_memory.json for measured max-T).
+            from jax.experimental.pallas.ops.tpu.flash_attention import (
+                flash_attention,
+            )
+
+            out = flash_attention(
+                q.transpose(0, 2, 1, 3),
+                k.transpose(0, 2, 1, 3),
+                v.transpose(0, 2, 1, 3),
+                causal=True,
+                sm_scale=float(1.0 / (D ** 0.5)),
+            )
+            out = out.transpose(0, 2, 1, 3).reshape(B, T, H * D)
+            return dense(cfg.d_model, "wo")(out)
         scores = jnp.einsum("bthd,bshd->bhts", q, k) / jnp.sqrt(D).astype(
             cfg.dtype
         )

@@ -51,51 +51,111 @@ def _block_attn(q, k, v, scale, qpos, kpos, causal):
     return m, o, l
 
 
+def _auto_q_chunk(T: int) -> int:
+    """Default query-chunk length: the largest power-of-two divisor of T
+    capped at 256, or 0 (no chunking) for short blocks.  Chunking caps the
+    per-hop score materialization at ``[B, H, chunk, T]`` instead of
+    ``[B, H, T, T]``; 256 keeps the MXU-side matmuls large."""
+    if T <= 512:
+        return 0
+    c = 256
+    while c > 1 and T % c:
+        c //= 2
+    return c if c > 1 else 0
+
+
+def _merge_partials(m, l, o, m_blk, l_blk, o_blk):
+    """Online-softmax combine of two (max, denom, weighted-sum) partials."""
+    m_new = jnp.maximum(m, m_blk)
+    c_old = jnp.exp(m - m_new)
+    c_blk = jnp.exp(m_blk - m_new)
+    c_old = jnp.where(jnp.isfinite(c_old), c_old, 0.0)
+    c_blk = jnp.where(jnp.isfinite(c_blk), c_blk, 0.0)
+    l_new = l * c_old + l_blk * c_blk
+    o_new = (
+        o * c_old.transpose(0, 2, 1)[..., None]
+        + o_blk * c_blk.transpose(0, 2, 1)[..., None]
+    )
+    return m_new, l_new, o_new
+
+
 def ring_attention_local(
     q: jnp.ndarray,
     k: jnp.ndarray,
     v: jnp.ndarray,
     axis_name: str = "sp",
     causal: bool = True,
+    q_chunk: Optional[int] = None,
 ) -> jnp.ndarray:
     """Call INSIDE shard_map over ``axis_name``.
 
     Args:
       q, k, v: this device's sequence block, ``[B, T_local, H, D]``;
         device i holds global positions ``[i*T_local, (i+1)*T_local)``.
+      q_chunk: query-chunk length for the flash-style inner loop.  None
+        picks :func:`_auto_q_chunk`; 0 disables chunking.  With a chunk
+        of C the per-hop peak is the ``[B, H, C, T_local]`` score panel —
+        never the full ``[B, H, T_local, T_local]`` block — and the hop
+        body is rematerialized (``jax.checkpoint``), so the backward pass
+        recomputes score panels instead of carrying sp-many of them as
+        scan residuals.  Long-context memory is O(T_local) activations.
     Returns the local block of the attention output, ``[B, T_local, H, D]``.
     """
     n = lax.axis_size(axis_name)
     me = lax.axis_index(axis_name)
     B, T, H, D = q.shape
+    if q_chunk is None:
+        q_chunk = _auto_q_chunk(T)
+    if q_chunk and T % q_chunk:
+        raise ValueError(f"q_chunk {q_chunk} must divide T_local {T}")
     scale = 1.0 / jnp.sqrt(D).astype(jnp.float32)
     q32 = q.astype(jnp.float32)
     qpos = me * T + jnp.arange(T)
 
     shift = [(j, (j + 1) % n) for j in range(n)]  # rotate kv around the ring
 
+    def hop_attn(k_cur, v_cur, m, l, o, kpos):
+        """One hop's partial attention + combine, optionally q-chunked."""
+        k32, v32 = k_cur.astype(jnp.float32), v_cur.astype(jnp.float32)
+        if not q_chunk:
+            m_blk, o_blk, l_blk = _block_attn(
+                q32, k32, v32, scale, qpos, kpos, causal
+            )
+            return _merge_partials(m, l, o, m_blk, l_blk, o_blk)
+
+        nc = T // q_chunk
+        # Stack per-chunk slices: scan materializes ONE chunk's score
+        # panel at a time (sequential, not vmapped — that is the point).
+        qs = q32.reshape(B, nc, q_chunk, H, D).transpose(1, 0, 2, 3, 4)
+        qps = qpos.reshape(nc, q_chunk)
+        ms = m.reshape(B, H, nc, q_chunk).transpose(2, 0, 1, 3)
+        ls = l.reshape(B, H, nc, q_chunk).transpose(2, 0, 1, 3)
+        os_ = o.reshape(B, nc, q_chunk, H, D).transpose(1, 0, 2, 3, 4)
+
+        def chunk_step(_, xs):
+            qc, qpc, mc, lc, oc = xs
+            m_blk, o_blk, l_blk = _block_attn(
+                qc, k32, v32, scale, qpc, kpos, causal
+            )
+            mc, lc, oc = _merge_partials(mc, lc, oc, m_blk, l_blk, o_blk)
+            return None, (mc, lc, oc)
+
+        _, (ms, ls, os_) = lax.scan(
+            jax.checkpoint(chunk_step), None, (qs, qps, ms, ls, os_)
+        )
+        m = ms.transpose(1, 2, 0, 3).reshape(B, H, T)
+        l = ls.transpose(1, 2, 0, 3).reshape(B, H, T)
+        o = os_.transpose(1, 0, 2, 3, 4).reshape(B, T, H, D)
+        return m, l, o
+
     def body(carry, hop):
         k_cur, v_cur, m, l, o = carry
         src = (me - hop) % n  # whose block we currently hold
         kpos = src * T + jnp.arange(T)
-        m_blk, o_blk, l_blk = _block_attn(
-            q32, k_cur.astype(jnp.float32), v_cur.astype(jnp.float32),
-            scale, qpos, kpos, causal,
-        )
-        m_new = jnp.maximum(m, m_blk)
-        # Rescale both accumulators to the new max.
-        c_old = jnp.exp(m - m_new)
-        c_blk = jnp.exp(m_blk - m_new)
-        c_old = jnp.where(jnp.isfinite(c_old), c_old, 0.0)
-        c_blk = jnp.where(jnp.isfinite(c_blk), c_blk, 0.0)
-        l_new = l * c_old + l_blk * c_blk
-        o_new = (
-            o * c_old.transpose(0, 2, 1)[..., None]
-            + o_blk * c_blk.transpose(0, 2, 1)[..., None]
-        )
+        m, l, o = hop_attn(k_cur, v_cur, m, l, o, kpos)
         k_nxt = lax.ppermute(k_cur, axis_name, perm=shift)
         v_nxt = lax.ppermute(v_cur, axis_name, perm=shift)
-        return (k_nxt, v_nxt, m_new, l_new, o_new), None
+        return (k_nxt, v_nxt, m, l, o), None
 
     # Initial accumulators must carry the same varying-over-axis type as
     # their per-hop updates (shard_map VMA typing) — derive them from q so
@@ -105,21 +165,26 @@ def ring_attention_local(
     m0 = zeros_bht - jnp.inf
     l0 = zeros_bht
     o0 = q32 * 0.0
+    # Remat the hop: the backward pass re-runs each hop's score math from
+    # the (small) K/V carry instead of keeping sp-many score panels alive.
     (k_f, v_f, m, l, o), _ = lax.scan(
-        body, (k, v, m0, l0, o0), jnp.arange(n)
+        jax.checkpoint(body), (k, v, m0, l0, o0), jnp.arange(n)
     )
     l = jnp.maximum(l, 1e-20)
     out = o / l.transpose(0, 2, 1)[..., None]
     return out.astype(q.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("axis_name", "causal", "mesh"))
-def _jit_ring(q, k, v, mesh, axis_name, causal):
+@functools.partial(
+    jax.jit, static_argnames=("axis_name", "causal", "mesh", "q_chunk")
+)
+def _jit_ring(q, k, v, mesh, axis_name, causal, q_chunk):
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
     body = functools.partial(
-        ring_attention_local, axis_name=axis_name, causal=causal
+        ring_attention_local, axis_name=axis_name, causal=causal,
+        q_chunk=q_chunk,
     )
     spec = P(None, axis_name, None, None)
     return shard_map(
@@ -134,10 +199,11 @@ def ring_attention(
     mesh,
     axis_name: str = "sp",
     causal: bool = True,
+    q_chunk: Optional[int] = None,
 ) -> jnp.ndarray:
     """Global-view convenience: q/k/v ``[B, T, H, D]`` sharded (or shardable)
     along T over ``mesh``'s ``axis_name``; returns the same layout."""
-    return _jit_ring(q, k, v, mesh, axis_name, causal)
+    return _jit_ring(q, k, v, mesh, axis_name, causal, q_chunk)
 
 
 def full_attention_reference(q, k, v, causal=True):

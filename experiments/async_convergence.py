@@ -38,10 +38,16 @@ import sys
 import time
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-# Variant runs (e.g. the bf16-wire validation) redirect artifacts and set
-# the wire dtype through the environment so every spawned leg inherits
-# them; the committed default study uses f32 + the default dir.
+# Variant runs (e.g. the bf16-wire validation, the ResNet-20 benchmark
+# task) redirect artifacts and set the wire dtype / task through the
+# environment so every spawned leg inherits them; the committed default
+# study uses f32 + SmallNet + the default dir.
 WIRE_DTYPE = os.environ.get("DPWA_EXP_WIRE_DTYPE", "f32")
+# Task: "smallnet" (8x8 digits, fast sanity substrate) or "resnet20" —
+# the BASELINE.json:8 benchmark model on the best offline stand-in for
+# CIFAR-10 (the digits upscaled to 32x32 RGB; same classes, real images,
+# a real train/test generalization gap).
+TASK = os.environ.get("DPWA_EXP_TASK", "smallnet")
 ART_DIR = os.environ.get(
     "DPWA_EXP_ART_DIR",
     os.path.join(REPO_ROOT, "artifacts", "async_convergence"),
@@ -86,6 +92,25 @@ def _jsonl_path(mode: str, seed: int) -> str:
     return os.path.join(ART_DIR, f"run_{mode}_s{seed}.jsonl")
 
 
+def _cifar_shaped_digits(seed: int):
+    """Digits upscaled to 32x32x3 — the offline CIFAR-10 stand-in.
+
+    Nearest-neighbor 4x upsample + channel tile: real images, 10 classes,
+    CIFAR's exact input shape, and a real generalization gap; the closest
+    substrate this zero-egress box can offer the BASELINE.json:8 task."""
+    import numpy as np
+
+    from dpwa_tpu.data import load_digits_dataset
+
+    x_tr, y_tr, x_te, y_te = load_digits_dataset(seed=seed)
+
+    def up(x):
+        x = np.repeat(np.repeat(x, 4, axis=1), 4, axis=2)  # 8x8 -> 32x32
+        return np.tile(x, (1, 1, 1, 3)).astype(np.float32)
+
+    return up(x_tr), y_tr, up(x_te), y_te
+
+
 def _setup_task(seed: int):
     """(model, stacked init params fn, batches iterator, test set, loss)."""
     import jax
@@ -93,12 +118,29 @@ def _setup_task(seed: int):
     import optax
 
     from dpwa_tpu.data import load_digits_dataset, peer_batches
-    from dpwa_tpu.models.mnist import SmallNet
 
-    x_tr, y_tr, x_te, y_te = load_digits_dataset(seed=DATA_SEED)
-    model = SmallNet()
-    params0 = model.init(jax.random.key(seed), jnp.zeros((1, 8, 8, 1)))
-    opt = optax.sgd(LR, momentum=MOMENTUM)
+    if TASK == "resnet20":
+        from dpwa_tpu.models.resnet import ResNet20
+
+        x_tr, y_tr, x_te, y_te = _cifar_shaped_digits(DATA_SEED)
+        # Standardize (CIFAR-style preprocessing) and use Adam: SGD(0.05)
+        # leaves this 20-layer GroupNorm net at chance for hundreds of
+        # steps on 1.4k samples; Adam(1e-3) reaches >95% by ~step 200
+        # (single-replica probe).  The gossip protocol under study is
+        # optimizer-agnostic.
+        mu, sd = x_tr.mean(), x_tr.std()
+        x_tr, x_te = (x_tr - mu) / sd, (x_te - mu) / sd
+        model = ResNet20()  # GroupNorm: pure params, all transports
+        shape = (1, 32, 32, 3)
+        opt = optax.adam(1e-3)
+    else:
+        from dpwa_tpu.models.mnist import SmallNet
+
+        x_tr, y_tr, x_te, y_te = load_digits_dataset(seed=DATA_SEED)
+        model = SmallNet()
+        shape = (1, 8, 8, 1)
+        opt = optax.sgd(LR, momentum=MOMENTUM)
+    params0 = model.init(jax.random.key(seed), jnp.zeros(shape))
     batches = peer_batches(x_tr, y_tr, N_PEERS, BATCH, seed=seed)
 
     def loss_fn(params, batch):
@@ -188,6 +230,7 @@ def tcp_worker(args) -> int:
                     "alpha": float(alpha),
                     "partner": int(partner),
                     "wire": WIRE_DTYPE,
+                    "task": TASK,
                 }
             )
         if JITTER_MS > 0:
@@ -242,7 +285,10 @@ def run_tcp(seed: int, steps: int) -> None:
     # bounded so one wedged worker aborts the leg instead of hanging the
     # whole multi-seed study; a dead or hung worker never leaks the others
     # (they hold the port range).
-    budget = 120 + steps * 1.0  # rendezvous + jit startup + generous step time
+    # Rendezvous + jit startup + generous step time.  ResNet-20 on this
+    # box's single CPU core costs ~0.3 s/peer-step with 8 workers
+    # contending 8-way, vs ms for SmallNet.
+    budget = 120 + steps * (6.0 if TASK == "resnet20" else 1.0)
     outs = []
     try:
         for p in procs:
@@ -342,6 +388,7 @@ def run_spmd(transport_kind: str, seed: int, steps: int) -> None:
                         "alpha": float(alphas[i]),
                         "partner": int(partners[i]),
                         "wire": WIRE_DTYPE,
+                        "task": TASK,
                     }
                 )
     os.makedirs(ART_DIR, exist_ok=True)
@@ -361,6 +408,7 @@ def analyze() -> dict:
 
     runs = {}  # (mode, seed) -> {step -> [accs]}
     wires = set()
+    tasks = set()
     for name in sorted(os.listdir(ART_DIR)):
         if not name.startswith("run_") or not name.endswith(".jsonl"):
             continue
@@ -370,6 +418,9 @@ def analyze() -> dict:
                 key = (r["mode"], r["seed"])
                 # Pre-field records were all produced with the f32 wire.
                 wires.add(r.get("wire", "f32"))
+                # Provenance from the RECORDS; records predating the task
+                # field fall back to this process's TASK (env/flag).
+                tasks.add(r.get("task", TASK))
                 runs.setdefault(key, {}).setdefault(r["step"], []).append(
                     r["acc"]
                 )
@@ -390,8 +441,20 @@ def analyze() -> dict:
     }
     actual_steps = max(per_run_steps.values())
     mixed = len(set(per_run_steps.values())) > 1
+    task_labels = {
+        "resnet20": (
+            "digits upscaled to 32x32x3 (CIFAR-shaped, standardized), "
+            "ResNet-20 (GroupNorm), Adam(1e-3), batch 32"
+        ),
+        "smallnet": "sklearn digits 8x8, SmallNet, SGD(0.05, m=0.9), batch 32",
+    }
+    rec_task = sorted(tasks)[0] if len(tasks) == 1 else None
     summary = {
-        "task": "sklearn digits 8x8, SmallNet, SGD(0.05, m=0.9), batch 32",
+        "task": (
+            task_labels.get(rec_task, rec_task)
+            if rec_task is not None
+            else f"MIXED tasks in one artifact dir: {sorted(tasks)}"
+        ),
         "protocol": {
             "n_peers": N_PEERS,
             "schedule": "random",
@@ -476,6 +539,12 @@ def main() -> int:
         help="bf16 runs the whole study with the compressed wire and "
         "writes artifacts to artifacts/async_convergence_bf16w/",
     )
+    r.add_argument(
+        "--task", choices=("smallnet", "resnet20"), default=None,
+        help="resnet20 runs the BASELINE.json:8 benchmark model on "
+        "CIFAR-shaped data and writes to "
+        "artifacts/async_convergence_resnet20/",
+    )
 
     s = sub.add_parser("spmd")
     s.add_argument("--transport", choices=("ici", "stacked"), required=True)
@@ -498,16 +567,25 @@ def main() -> int:
     # platform/device-count choices never leak across legs.
     from dpwa_tpu.utils.launch import child_process_env
 
+    global WIRE_DTYPE, ART_DIR, TASK
     if args.wire_dtype is not None:
-        global WIRE_DTYPE, ART_DIR
         WIRE_DTYPE = args.wire_dtype
         os.environ["DPWA_EXP_WIRE_DTYPE"] = args.wire_dtype
-        if args.wire_dtype != "f32":
-            ART_DIR = os.path.join(
-                REPO_ROOT, "artifacts",
-                f"async_convergence_{args.wire_dtype}w",
-            )
-            os.environ["DPWA_EXP_ART_DIR"] = ART_DIR
+    if args.task is not None:
+        # Explicit flag always wins, including `--task smallnet` in a
+        # shell that has DPWA_EXP_TASK exported.
+        TASK = args.task
+        os.environ["DPWA_EXP_TASK"] = args.task
+    if args.task is not None or args.wire_dtype is not None:
+        # Variant dirs compose: task and wire dtype each add a suffix, so
+        # bf16 x resnet20 never clobbers the f32 resnet20 study.
+        parts = ["async_convergence"]
+        if TASK != "smallnet":
+            parts.append(TASK)
+        if WIRE_DTYPE != "f32":
+            parts.append(f"{WIRE_DTYPE}w")
+        ART_DIR = os.path.join(REPO_ROOT, "artifacts", "_".join(parts))
+        os.environ["DPWA_EXP_ART_DIR"] = ART_DIR
 
     env = child_process_env(REPO_ROOT)
     for seed in [int(x) for x in args.seeds.split(",")]:
