@@ -39,6 +39,20 @@ def _data_state_path(path: str) -> str:
     return path.rstrip(os.sep) + "-data.json"
 
 
+def _layout_path(path: str) -> str:
+    """Sidecar recording which state class was saved (Orbax stores only
+    the array tree; both layouts share one field set)."""
+    return path.rstrip(os.sep) + "-meta.json"
+
+
+def _state_class(name: str):
+    if name == "StackedTrainState":
+        from dpwa_tpu.parallel.stacked import StackedTrainState
+
+        return StackedTrainState
+    return GossipTrainState
+
+
 def save_checkpoint(path: str, state, data_stream=None) -> None:
     """Atomically save a training state to ``path`` (a directory).
 
@@ -66,6 +80,10 @@ def save_checkpoint(path: str, state, data_stream=None) -> None:
         os.remove(sidecar)
     with ocp.StandardCheckpointer() as ckptr:
         ckptr.save(path, dict(state._asdict()), force=True)
+    meta_tmp = _layout_path(path) + ".tmp"
+    with open(meta_tmp, "w") as f:
+        json.dump({"layout": type(state).__name__}, f)
+    os.replace(meta_tmp, _layout_path(path))
     if data_stream is not None:
         tmp = sidecar + ".tmp"
         with open(tmp, "w") as f:
@@ -87,12 +105,12 @@ def restore_checkpoint(path: str, like: Optional[Any] = None, data_stream=None):
 
     ``like`` (same treedef/shapes/shardings as the saved state) restores
     arrays onto the right devices/shardings, and its type decides the
-    returned state class; without it, arrays come back as host numpy in a
-    :class:`GossipTrainState` REGARDLESS of which layout saved the
-    checkpoint (the file records no layout; the two state classes carry
-    identical fields).  To re-label, rewrap:
-    ``StackedTrainState(**restored._asdict())``.  Pass ``like`` whenever
-    the class identity matters.
+    returned state class; without it, arrays come back as host numpy in
+    the class recorded by the save's layout sidecar
+    (``<path>-meta.json``; checkpoints predating it default to
+    :class:`GossipTrainState` — the two layouts carry identical fields,
+    so rewrapping is always safe).  Pass ``like`` whenever
+    devices/shardings matter.
 
     ``data_stream`` (``load_state_dict()``-capable): restore the dataset
     position saved alongside this checkpoint.  Raises if the checkpoint
@@ -150,7 +168,15 @@ def restore_checkpoint(path: str, like: Optional[Any] = None, data_stream=None):
             )
         with open(sidecar) as f:
             data_stream.load_state_dict(json.load(f))
-    cls = type(like) if like is not None else GossipTrainState
+    if like is not None:
+        cls = type(like)
+    else:
+        layout = _layout_path(path)
+        name = ""
+        if os.path.exists(layout):
+            with open(layout) as f:
+                name = json.load(f).get("layout", "")
+        cls = _state_class(name)
     # Old checkpoints simply lack optional fields here; the state classes
     # default them (loss=None is accepted by both train steps).
     return cls(**restored)

@@ -38,10 +38,11 @@ class MetricsLogger:
     done, or use the logger as a context manager.  As a safety net an
     ``atexit`` flush is registered, so a forgotten close loses nothing on
     a clean interpreter exit — but records written that late appear after
-    anything else the process printed.  Interleaving direct :meth:`log`
-    calls between deferred :meth:`log_exchange` calls can emit lines out
-    of step order (the deferred record carries its original ``step``/``t``
-    stamps); call :meth:`flush` first if strict file order matters."""
+    anything else the process printed.  Output order is guaranteed:
+    every logging point (:meth:`log` or :meth:`log_exchange`) first
+    writes any pending deferred record, so records always land in the
+    order they were produced — just one logging interval late, with
+    their original ``step``/``t`` stamps."""
 
     def __init__(
         self,
@@ -65,6 +66,11 @@ class MetricsLogger:
     def log(self, step: int, _t: Optional[float] = None, **fields: Any) -> None:
         if step % self.every != 0:
             return
+        # Keep file order == production order: a deferred exchange record
+        # from an earlier step must land before this one.  (flush() pops
+        # _pending before re-entering log(), so this never recurses.)
+        if self._pending is not None:
+            self.flush()
         rec: dict[str, Any] = {
             "step": int(step),
             "t": round(

@@ -17,7 +17,10 @@ from typing import Optional
 
 import numpy as np
 
-_SRC = os.path.join(os.path.dirname(__file__), "dpwa_native.cpp")
+_SRCS = [
+    os.path.join(os.path.dirname(__file__), "dpwa_native.cpp"),
+    os.path.join(os.path.dirname(__file__), "rx_server.cpp"),
+]
 _LIB = os.path.join(os.path.dirname(__file__), "_libdpwa_native.so")
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
@@ -27,7 +30,7 @@ _tried = False
 def _build() -> bool:
     try:
         subprocess.run(
-            ["g++", "-O3", "-shared", "-fPIC", "-o", _LIB, _SRC],
+            ["g++", "-O3", "-shared", "-fPIC", "-pthread", "-o", _LIB, *_SRCS],
             check=True,
             capture_output=True,
             timeout=120,
@@ -44,9 +47,9 @@ def load() -> Optional[ctypes.CDLL]:
         if _lib is not None or _tried:
             return _lib
         _tried = True
-        if not os.path.exists(_LIB) or os.path.getmtime(
-            _LIB
-        ) < os.path.getmtime(_SRC):
+        if not os.path.exists(_LIB) or any(
+            os.path.getmtime(_LIB) < os.path.getmtime(src) for src in _SRCS
+        ):
             if not _build():
                 return None
         try:
@@ -71,8 +74,50 @@ def load() -> Optional[ctypes.CDLL]:
             ctypes.c_size_t,
         ]
         lib.dpwa_checksum.restype = ctypes.c_uint64
+        lib.dpwa_server_create.argtypes = [ctypes.c_char_p, ctypes.c_int]
+        lib.dpwa_server_create.restype = ctypes.c_void_p
+        lib.dpwa_server_port.argtypes = [ctypes.c_void_p]
+        lib.dpwa_server_port.restype = ctypes.c_int
+        # c_char_p: the C side only READS the payload, so the immutable
+        # bytes object passes zero-copy (no per-publish ctypes buffer).
+        lib.dpwa_server_publish.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_char_p,
+            ctypes.c_size_t,
+        ]
+        lib.dpwa_server_close.argtypes = [ctypes.c_void_p]
         _lib = lib
         return _lib
+
+
+class NativeRxServer:
+    """ctypes handle to the C++ Rx server (rx_server.cpp).
+
+    Same observable behavior as the Python ``PeerServer`` thread — serves
+    the latest pre-framed payload to any peer sending the request magic —
+    but the serve loop is a native thread that never touches the GIL.
+    Construction raises if the native library (or the bind) is
+    unavailable; callers fall back to the Python server."""
+
+    def __init__(self, host: str, port: int):
+        lib = load()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        self._handle = lib.dpwa_server_create(host.encode(), int(port))
+        if not self._handle:
+            raise RuntimeError(f"native Rx server failed to bind {host}:{port}")
+        self.port = int(lib.dpwa_server_port(self._handle))
+
+    def publish_framed(self, payload: bytes) -> None:
+        if not self._handle:
+            return  # after close(): harmless no-op, like the Python server
+        self._lib.dpwa_server_publish(self._handle, payload, len(payload))
+
+    def close(self) -> None:
+        if self._handle:
+            self._lib.dpwa_server_close(self._handle)
+            self._handle = None
 
 
 def _fptr(a: np.ndarray):

@@ -1,0 +1,158 @@
+// Native Rx server for the CPU/TCP gossip path.
+//
+// The reference's always-on listener is a Python thread (SURVEY.md §3.3:
+// accept → read framed request → send latest published blob).  Under
+// free-running training that thread competes with the train loop for the
+// GIL: every fetch served steals interpreter time from fwd/bwd, and a slow
+// fetcher can hold the GIL boundary for the whole send.  This is the same
+// loop in C++ — one detached native thread per node, zero GIL interaction;
+// the training thread only swaps the publish buffer under a mutex.
+//
+// Protocol (identical to dpwa_tpu/parallel/tcp.py): request is the 5-byte
+// magic "DPWA?"; response is the pre-framed payload Python hands to
+// dpwa_server_publish (header + raw vector bytes).  Framing stays in
+// Python so there is exactly ONE definition of the wire format.
+//
+// Exposed C ABI (ctypes, see dpwa_tpu/native/__init__.py):
+//   dpwa_server_create(host, port) -> handle (NULL on bind failure)
+//   dpwa_server_port(h)            -> bound port (resolves port=0)
+//   dpwa_server_publish(h, p, n)   -> swap the served payload
+//   dpwa_server_close(h)           -> stop thread, close socket, free
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+namespace {
+
+constexpr char kReq[5] = {'D', 'P', 'W', 'A', '?'};
+
+struct DpwaServer {
+  int listen_fd = -1;
+  int port = 0;
+  std::atomic<bool> stop{false};
+  std::mutex mu;
+  std::vector<uint8_t> payload;
+  bool has_payload = false;
+  std::thread thread;
+};
+
+bool recv_exact(int fd, uint8_t* buf, size_t n) {
+  size_t got = 0;
+  while (got < n) {
+    ssize_t r = recv(fd, buf + got, n - got, 0);
+    if (r <= 0) return false;  // timeout, error, or peer closed
+    got += static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool send_all(int fd, const uint8_t* buf, size_t n) {
+  size_t sent = 0;
+  while (sent < n) {
+    ssize_t r = send(fd, buf + sent, n - sent, MSG_NOSIGNAL);
+    if (r <= 0) return false;
+    sent += static_cast<size_t>(r);
+  }
+  return true;
+}
+
+void serve_loop(DpwaServer* s) {
+  pollfd pfd{s->listen_fd, POLLIN, 0};
+  while (!s->stop.load(std::memory_order_relaxed)) {
+    int rc = poll(&pfd, 1, 200);  // 200 ms stop-check cadence
+    if (rc <= 0) continue;
+    sockaddr_in addr;
+    socklen_t alen = sizeof(addr);
+    int conn = accept(s->listen_fd, reinterpret_cast<sockaddr*>(&addr), &alen);
+    if (conn < 0) continue;
+    timeval tv{5, 0};  // per-connection 5 s timeouts, as the Python server
+    setsockopt(conn, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+    setsockopt(conn, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+    uint8_t req[sizeof(kReq)];
+    if (recv_exact(conn, req, sizeof(kReq)) &&
+        std::memcmp(req, kReq, sizeof(kReq)) == 0) {
+      // Copy under the lock, send outside it: a slow fetcher must never
+      // block the training thread's publish.
+      std::vector<uint8_t> copy;
+      bool has;
+      {
+        std::lock_guard<std::mutex> g(s->mu);
+        has = s->has_payload;
+        if (has) copy = s->payload;
+      }
+      if (has) send_all(conn, copy.data(), copy.size());
+    }
+    close(conn);
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+void* dpwa_server_create(const char* host, int port) {
+  // getaddrinfo, not inet_pton: the YAML nodes: list may name hosts (the
+  // real multi-machine case) — Python's socket.bind resolves them, and
+  // the native server must accept exactly the same hosts.
+  addrinfo hints{};
+  hints.ai_family = AF_INET;
+  hints.ai_socktype = SOCK_STREAM;
+  hints.ai_flags = AI_PASSIVE;
+  addrinfo* res = nullptr;
+  if (getaddrinfo(host, nullptr, &hints, &res) != 0 || res == nullptr) {
+    return nullptr;
+  }
+  sockaddr_in addr{};
+  std::memcpy(&addr, res->ai_addr, sizeof(addr));
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  freeaddrinfo(res);
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return nullptr;
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  if (bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0 ||
+      listen(fd, 16) != 0) {
+    close(fd);
+    return nullptr;
+  }
+  socklen_t alen = sizeof(addr);
+  getsockname(fd, reinterpret_cast<sockaddr*>(&addr), &alen);
+  auto* s = new DpwaServer;
+  s->listen_fd = fd;
+  s->port = ntohs(addr.sin_port);
+  s->thread = std::thread(serve_loop, s);
+  return s;
+}
+
+int dpwa_server_port(void* h) {
+  return static_cast<DpwaServer*>(h)->port;
+}
+
+void dpwa_server_publish(void* h, const uint8_t* data, size_t n) {
+  auto* s = static_cast<DpwaServer*>(h);
+  std::lock_guard<std::mutex> g(s->mu);
+  s->payload.assign(data, data + n);
+  s->has_payload = true;
+}
+
+void dpwa_server_close(void* h) {
+  auto* s = static_cast<DpwaServer*>(h);
+  s->stop.store(true);
+  if (s->thread.joinable()) s->thread.join();
+  if (s->listen_fd >= 0) close(s->listen_fd);
+  delete s;
+}
+
+}  // extern "C"

@@ -68,6 +68,27 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     return bytes(buf)
 
 
+def _frame(vec: np.ndarray, clock: float, loss: float) -> bytes:
+    """Header + raw vector bytes — the one definition of the wire format,
+    shared by the Python and native Rx servers."""
+    vec = np.ascontiguousarray(vec)
+    # Exact-dtype lookup first (covers bf16, whose custom numpy dtype
+    # has no byte-order variants), then the byte-order-normalized
+    # form, then an f32 fallback.
+    code = _DTYPE_CODES.get(vec.dtype)
+    if code is None:
+        try:
+            code = _DTYPE_CODES.get(np.dtype(vec.dtype.newbyteorder("<")))
+        except (TypeError, ValueError):  # pragma: no cover
+            code = None
+    if code is None:
+        vec = vec.astype("<f4")
+        code = _DTYPE_CODES[np.dtype("<f4")]
+    data = vec.tobytes()
+    header = _HDR.pack(_MAGIC, 1, code, float(clock), float(loss), len(data))
+    return header + data
+
+
 class PeerServer:
     """The Rx thread: serves this node's latest published blob.
 
@@ -90,23 +111,9 @@ class PeerServer:
         self._thread.start()
 
     def publish(self, vec: np.ndarray, clock: float, loss: float) -> None:
-        vec = np.ascontiguousarray(vec)
-        # Exact-dtype lookup first (covers bf16, whose custom numpy dtype
-        # has no byte-order variants), then the byte-order-normalized
-        # form, then an f32 fallback.
-        code = _DTYPE_CODES.get(vec.dtype)
-        if code is None:
-            try:
-                code = _DTYPE_CODES.get(np.dtype(vec.dtype.newbyteorder("<")))
-            except (TypeError, ValueError):  # pragma: no cover
-                code = None
-        if code is None:
-            vec = vec.astype("<f4")
-            code = _DTYPE_CODES[np.dtype("<f4")]
-        data = vec.tobytes()
-        header = _HDR.pack(_MAGIC, 1, code, float(clock), float(loss), len(data))
+        payload = _frame(vec, clock, loss)
         with self._lock:
-            self._payload = header + data
+            self._payload = payload
 
     def _serve(self) -> None:
         try:
@@ -144,6 +151,42 @@ class PeerServer:
         except OSError:
             pass
         self._thread.join(timeout=2.0)
+
+
+class NativePeerServer:
+    """Rx server backed by the C++ serve loop (native/rx_server.cpp).
+
+    Same protocol and publish semantics as :class:`PeerServer`; the serve
+    thread is native, so fetches from peers cost this process zero GIL
+    time — under free-running training the Python Rx thread otherwise
+    competes with fwd/bwd for the interpreter."""
+
+    def __init__(self, host: str, port: int):
+        from dpwa_tpu import native
+
+        self._srv = native.NativeRxServer(host, port)
+        self.port = self._srv.port
+
+    def publish(self, vec: np.ndarray, clock: float, loss: float) -> None:
+        self._srv.publish_framed(_frame(vec, clock, loss))
+
+    def close(self) -> None:
+        self._srv.close()
+
+
+def make_peer_server(host: str, port: int):
+    """Native Rx server when the toolchain allows, Python thread otherwise.
+
+    ``DPWA_NATIVE_RX=0`` forces the Python server (debugging / parity
+    tests)."""
+    import os
+
+    if os.environ.get("DPWA_NATIVE_RX", "1") != "0":
+        try:
+            return NativePeerServer(host, port)
+        except (RuntimeError, OSError):
+            pass  # no toolchain / bind raced: identical Python fallback
+    return PeerServer(host, port)
 
 
 def fetch_blob(
@@ -188,7 +231,7 @@ class TcpTransport:
         if self._wire_bf16 and ml_dtypes is None:  # pragma: no cover
             raise RuntimeError("wire_dtype bf16 requires ml_dtypes")
         spec = config.nodes[self.me]
-        self.server = PeerServer(spec.host, spec.port)
+        self.server = make_peer_server(spec.host, spec.port)
         self._ports = {
             i: (n.host, n.port) for i, n in enumerate(config.nodes)
         }

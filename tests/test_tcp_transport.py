@@ -6,7 +6,54 @@ import numpy as np
 import pytest
 
 from dpwa_tpu.config import make_local_config
-from dpwa_tpu.parallel.tcp import PeerServer, TcpTransport, fetch_blob
+from dpwa_tpu.parallel.tcp import (
+    NativePeerServer,
+    PeerServer,
+    TcpTransport,
+    fetch_blob,
+    make_peer_server,
+)
+
+
+def test_native_rx_server_parity_with_python_server():
+    """The C++ Rx server must serve byte-identical blobs and metadata to
+    the Python thread for every wire dtype, including publish overwrite
+    and the no-payload-yet case."""
+    try:
+        nat = NativePeerServer("127.0.0.1", 0)
+    except (RuntimeError, OSError):
+        pytest.skip("native toolchain unavailable")
+    py = PeerServer("127.0.0.1", 0)
+    try:
+        # Before any publish: fetch must come back empty (None) from both.
+        assert fetch_blob("127.0.0.1", nat.port, 500) is None
+        assert fetch_blob("127.0.0.1", py.port, 500) is None
+        for dtype in (np.float32, np.float64):
+            vec = np.arange(513, dtype=dtype)
+            nat.publish(vec, 7.0, 0.125)
+            py.publish(vec, 7.0, 0.125)
+            got_n = fetch_blob("127.0.0.1", nat.port, 2000)
+            got_p = fetch_blob("127.0.0.1", py.port, 2000)
+            assert got_n is not None and got_p is not None
+            np.testing.assert_array_equal(got_n[0], got_p[0])
+            assert got_n[1:] == got_p[1:] == (7.0, 0.125)
+        # Overwrite: latest publish wins.
+        nat.publish(np.full(8, 9.0, np.float32), 8.0, 0.5)
+        vec, clock, loss = fetch_blob("127.0.0.1", nat.port, 2000)
+        np.testing.assert_array_equal(vec, np.full(8, 9.0, np.float32))
+        assert (clock, loss) == (8.0, 0.5)
+    finally:
+        nat.close()
+        py.close()
+
+
+def test_make_peer_server_env_fallback(monkeypatch):
+    monkeypatch.setenv("DPWA_NATIVE_RX", "0")
+    srv = make_peer_server("127.0.0.1", 0)
+    try:
+        assert isinstance(srv, PeerServer)
+    finally:
+        srv.close()
 
 
 def make_ring(n, **cfg_kwargs):
