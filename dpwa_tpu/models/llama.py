@@ -50,6 +50,12 @@ class LlamaConfig:
     # The sp path is unaffected (ring attention is already blockwise).
     attn_impl: str = "auto"
 
+    def __post_init__(self):
+        if self.attn_impl not in ("auto", "flash", "dense"):
+            raise ValueError(
+                f"attn_impl must be auto|flash|dense, got {self.attn_impl!r}"
+            )
+
     @property
     def kv_heads(self) -> int:
         return self.n_kv_heads or self.n_heads

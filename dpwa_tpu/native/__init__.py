@@ -74,18 +74,32 @@ def load() -> Optional[ctypes.CDLL]:
             ctypes.c_size_t,
         ]
         lib.dpwa_checksum.restype = ctypes.c_uint64
-        lib.dpwa_server_create.argtypes = [ctypes.c_char_p, ctypes.c_int]
-        lib.dpwa_server_create.restype = ctypes.c_void_p
-        lib.dpwa_server_port.argtypes = [ctypes.c_void_p]
-        lib.dpwa_server_port.restype = ctypes.c_int
-        # c_char_p: the C side only READS the payload, so the immutable
-        # bytes object passes zero-copy (no per-publish ctypes buffer).
-        lib.dpwa_server_publish.argtypes = [
-            ctypes.c_void_p,
-            ctypes.c_char_p,
-            ctypes.c_size_t,
-        ]
-        lib.dpwa_server_close.argtypes = [ctypes.c_void_p]
+        if not hasattr(lib, "dpwa_server_create"):
+            # Stale cached .so predating rx_server.cpp (mtime checks can
+            # miss when files arrive via tar/rsync with preserved times):
+            # rebuild once.  NOTE dlopen may return the old mapping for
+            # the same path in this process; if the symbols are still
+            # absent, the merge/checksum entry points keep working and
+            # NativeRxServer reports unavailable (Python server fallback).
+            if _build():
+                try:
+                    lib = ctypes.CDLL(_LIB)
+                except OSError:
+                    return None
+        if hasattr(lib, "dpwa_server_create"):
+            lib.dpwa_server_create.argtypes = [ctypes.c_char_p, ctypes.c_int]
+            lib.dpwa_server_create.restype = ctypes.c_void_p
+            lib.dpwa_server_port.argtypes = [ctypes.c_void_p]
+            lib.dpwa_server_port.restype = ctypes.c_int
+            # c_char_p: the C side only READS the payload, so the
+            # immutable bytes object passes zero-copy (no per-publish
+            # ctypes buffer).
+            lib.dpwa_server_publish.argtypes = [
+                ctypes.c_void_p,
+                ctypes.c_char_p,
+                ctypes.c_size_t,
+            ]
+            lib.dpwa_server_close.argtypes = [ctypes.c_void_p]
         _lib = lib
         return _lib
 
@@ -101,8 +115,8 @@ class NativeRxServer:
 
     def __init__(self, host: str, port: int):
         lib = load()
-        if lib is None:
-            raise RuntimeError("native library unavailable")
+        if lib is None or not hasattr(lib, "dpwa_server_create"):
+            raise RuntimeError("native Rx server unavailable")
         self._lib = lib
         self._handle = lib.dpwa_server_create(host.encode(), int(port))
         if not self._handle:

@@ -58,9 +58,15 @@ bool recv_exact(int fd, uint8_t* buf, size_t n) {
   return true;
 }
 
-bool send_all(int fd, const uint8_t* buf, size_t n) {
+bool send_all(int fd, const uint8_t* buf, size_t n,
+              const std::atomic<bool>& stop) {
+  // Each send() returns within SO_SNDTIMEO (5 s); checking the stop flag
+  // between chunks bounds close() at one timeout even when a peer reads
+  // at a trickle (each trickled ACK restarts the timeout, so a multi-MB
+  // payload could otherwise hold this loop for minutes).
   size_t sent = 0;
   while (sent < n) {
+    if (stop.load(std::memory_order_relaxed)) return false;
     ssize_t r = send(fd, buf + sent, n - sent, MSG_NOSIGNAL);
     if (r <= 0) return false;
     sent += static_cast<size_t>(r);
@@ -92,7 +98,7 @@ void serve_loop(DpwaServer* s) {
         has = s->has_payload;
         if (has) copy = s->payload;
       }
-      if (has) send_all(conn, copy.data(), copy.size());
+      if (has) send_all(conn, copy.data(), copy.size(), s->stop);
     }
     close(conn);
   }

@@ -183,14 +183,39 @@ def run_single_process(args, stacked: bool) -> None:
     payload = tree_size_bytes(jax.tree.map(lambda v: v[0], stacked_params))
 
     metrics = MetricsLogger(stream=sys.stdout, every=args.log_every)
-    batches = device_prefetch(
-        peer_batches(x_tr, y_tr, n, args.batch_size, seed=cfg.protocol.seed),
-        sharding=batch_sharding,
+    stream = peer_batches(
+        x_tr, y_tr, n, args.batch_size, seed=cfg.protocol.seed
     )
+    start = 0
+    if args.checkpoint:
+        # Checkpointing consumes the stream directly (no device_prefetch):
+        # prefetch keeps a lookahead of batches in flight, so the stream's
+        # saved cursor would run AHEAD of what training actually consumed
+        # and a resume would skip those batches.  Exactness beats the
+        # copy-overlap here.
+        batches = stream
+        if args.resume:
+            from dpwa_tpu.checkpoint import restore_checkpoint
+
+            state = restore_checkpoint(
+                args.checkpoint, like=state, data_stream=stream
+            )
+            start = int(state.step)
+            print(f"resumed at step {start} (batch {stream.batch_count})")
+    else:
+        batches = device_prefetch(stream, sharding=batch_sharding)
     try:
-        for step in range(args.steps):
-            state, losses, info = step_fn(state, next(batches))
+        for step in range(start, args.steps):
+            batch = next(batches)
+            if args.checkpoint:
+                batch = jax.device_put(batch, batch_sharding)
+            state, losses, info = step_fn(state, batch)
             metrics.log_exchange(step, losses, info, payload_bytes=payload)
+            if args.checkpoint and (step + 1) % args.save_every == 0:
+                from dpwa_tpu.checkpoint import save_checkpoint
+
+                jax.block_until_ready(state.params)
+                save_checkpoint(args.checkpoint, state, data_stream=stream)
     finally:
         metrics.close()
     eval_fn = make_gossip_eval_fn(model.apply, eval_transport)
@@ -214,6 +239,14 @@ def main() -> None:
     ap.add_argument("--lr", type=float, default=2e-3)
     ap.add_argument("--log-every", type=int, default=25)
     ap.add_argument(
+        "--checkpoint", metavar="DIR",
+        help="ici/stacked: save full state + data-stream position here "
+        "every --save-every steps; with --resume, continue the exact "
+        "run (same batches, same exchange sequence)",
+    )
+    ap.add_argument("--save-every", type=int, default=50)
+    ap.add_argument("--resume", action="store_true")
+    ap.add_argument(
         "--platform", default="cpu",
         help="TCP mode: jax platform per worker (default cpu)",
     )
@@ -225,6 +258,13 @@ def main() -> None:
         "'auto' keeps jax's default device",
     )
     args = ap.parse_args()
+    if args.resume and not args.checkpoint:
+        ap.error("--resume requires --checkpoint DIR")
+    if args.checkpoint and args.transport == "tcp":
+        ap.error(
+            "--checkpoint is not wired into the per-process tcp loop; use "
+            "--transport ici or stacked"
+        )
     if args.transport == "tcp":
         if not args.name:
             ap.error("--transport tcp requires --name (this node's identity)")

@@ -22,6 +22,7 @@ Results -> artifacts/llama_block_real_dims.json.
 
 from __future__ import annotations
 
+import argparse
 import json
 import os
 import sys
@@ -30,7 +31,6 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 N_PEERS = 8
-T = 8192
 B = 1
 LORA_RANK = 16
 
@@ -55,6 +55,15 @@ def lora_params_per_block(cfg) -> int:
 
 
 def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument(
+        "--seq-len", type=int, default=8192,
+        help="tokens per block step (8192 = the model's native context; "
+        "drop to 4096 if the tunnel compile service struggles)",
+    )
+    args = ap.parse_args()
+    T = args.seq_len
+
     import jax
     import jax.numpy as jnp
     import numpy as np
