@@ -267,6 +267,27 @@ def test_spec_scale_mixing_128_peers(schedule, kwargs, cycles):
         assert x.std() < std0 / 1e4
 
 
+@pytest.mark.parametrize("n_groups,group_size", [(3, 4), (4, 4)])
+def test_hierarchical_pull_reaches_consensus(n_groups, group_size):
+    # Pull mode: one-sided merges x_i <- (x_i + x_src)/2.  The directed
+    # group ring is connected, so all replicas still contract to ONE
+    # value (not necessarily the initial mean — one-sided gossip is not
+    # doubly stochastic).  Guards the pull-mode analogue of the round-2
+    # pairwise disconnection bug.
+    n = n_groups * group_size
+    sched = build_schedule(
+        make_local_config(
+            n, schedule="hierarchical", mode="pull",
+            group_size=group_size, inter_period=3, fetch_probability=1.0,
+        )
+    )
+    x = np.arange(n, dtype=np.float64)
+    for step in range(80 * sched.period):
+        src = sched.pairing(step)
+        x = 0.5 * (x + x[src])
+    assert x.std() < 1e-8, x.std()
+
+
 def test_hierarchical_pool_dedupes_distinct_pairings():
     # Compile cost guard: the jit path builds one lax.switch branch per
     # pool row, so the pool must hold only DISTINCT pairings.  32 groups of
