@@ -250,11 +250,13 @@ PROBE_SNIPPET = (
 )
 
 
-def probe_backend(timeout_s: float) -> str | None:
-    """Init + tiny compile in a subprocess; returns platform or None.
+def probe_backend(timeout_s: float) -> tuple[str | None, bool]:
+    """Init + tiny compile in a subprocess; returns (platform, hung).
 
     The axon plugin has been observed to *hang* (not just raise) at init
     (VERDICT.md round 1), so the probe must be a killable subprocess.
+    ``hung`` distinguishes the transient tunnel wedge (worth one retry)
+    from deterministic failures (missing plugin, bad install — not).
     """
     try:
         proc = subprocess.run(
@@ -266,15 +268,15 @@ def probe_backend(timeout_s: float) -> str | None:
         )
     except subprocess.TimeoutExpired:
         log(f"backend probe HUNG past {timeout_s:.0f}s — treating as dead")
-        return None
+        return None, True
     if proc.returncode != 0:
         tail = (proc.stderr or "").strip().splitlines()[-1:]
         log(f"backend probe failed rc={proc.returncode}: {tail}")
-        return None
+        return None, False
     for line in proc.stdout.splitlines():
         if line.startswith("PLATFORM "):
-            return line.split(None, 1)[1].strip()
-    return None
+            return line.split(None, 1)[1].strip(), False
+    return None, False
 
 
 def run_leg(
@@ -375,7 +377,14 @@ def main() -> None:
     # --- Backend probe, then the watchdog'd device leg with CPU fallback.
     dev_gbps = None
     backend = "none"
-    platform = probe_backend(args.probe_timeout)
+    platform, hung = probe_backend(args.probe_timeout)
+    if platform is None and hung:
+        # Only the HANG case is worth retrying: the tunnel's wedges are
+        # sometimes transient, while a fast deterministic failure (rc!=0,
+        # missing plugin) will fail again identically.
+        log("backend probe hung; retrying once after 60s")
+        time.sleep(60)
+        platform, _ = probe_backend(args.probe_timeout)
     cpu_leg_args = [
         "--size", str(args.cpu_size),
         "--peers", str(args.peers),
