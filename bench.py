@@ -381,10 +381,13 @@ def main() -> None:
     if platform is None and hung:
         # Only the HANG case is worth retrying: the tunnel's wedges are
         # sometimes transient, while a fast deterministic failure (rc!=0,
-        # missing plugin) will fail again identically.
+        # missing plugin) will fail again identically.  The retry runs at
+        # a quarter of the probe budget — a recovered tunnel inits in
+        # seconds, so a short probe catches it while a still-wedged one
+        # costs ~60s extra, not another full budget.
         log("backend probe hung; retrying once after 60s")
         time.sleep(60)
-        platform, _ = probe_backend(args.probe_timeout)
+        platform, _ = probe_backend(max(60.0, args.probe_timeout / 4))
     cpu_leg_args = [
         "--size", str(args.cpu_size),
         "--peers", str(args.peers),
