@@ -47,6 +47,38 @@ def test_native_rx_server_parity_with_python_server():
         py.close()
 
 
+def test_native_rx_server_serves_concurrent_fetchers():
+    """Several peers fetching at once must all get complete blobs (the
+    native loop serves connections sequentially; concurrency shows up as
+    queued accepts, never partial or interleaved payloads)."""
+    try:
+        srv = NativePeerServer("127.0.0.1", 0)
+    except (RuntimeError, OSError):
+        pytest.skip("native toolchain unavailable")
+    try:
+        vec = np.arange(200_000, dtype=np.float32)  # ~800 KB blob
+        srv.publish(vec, 5.0, 0.75)
+        results = [None] * 6
+
+        def fetch(i):
+            results[i] = fetch_blob("127.0.0.1", srv.port, 5000)
+
+        threads = [
+            threading.Thread(target=fetch, args=(i,))
+            for i in range(len(results))
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for got in results:
+            assert got is not None
+            np.testing.assert_array_equal(got[0], vec)
+            assert got[1:] == (5.0, 0.75)
+    finally:
+        srv.close()
+
+
 def test_make_peer_server_env_fallback(monkeypatch):
     monkeypatch.setenv("DPWA_NATIVE_RX", "0")
     srv = make_peer_server("127.0.0.1", 0)
