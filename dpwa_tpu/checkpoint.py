@@ -71,13 +71,26 @@ def save_checkpoint(path: str, state, data_stream=None) -> None:
     trajectory diverges on resume."""
     path = os.path.abspath(path)
     sidecar = _data_state_path(path)
+    # The previous save's sidecar is deliberately left in place until the
+    # new one atomically replaces it: pre-deleting would mean a crash
+    # during the Orbax write leaves the SURVIVING old checkpoint (Orbax
+    # writes atomically) with no sidecar — the last good resume point
+    # irrecoverably lost.  Stale-pairing protection comes from the
+    # ``ckpt_step`` stamp instead: restore refuses a sidecar whose stamp
+    # disagrees with the restored checkpoint's ``step``.  The ONE case
+    # still pre-deleted is a legacy UNSTAMPED sidecar (pre-stamp format):
+    # it cannot be verified against the new state, so a crash mid-save
+    # would silently pair it with the overwritten checkpoint — for that
+    # transition save only, keep the old fail-safe (restore raises
+    # FileNotFoundError rather than replaying the wrong batches).
     if os.path.exists(sidecar):
-        # Drop any PREVIOUS save's sidecar up front — also before a save
-        # WITH a stream, so a crash between the Orbax write and the new
-        # sidecar write fails safe (restore raises FileNotFoundError)
-        # instead of pairing the new state with a stale stream position
-        # and silently replaying the wrong batches.
-        os.remove(sidecar)
+        try:
+            with open(sidecar) as f:
+                old = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            old = None
+        if not (isinstance(old, dict) and "ckpt_step" in old):
+            os.remove(sidecar)
     with ocp.StandardCheckpointer() as ckptr:
         ckptr.save(path, dict(state._asdict()), force=True)
     meta_tmp = _layout_path(path) + ".tmp"
@@ -87,8 +100,19 @@ def save_checkpoint(path: str, state, data_stream=None) -> None:
     if data_stream is not None:
         tmp = sidecar + ".tmp"
         with open(tmp, "w") as f:
-            json.dump(data_stream.state_dict(), f)
+            json.dump(
+                {
+                    "ckpt_step": int(np.asarray(jax.device_get(state.step))),
+                    "data": data_stream.state_dict(),
+                },
+                f,
+            )
         os.replace(tmp, sidecar)  # atomic write
+    elif os.path.exists(sidecar):
+        # A no-stream re-save at the same path: drop the previous save's
+        # sidecar, but only AFTER the new Orbax write succeeded — a crash
+        # above leaves the old checkpoint+sidecar pair fully intact.
+        os.remove(sidecar)
 
 
 def _saved_keys(ckptr, path) -> Optional[set]:
@@ -167,7 +191,21 @@ def restore_checkpoint(path: str, like: Optional[Any] = None, data_stream=None):
                 "would replay different batches"
             )
         with open(sidecar) as f:
-            data_stream.load_state_dict(json.load(f))
+            payload = json.load(f)
+        if isinstance(payload, dict) and "ckpt_step" in payload:
+            ckpt_step = int(np.asarray(jax.device_get(restored["step"])))
+            if int(payload["ckpt_step"]) != ckpt_step:
+                raise ValueError(
+                    f"data-stream sidecar {sidecar} was written for step "
+                    f"{payload['ckpt_step']} but the checkpoint holds step "
+                    f"{ckpt_step}; refusing to pair a stale stream position "
+                    "with this state (a crash likely interrupted the save "
+                    "that would have replaced the sidecar)"
+                )
+            data_stream.load_state_dict(payload["data"])
+        else:
+            # Sidecar predating the ckpt_step stamp: raw state_dict.
+            data_stream.load_state_dict(payload)
     if like is not None:
         cls = type(like)
     else:

@@ -12,6 +12,7 @@ from __future__ import annotations
 import ctypes
 import os
 import subprocess
+import tempfile
 import threading
 from typing import Optional
 
@@ -28,15 +29,32 @@ _tried = False
 
 
 def _build() -> bool:
+    # Compile to a temp file and os.replace() over _LIB: rename keeps the
+    # old inode alive for any mapping already dlopen'ed in this (or another)
+    # process — truncating the .so in place risks SIGBUS on unfaulted pages —
+    # and gives the path a fresh inode so a re-dlopen actually loads the new
+    # code instead of returning the cached mapping.
+    # Per-process unique temp name: concurrently launched peers otherwise
+    # race g++ on one shared tmp file and can install a truncated .so whose
+    # fresh mtime suppresses every future rebuild.
+    fd, tmp = tempfile.mkstemp(
+        suffix=".so.tmp", dir=os.path.dirname(_LIB)
+    )
+    os.close(fd)
     try:
         subprocess.run(
-            ["g++", "-O3", "-shared", "-fPIC", "-pthread", "-o", _LIB, *_SRCS],
+            ["g++", "-O3", "-shared", "-fPIC", "-pthread", "-o", tmp, *_SRCS],
             check=True,
             capture_output=True,
             timeout=120,
         )
+        os.replace(tmp, _LIB)
         return True
     except (OSError, subprocess.SubprocessError):
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
         return False
 
 
@@ -56,6 +74,23 @@ def load() -> Optional[ctypes.CDLL]:
             lib = ctypes.CDLL(_LIB)
         except OSError:
             return None
+        if not hasattr(lib, "dpwa_server_create"):
+            # Stale cached .so predating rx_server.cpp (mtime checks can
+            # miss when files arrive via tar/rsync with preserved times):
+            # rebuild once.  _build() replaces the path with a fresh inode,
+            # so this re-dlopen loads the new code rather than the cached
+            # mapping; if the rebuild fails, merge/checksum keep working on
+            # the old handle and NativeRxServer reports unavailable
+            # (Python server fallback).
+            if _build():
+                try:
+                    lib = ctypes.CDLL(_LIB)
+                except OSError:
+                    return None
+        # Signature setup happens AFTER any rebuild so it is applied to
+        # whichever CDLL object is ultimately stored (a handle swapped in by
+        # the rebuild would otherwise default dpwa_checksum.restype to c_int,
+        # silently truncating the 64-bit FNV).
         lib.dpwa_merge_out.argtypes = [
             ctypes.POINTER(ctypes.c_float),
             ctypes.POINTER(ctypes.c_float),
@@ -74,18 +109,6 @@ def load() -> Optional[ctypes.CDLL]:
             ctypes.c_size_t,
         ]
         lib.dpwa_checksum.restype = ctypes.c_uint64
-        if not hasattr(lib, "dpwa_server_create"):
-            # Stale cached .so predating rx_server.cpp (mtime checks can
-            # miss when files arrive via tar/rsync with preserved times):
-            # rebuild once.  NOTE dlopen may return the old mapping for
-            # the same path in this process; if the symbols are still
-            # absent, the merge/checksum entry points keep working and
-            # NativeRxServer reports unavailable (Python server fallback).
-            if _build():
-                try:
-                    lib = ctypes.CDLL(_LIB)
-                except OSError:
-                    return None
         if hasattr(lib, "dpwa_server_create"):
             lib.dpwa_server_create.argtypes = [ctypes.c_char_p, ctypes.c_int]
             lib.dpwa_server_create.restype = ctypes.c_void_p

@@ -180,7 +180,17 @@ def _hierarchical_pull_pool(
     group (groups in a directed ring)."""
     if n % group_size != 0:
         raise ValueError(f"n_peers {n} not divisible by group_size {group_size}")
+    if inter_period < 1:
+        raise ValueError(f"inter_period must be >= 1, got {inter_period}")
     n_groups = n // group_size
+    if inter_period == 1 and group_size > 1 and n_groups > 1:
+        # Same disconnection as the pairwise pool: an all-inter pool never
+        # mixes across intra-group indices.
+        raise ValueError(
+            "hierarchical schedule with inter_period=1 has no intra-group "
+            "slots, so the gossip graph is disconnected for group_size >= 2; "
+            "use inter_period >= 2"
+        )
     pool = []
     for slot in range(inter_period):
         if slot == inter_period - 1 and n_groups > 1:
@@ -247,7 +257,18 @@ def _hierarchical_pool(
     """
     if n % group_size != 0:
         raise ValueError(f"n_peers {n} not divisible by group_size {group_size}")
+    if inter_period < 1:
+        raise ValueError(f"inter_period must be >= 1, got {inter_period}")
     n_groups = n // group_size
+    if inter_period == 1 and group_size > 1 and n_groups > 1:
+        # With inter_period=1 every slot is the index-preserving cross-group
+        # pairing: peers at different intra-group indices would never
+        # exchange — a permanently disconnected gossip graph.
+        raise ValueError(
+            "hierarchical schedule with inter_period=1 has no intra-group "
+            "slots, so the gossip graph is disconnected for group_size >= 2; "
+            "use inter_period >= 2"
+        )
     rounds = _group_round_robin(n_groups) if n_groups > 1 else [None]
     n_blocks = len(rounds)
     # Guarantee both intra ring phases appear in the pool (needed to connect
@@ -396,7 +417,7 @@ def build_schedule(config: DpwaConfig) -> Schedule:
             )
         elif proto.schedule == "hierarchical":
             group = proto.group_size or _auto_group_size(n)
-            pool = _hierarchical_pull_pool(n, group, max(2, proto.inter_period))
+            pool = _hierarchical_pull_pool(n, group, proto.inter_period)
         elif proto.schedule == "exponential":
             # XOR pairings are their own pull maps (involutions with no
             # fixed points) — identical pool in both modes; only the
@@ -413,7 +434,7 @@ def build_schedule(config: DpwaConfig) -> Schedule:
         )
     elif proto.schedule == "hierarchical":
         group = proto.group_size or _auto_group_size(n)
-        pool = _hierarchical_pool(n, group, max(2, proto.inter_period))
+        pool = _hierarchical_pool(n, group, proto.inter_period)
     elif proto.schedule == "exponential":
         pool = _exponential_pool(n)
     else:  # pragma: no cover - config validates earlier

@@ -230,6 +230,76 @@ def test_checkpoint_resave_clears_stale_data_sidecar(tmp_path):
         restore_checkpoint(ckpt, like=state, data_stream=fresh)
 
 
+def test_checkpoint_refuses_stale_step_sidecar(tmp_path):
+    """ADVICE r3: a sidecar stamped with a different step than the
+    checkpoint holds (the signature of a save interrupted between the
+    Orbax write and the sidecar replace) must be refused, not silently
+    paired with the wrong state."""
+    import json as _json
+
+    from dpwa_tpu.checkpoint import (
+        _data_state_path, restore_checkpoint, save_checkpoint,
+    )
+    from dpwa_tpu.data import PeerBatchStream, gaussian_blobs
+    from dpwa_tpu.parallel.stacked import StackedTransport, init_stacked_state
+
+    n = 2
+    x, y = gaussian_blobs(n_per_class=20)
+    stream = PeerBatchStream(x, y, n, batch_size=4)
+    cfg = make_local_config(n, schedule="ring")
+    state = init_stacked_state(
+        {"w": jnp.ones((n, 3))}, optax.sgd(0.1), StackedTransport(cfg)
+    )
+    ckpt = str(tmp_path / "ck")
+    save_checkpoint(ckpt, state, data_stream=stream)
+
+    sidecar = _data_state_path(ckpt)
+    with open(sidecar) as f:
+        payload = _json.load(f)
+    assert payload["ckpt_step"] == 0
+    payload["ckpt_step"] = 99  # simulate a sidecar from another save
+    with open(sidecar, "w") as f:
+        _json.dump(payload, f)
+
+    fresh = PeerBatchStream(x, y, n, batch_size=4)
+    with pytest.raises(ValueError, match="step 99"):
+        restore_checkpoint(ckpt, like=state, data_stream=fresh)
+    # Plain restore (no stream) is unaffected.
+    restore_checkpoint(ckpt, like=state)
+
+
+def test_checkpoint_legacy_sidecar_without_stamp(tmp_path):
+    """Sidecars written before the ckpt_step stamp are a raw state_dict;
+    restore must still accept them."""
+    import json as _json
+
+    from dpwa_tpu.checkpoint import (
+        _data_state_path, restore_checkpoint, save_checkpoint,
+    )
+    from dpwa_tpu.data import PeerBatchStream, gaussian_blobs
+    from dpwa_tpu.parallel.stacked import StackedTransport, init_stacked_state
+
+    n = 2
+    x, y = gaussian_blobs(n_per_class=20)
+    stream = PeerBatchStream(x, y, n, batch_size=4)
+    next(stream)
+    cfg = make_local_config(n, schedule="ring")
+    state = init_stacked_state(
+        {"w": jnp.ones((n, 3))}, optax.sgd(0.1), StackedTransport(cfg)
+    )
+    ckpt = str(tmp_path / "ck")
+    save_checkpoint(ckpt, state, data_stream=stream)
+    # Rewrite the sidecar in the legacy (unwrapped) format.
+    sidecar = _data_state_path(ckpt)
+    with open(sidecar) as f:
+        payload = _json.load(f)
+    with open(sidecar, "w") as f:
+        _json.dump(payload["data"], f)
+    fresh = PeerBatchStream(x, y, n, batch_size=4)
+    restore_checkpoint(ckpt, like=state, data_stream=fresh)
+    assert fresh.batch_count == 1
+
+
 def test_data_stream_state_rejects_mismatched_parameters():
     from dpwa_tpu.data import PeerBatchStream, gaussian_blobs
 

@@ -140,6 +140,34 @@ def test_hierarchical_rejects_indivisible():
         build_schedule(cfg)
 
 
+@pytest.mark.parametrize("mode", ["pairwise", "pull"])
+def test_hierarchical_rejects_inter_period_one(mode):
+    # ADVICE r3: inter_period=1 emits only the index-preserving cross-group
+    # slot — peers at different intra-group indices would never exchange
+    # (a permanently disconnected gossip graph for group_size >= 2).
+    cfg = make_local_config(
+        8, schedule="hierarchical", mode=mode, group_size=4, inter_period=1,
+    )
+    with pytest.raises(ValueError, match="inter_period=1"):
+        build_schedule(cfg)
+    with pytest.raises(ValueError, match="inter_period"):
+        build_schedule(
+            make_local_config(
+                8, schedule="hierarchical", mode=mode, group_size=4,
+                inter_period=0,
+            )
+        )
+    # Degenerate shapes where an all-inter pool is actually fine:
+    # group_size=1 (nothing to mix within a group).
+    sched = build_schedule(
+        make_local_config(
+            4, schedule="hierarchical", mode=mode, group_size=1,
+            inter_period=1, fetch_probability=1.0,
+        )
+    )
+    assert sched.pool.shape[1] == 4
+
+
 def test_participation_draw_matches_host_and_is_pair_symmetric():
     cfg = make_local_config(
         8, schedule="ring", fetch_probability=0.5, seed=11
