@@ -37,11 +37,12 @@ def _build() -> bool:
     # Per-process unique temp name: concurrently launched peers otherwise
     # race g++ on one shared tmp file and can install a truncated .so whose
     # fresh mtime suppresses every future rebuild.
-    fd, tmp = tempfile.mkstemp(
-        suffix=".so.tmp", dir=os.path.dirname(_LIB)
-    )
-    os.close(fd)
+    tmp = None
     try:
+        fd, tmp = tempfile.mkstemp(
+            suffix=".so.tmp", dir=os.path.dirname(_LIB)
+        )
+        os.close(fd)
         subprocess.run(
             ["g++", "-O3", "-shared", "-fPIC", "-pthread", "-o", tmp, *_SRCS],
             check=True,
@@ -51,10 +52,13 @@ def _build() -> bool:
         os.replace(tmp, _LIB)
         return True
     except (OSError, subprocess.SubprocessError):
-        try:
-            os.unlink(tmp)
-        except OSError:
-            pass
+        # Covers an unwritable package dir (mkstemp) the same as a failed
+        # compile: callers degrade to the numpy fallback.
+        if tmp is not None:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
         return False
 
 

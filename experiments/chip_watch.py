@@ -1,0 +1,244 @@
+#!/usr/bin/env python
+"""Wedge-proof chip watcher: re-probe the tunneled TPU for the whole round.
+
+Two of three rounds lost their headline TPU bench artifact to the axon
+tunnel's wedge (backend init hangs indefinitely; see
+``artifacts/chip_tunnel_incident_r03.md``).  ``bench.py`` probes once and
+falls back to CPU — correct for a single invocation, but a tunnel that
+recovers MID-round went uncaptured.  This daemon closes that hole:
+
+- every ``--interval`` seconds (default 20 min) it probes the backend in a
+  killable subprocess (the wedge hangs, it does not raise), appending one
+  JSON line per probe to ``artifacts/probe_history.jsonl``;
+- on the FIRST probe that reports a non-CPU platform it runs the round's
+  chip jobs, in order of value-per-compile-risk:
+    1. ``experiments/llama_block_bench.py --seq-len 4096``
+    2. ``python bench.py`` (full size)  ->  ``artifacts/bench_tpu_capture.json``
+    3. ``experiments/llama_block_bench.py --seq-len 8192`` (LAST: the
+       T=8192 compile is the suspected trigger of the round-3 wedge, so it
+       must not be able to cost the other two artifacts)
+- ``bench.py`` reads the capture file when its own live run can only reach
+  CPU, so the round's recorded headline is the chip number whenever the
+  chip was alive at ANY point in the round (with full provenance fields).
+
+Probes are cheap on an alive tunnel (a few seconds) and bounded on a dead
+one (``--probe-timeout``, killed, logged).  The daemon keeps probing after
+the jobs are done so the history stays honest for the incident log.
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import json
+import os
+import shutil
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ART = os.path.join(REPO, "artifacts")
+HISTORY = os.path.join(ART, "probe_history.jsonl")
+CAPTURE = os.path.join(ART, "bench_tpu_capture.json")
+BLOCK_ARTIFACT = os.path.join(ART, "llama_block_real_dims.json")
+
+PROBE_SNIPPET = (
+    "import jax, jax.numpy as jnp;"
+    "print('PLATFORM', jax.devices()[0].platform);"
+    "print('SUM', float(jnp.ones(8).sum()))"
+)
+
+
+def now_utc() -> str:
+    return datetime.datetime.now(datetime.timezone.utc).strftime(
+        "%Y-%m-%dT%H:%M:%SZ"
+    )
+
+
+def log(msg: str) -> None:
+    print(f"[chip_watch {now_utc()}] {msg}", file=sys.stderr, flush=True)
+
+
+def append_history(record: dict) -> None:
+    os.makedirs(ART, exist_ok=True)
+    with open(HISTORY, "a") as f:
+        f.write(json.dumps(record) + "\n")
+
+
+def probe(timeout_s: float) -> tuple[str | None, bool]:
+    """(platform, hung) — same probe contract as bench.py's."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", PROBE_SNIPPET],
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+            env=os.environ.copy(),
+            cwd=REPO,
+        )
+    except subprocess.TimeoutExpired:
+        return None, True
+    if proc.returncode != 0:
+        return None, False
+    for line in proc.stdout.splitlines():
+        if line.startswith("PLATFORM "):
+            return line.split(None, 1)[1].strip(), False
+    return None, False
+
+
+def run_job(cmd: list[str], timeout_s: float, tag: str) -> tuple[bool, str]:
+    """Run one chip job; (ok, stdout).  Timeouts kill the child — a wedged
+    compile must not freeze the watcher itself."""
+    log(f"{tag}: {' '.join(cmd)}")
+    try:
+        proc = subprocess.run(
+            cmd,
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+            env=os.environ.copy(),
+            cwd=REPO,
+        )
+    except subprocess.TimeoutExpired:
+        log(f"{tag}: HUNG past {timeout_s:.0f}s — killed")
+        return False, ""
+    tail = (proc.stderr or "").strip().splitlines()[-3:]
+    for t in tail:
+        log(f"{tag} stderr| {t}")
+    if proc.returncode != 0:
+        log(f"{tag}: failed rc={proc.returncode}")
+        return False, proc.stdout or ""
+    log(f"{tag}: ok")
+    return True, proc.stdout or ""
+
+
+def capture_bench(stdout: str) -> bool:
+    """Persist bench.py's JSON line (+provenance) as the round capture."""
+    line = None
+    for ln in stdout.splitlines():
+        ln = ln.strip()
+        if ln.startswith("{") and '"metric"' in ln:
+            line = ln
+    if line is None:
+        log("bench run produced no JSON line")
+        return False
+    try:
+        data = json.loads(line)
+    except json.JSONDecodeError:
+        log("bench JSON line unparseable")
+        return False
+    if data.get("backend") not in ("tpu", "axon"):
+        log(f"bench ran on backend={data.get('backend')!r}; not capturing")
+        return False
+    if "live_run_backend" in data or "captured_at_utc" in data:
+        # bench.py replayed an EXISTING capture (its live run fell back to
+        # CPU) — re-stamping it would falsify when the chip number was
+        # actually measured.
+        log("bench output is a replayed capture; not re-stamping")
+        return False
+    data["captured_at_utc"] = now_utc()
+    data["captured_by"] = "experiments/chip_watch.py"
+    with open(CAPTURE + ".tmp", "w") as f:
+        json.dump(data, f, indent=1)
+    os.replace(CAPTURE + ".tmp", CAPTURE)
+    log(f"TPU bench captured: {data['value']} {data['unit']}")
+    return True
+
+
+def run_chip_jobs(job_timeout: float) -> dict:
+    """The round's chip work, cheapest-compile first.  Each job's outcome
+    is recorded; a failure (or fresh wedge) mid-sequence keeps earlier
+    artifacts."""
+    outcomes = {}
+    ok4096, _ = run_job(
+        [sys.executable, "experiments/llama_block_bench.py",
+         "--seq-len", "4096"],
+        job_timeout,
+        "llama-block-4096",
+    )
+    outcomes["llama_block_4096"] = ok4096
+    if ok4096 and os.path.exists(BLOCK_ARTIFACT):
+        # Keep the 4096 result under its own name: the 8192 run (if it
+        # survives the compile) overwrites the main artifact.
+        shutil.copyfile(
+            BLOCK_ARTIFACT,
+            os.path.join(ART, "llama_block_real_dims_T4096.json"),
+        )
+
+    ok_bench, stdout = run_job(
+        [sys.executable, "bench.py"], job_timeout, "bench-full"
+    )
+    outcomes["bench_full"] = ok_bench and capture_bench(stdout)
+
+    if ok4096 and outcomes["bench_full"]:
+        # Only attempt the native-context compile once BOTH cheaper
+        # artifacts are safely on disk — a wedge triggered here must not
+        # be able to cost the headline bench capture.
+        ok8192, _ = run_job(
+            [sys.executable, "experiments/llama_block_bench.py",
+             "--seq-len", "8192"],
+            job_timeout,
+            "llama-block-8192",
+        )
+        outcomes["llama_block_8192"] = ok8192
+    return outcomes
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--interval", type=float, default=1200.0,
+                    help="seconds between probes")
+    ap.add_argument("--probe-timeout", type=float, default=120.0)
+    ap.add_argument("--job-timeout", type=float, default=3000.0,
+                    help="per chip-job watchdog")
+    ap.add_argument("--max-hours", type=float, default=14.0,
+                    help="stop probing after this long (round is over)")
+    ap.add_argument("--once", action="store_true",
+                    help="single probe (and jobs if alive), then exit")
+    args = ap.parse_args()
+
+    deadline = time.monotonic() + args.max_hours * 3600
+    if not args.once:
+        # The daemon is launched once per round: rotate any capture/history
+        # left by a PREVIOUS round so a stale chip number can never be
+        # promoted to this round's headline (bench.py also enforces a
+        # freshness bound on captured_at_utc as a second line of defense).
+        for path in (CAPTURE, HISTORY):
+            if os.path.exists(path):
+                root, ext = os.path.splitext(path)
+                os.replace(path, f"{root}_prev{ext}")
+                log(f"rotated stale {os.path.basename(path)} from a "
+                    "previous round")
+    jobs_done = os.path.exists(CAPTURE)
+    if jobs_done:
+        log(f"capture already exists ({CAPTURE}); probing for history only")
+    while True:
+        platform, hung = probe(args.probe_timeout)
+        alive = platform is not None and platform != "cpu"
+        append_history(
+            {
+                "t_utc": now_utc(),
+                "alive": alive,
+                "platform": platform,
+                "hung": hung,
+            }
+        )
+        log(f"probe: platform={platform!r} hung={hung} alive={alive}")
+        if alive and not jobs_done:
+            outcomes = run_chip_jobs(args.job_timeout)
+            append_history(
+                {"t_utc": now_utc(), "chip_jobs": outcomes}
+            )
+            # Done means the bench capture exists; block benches may have
+            # individually failed and are retried on the next alive probe.
+            jobs_done = os.path.exists(CAPTURE) and outcomes.get(
+                "llama_block_4096", False
+            )
+        if args.once or time.monotonic() >= deadline:
+            break
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    main()
