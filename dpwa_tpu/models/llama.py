@@ -175,8 +175,12 @@ class Attention(nn.Module):
             # on the fabric.
             from dpwa_tpu.ops.ring_attention import ring_attention_local
 
+            # attn_impl maps onto the ring hop implementation: auto/flash
+            # run each hop through the Pallas flash kernel (VMEM score
+            # tiles) when eligible; dense keeps the q-chunked einsum hop.
             out = ring_attention_local(
-                q, k, v, axis_name=cfg.sp_axis, causal=True
+                q, k, v, axis_name=cfg.sp_axis, causal=True,
+                impl="xla" if cfg.attn_impl == "dense" else cfg.attn_impl,
             ).reshape(B, T, H * D)
             return dense(cfg.d_model, "wo")(out)
         if KV != H:  # GQA: repeat kv heads

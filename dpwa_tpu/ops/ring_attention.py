@@ -86,6 +86,7 @@ def ring_attention_local(
     axis_name: str = "sp",
     causal: bool = True,
     q_chunk: Optional[int] = None,
+    impl: str = "auto",
 ) -> jnp.ndarray:
     """Call INSIDE shard_map over ``axis_name``.
 
@@ -99,8 +100,30 @@ def ring_attention_local(
         body is rematerialized (``jax.checkpoint``), so the backward pass
         recomputes score panels instead of carrying sp-many of them as
         scan residuals.  Long-context memory is O(T_local) activations.
+      impl: "auto" runs every hop through the Pallas flash kernel on TPU
+        when shapes allow (:mod:`dpwa_tpu.ops.flash_ring` — VMEM score
+        tiles, never HBM panels); "flash" requests the same (on a TPU
+        with ineligible shapes it falls back to THIS module's chunked
+        einsum hop, never the flash-ring jnp twin, whose per-hop
+        [B,H,T,T] panel would be a memory regression at long T; off-TPU
+        it forces the twin — the CPU parity tests' hook); "xla" keeps
+        the q-chunked einsum hop.  An EXPLICIT ``q_chunk`` pins the
+        einsum hop too — it tunes a knob only that path has.
     Returns the local block of the attention output, ``[B, T_local, H, D]``.
     """
+    if impl != "xla" and q_chunk is None:
+        from dpwa_tpu.ops.flash_ring import (
+            flash_ring_supported,
+            ring_flash_attention_local,
+        )
+
+        on_tpu = jax.default_backend() == "tpu"
+        if (on_tpu and flash_ring_supported(q.shape)) or (
+            not on_tpu and impl == "flash"
+        ):
+            # Kernel choice (pallas vs jnp twin) auto-resolves by backend
+            # inside flash_ring.
+            return ring_flash_attention_local(q, k, v, axis_name, causal)
     n = lax.axis_size(axis_name)
     me = lax.axis_index(axis_name)
     B, T, H, D = q.shape
@@ -176,15 +199,15 @@ def ring_attention_local(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("axis_name", "causal", "mesh", "q_chunk")
+    jax.jit, static_argnames=("axis_name", "causal", "mesh", "q_chunk", "impl")
 )
-def _jit_ring(q, k, v, mesh, axis_name, causal, q_chunk):
+def _jit_ring(q, k, v, mesh, axis_name, causal, q_chunk, impl):
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
     body = functools.partial(
         ring_attention_local, axis_name=axis_name, causal=causal,
-        q_chunk=q_chunk,
+        q_chunk=q_chunk, impl=impl,
     )
     spec = P(None, axis_name, None, None)
     return shard_map(
@@ -200,10 +223,11 @@ def ring_attention(
     axis_name: str = "sp",
     causal: bool = True,
     q_chunk: Optional[int] = None,
+    impl: str = "auto",
 ) -> jnp.ndarray:
     """Global-view convenience: q/k/v ``[B, T, H, D]`` sharded (or shardable)
     along T over ``mesh``'s ``axis_name``; returns the same layout."""
-    return _jit_ring(q, k, v, mesh, axis_name, causal, q_chunk)
+    return _jit_ring(q, k, v, mesh, axis_name, causal, q_chunk, impl)
 
 
 def full_attention_reference(q, k, v, causal=True):
