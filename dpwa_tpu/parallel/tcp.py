@@ -215,6 +215,27 @@ def fetch_blob(
         return None
 
 
+# Jitted on first use, never at import: this module stays importable (and
+# its CPU exchange usable) without touching a JAX backend — bench.py's TCP
+# leg runs it in a backend-pinned subprocess for exactly that reason.
+_LERP_CACHE = []
+
+
+def _device_lerp(local_dev, remote_host: np.ndarray, alpha: float):
+    """On-device ``(1-alpha)*local + alpha*remote``; uploads the fetched
+    host vector to the local replica's device.  alpha arrives as a traced
+    argument, so one compiled program serves every interpolation value."""
+    import jax
+
+    if not _LERP_CACHE:
+        _LERP_CACHE.append(
+            jax.jit(lambda a, b, t: (1.0 - t) * a + t * b)
+        )
+    import jax.numpy as jnp
+
+    return _LERP_CACHE[0](local_dev, jnp.asarray(remote_host), alpha)
+
+
 class TcpTransport:
     """Per-process gossip transport with the reference's update semantics.
 
@@ -262,20 +283,21 @@ class TcpTransport:
             timeout_ms = self.config.protocol.timeout_ms
         return fetch_blob(host, port, timeout_ms)
 
-    def exchange(
+    def _round(
         self, vec: np.ndarray, clock: float, loss: float, step: int
-    ) -> Tuple[np.ndarray, float, int]:
-        """One full gossip round: publish, pick partner, fetch, merge.
-
-        Returns (merged_vector, alpha_applied, partner).  alpha == 0.0 means
-        the round was skipped (self-pair, masked, or fetch timeout)."""
+    ) -> Tuple[Optional[np.ndarray], float, int]:
+        """The round protocol shared by every merge substrate: publish,
+        pick partner, participation gate, fetch, interpolation weight,
+        bf16-wire upcast.  Returns (remote_f32_vector | None, alpha,
+        partner); None means the round was skipped (self-pair, masked, or
+        fetch timeout) and the caller keeps its vector untouched."""
         self.publish(vec, clock, loss)
         partner = self.schedule.partner(step, self.me)
         if partner == self.me or not self.schedule.participates(step, self.me):
-            return vec, 0.0, partner
+            return None, 0.0, partner
         got = self.fetch(partner)
         if got is None:
-            return vec, 0.0, partner  # dead/slow peer: skip, keep training
+            return None, 0.0, partner  # dead/slow peer: skip, keep training
         remote_vec, remote_clock, remote_loss = got
         local = PeerMeta(np.float32(clock), np.float32(loss))
         remote = PeerMeta(np.float32(remote_clock), np.float32(remote_loss))
@@ -284,6 +306,18 @@ class TcpTransport:
             # bf16 off the wire: upcast once, merge in f32 (same math as
             # the ICI transport's bf16-wire merge).
             remote_vec = remote_vec.astype(np.float32)
+        return remote_vec, alpha, partner
+
+    def exchange(
+        self, vec: np.ndarray, clock: float, loss: float, step: int
+    ) -> Tuple[np.ndarray, float, int]:
+        """One full gossip round: publish, pick partner, fetch, merge.
+
+        Returns (merged_vector, alpha_applied, partner).  alpha == 0.0 means
+        the round was skipped (self-pair, masked, or fetch timeout)."""
+        remote_vec, alpha, partner = self._round(vec, clock, loss, step)
+        if remote_vec is None:
+            return vec, alpha, partner
         if vec.dtype == np.float32 and remote_vec.dtype == np.float32:
             # Native single-pass axpy (numpy takes three passes + temps).
             merged = native.merge_out(
@@ -297,6 +331,32 @@ class TcpTransport:
                 + alpha * remote_vec.astype(np.float32)
             ).astype(vec.dtype)
         return merged, alpha, partner
+
+    def exchange_on_device(
+        self, vec_dev, clock: float, loss: float, step: int
+    ):
+        """:meth:`exchange` with a DEVICE-RESIDENT replica (VERDICT r3 #6).
+
+        ``vec_dev`` is a flat f32 JAX array living on an accelerator (or
+        the forced-CPU backend standing in for one): the local replica
+        never exists as host state — TCP is only the wire.  Per round:
+        download once to publish (the wire needs host bytes; on real
+        hardware this is the device→NIC staging copy), fetch the
+        partner's bytes, upload them, and merge ON DEVICE with a jitted
+        lerp.  Returns ``(merged_device_vec, alpha, partner)`` with the
+        result still on the device; alpha == 0.0 means the round was
+        skipped and ``vec_dev`` is returned untouched (no copies).
+
+        This is the reference's free-running async semantics executed on
+        the rebuild's actual data plane — each OS process free-runs its
+        own device-resident replica — where the lock-step SPMD paths
+        emulate it with masked merges."""
+        remote_vec, alpha, partner = self._round(
+            np.asarray(vec_dev), clock, loss, step
+        )
+        if remote_vec is None:
+            return vec_dev, alpha, partner
+        return _device_lerp(vec_dev, remote_vec, alpha), alpha, partner
 
     def close(self) -> None:
         self.server.close()

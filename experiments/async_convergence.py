@@ -206,21 +206,34 @@ def tcp_worker(args) -> int:
             break
         time.sleep(0.1)
 
+    mode_name = "tcpdev" if args.device_resident else "tcp"
     for k in range(args.steps):
         stacked = next(batches)  # identical streams across modes
         batch = (stacked[0][me], stacked[1][me])
         params, opt_state, loss = local_step(params, opt_state, batch)
         clock += 1.0
-        vec = np.asarray(ravel(params)[0], np.float32)
-        merged, alpha, partner = transport.exchange(
-            vec, clock, float(loss), k
-        )
-        if alpha != 0.0:
-            params = unravel(jnp.asarray(merged))
+        if args.device_resident:
+            # VERDICT r3 #6: the replica never exists as host state — the
+            # flat vector stays a JAX device array, the merge is a jitted
+            # on-device lerp, and TCP touches only the wire staging
+            # copies (publish download / fetched-partner upload).
+            flat = ravel(params)[0]
+            merged, alpha, partner = transport.exchange_on_device(
+                flat, clock, float(loss), k
+            )
+            if alpha != 0.0:
+                params = unravel(merged)
+        else:
+            vec = np.asarray(ravel(params)[0], np.float32)
+            merged, alpha, partner = transport.exchange(
+                vec, clock, float(loss), k
+            )
+            if alpha != 0.0:
+                params = unravel(jnp.asarray(merged))
         if k % EVAL_EVERY == 0 or k == args.steps - 1:
             records.append(
                 {
-                    "mode": "tcp",
+                    "mode": mode_name,
                     "seed": seed,
                     "peer": me,
                     "step": k,
@@ -246,14 +259,17 @@ def tcp_worker(args) -> int:
     return 0
 
 
-def run_tcp(seed: int, steps: int) -> None:
+def run_tcp(seed: int, steps: int, device_resident: bool = False) -> None:
     """Spawn N free-running worker processes; merge their JSONL shards."""
+    mode = "tcpdev" if device_resident else "tcp"
     # Below the Linux ephemeral range (32768+): a transient outgoing
-    # connection can never squat one of the workers' listening ports.
-    base_port = 17000 + seed * 20
+    # connection can never squat one of the workers' listening ports; the
+    # device-resident variant gets its own block so both tcp legs of one
+    # seed can ever overlap in a wrapper script without port fights.
+    base_port = 17000 + seed * 20 + (1000 if device_resident else 0)
     os.makedirs(ART_DIR, exist_ok=True)
     shard_paths = [
-        os.path.join(ART_DIR, f".tcp_s{seed}_p{i}.jsonl")
+        os.path.join(ART_DIR, f".{mode}_s{seed}_p{i}.jsonl")
         for i in range(N_PEERS)
     ]
     from dpwa_tpu.utils.launch import child_process_env
@@ -271,6 +287,7 @@ def run_tcp(seed: int, steps: int) -> None:
                 "--base-port", str(base_port),
                 "--out", shard_paths[i],
                 "--grace", "20",
+                *(["--device-resident"] if device_resident else []),
             ],
             env=env,
             cwd=REPO_ROOT,
@@ -305,12 +322,12 @@ def run_tcp(seed: int, steps: int) -> None:
             p.kill()
         for p in procs:
             p.wait(timeout=30)
-    with open(_jsonl_path("tcp", seed), "w") as out:
+    with open(_jsonl_path(mode, seed), "w") as out:
         for sp in shard_paths:
             with open(sp) as f:
                 out.write(f.read())
             os.remove(sp)
-    print(f"tcp seed={seed}: {len(outs)} workers done")
+    print(f"{mode} seed={seed}: {len(outs)} workers done")
 
 
 # ------------------------------------------------------------- spmd runners
@@ -529,6 +546,11 @@ def main() -> int:
     w.add_argument("--base-port", type=int, required=True)
     w.add_argument("--out", required=True)
     w.add_argument("--grace", type=float, default=20.0)
+    w.add_argument(
+        "--device-resident", action="store_true",
+        help="hold the replica as a JAX device array and merge on-device "
+        "(exchange_on_device); TCP is only the wire",
+    )
 
     r = sub.add_parser("run")
     r.add_argument("--modes", default="tcp,ici,stacked")
@@ -591,8 +613,8 @@ def main() -> int:
     for seed in [int(x) for x in args.seeds.split(",")]:
         for mode in args.modes.split(","):
             t0 = time.time()
-            if mode == "tcp":
-                run_tcp(seed, args.steps)
+            if mode in ("tcp", "tcpdev"):
+                run_tcp(seed, args.steps, device_resident=(mode == "tcpdev"))
                 continue
             cmd = [
                 sys.executable, os.path.abspath(__file__), "spmd",

@@ -106,24 +106,26 @@ def transformer_analytic(
     return train_factor * fwd
 
 
-def build_resnet20():
+def _build_resnet(model_name: str, n: int, b: int, img: int, schedule: str):
+    """Shared scaffolding for the two ResNet rows (same loss/optimizer/
+    stacked-step wiring; they differ only in model, peers, batch, image
+    size, schedule — exactly the examples' benchmark settings)."""
     import jax
     import jax.numpy as jnp
     import optax
 
     from dpwa_tpu.config import make_local_config
-    from dpwa_tpu.models.resnet import ResNet20
+    from dpwa_tpu.models import resnet
     from dpwa_tpu.parallel.stacked import (
         StackedTransport, init_stacked_state, make_stacked_train_step,
     )
     from dpwa_tpu.train import init_params_per_peer
 
-    n, b = 8, 64
-    cfg = make_local_config(n, schedule="ring")
+    cfg = make_local_config(n, schedule=schedule)
     transport = StackedTransport(cfg)
-    model = ResNet20(dtype=jnp.bfloat16)
+    model = getattr(resnet, model_name)(dtype=jnp.bfloat16)
     stacked = init_params_per_peer(
-        lambda k: model.init(k, jnp.zeros((1, 32, 32, 3))),
+        lambda k: model.init(k, jnp.zeros((1, img, img, 3))),
         jax.random.key(0), n,
     )
     opt = optax.sgd(0.1, momentum=0.9)
@@ -136,52 +138,21 @@ def build_resnet20():
 
     step = make_stacked_train_step(loss_fn, opt, transport)
     batch = (
-        jnp.zeros((n, b, 32, 32, 3), jnp.float32),
+        jnp.zeros((n, b, img, img, 3), jnp.float32),
         jnp.zeros((n, b), jnp.int32),
     )
     return step, (state, batch), {
         "peers": n, "batch_per_peer": b, "dtype": "bf16",
         "images_per_step": n * b,
     }, None
+
+
+def build_resnet20():
+    return _build_resnet("ResNet20", n=8, b=64, img=32, schedule="ring")
 
 
 def build_resnet50():
-    import jax
-    import jax.numpy as jnp
-    import optax
-
-    from dpwa_tpu.config import make_local_config
-    from dpwa_tpu.models.resnet import ResNet50
-    from dpwa_tpu.parallel.stacked import (
-        StackedTransport, init_stacked_state, make_stacked_train_step,
-    )
-    from dpwa_tpu.train import init_params_per_peer
-
-    n, b = 8, 8
-    cfg = make_local_config(n, schedule="random")
-    transport = StackedTransport(cfg)
-    model = ResNet50(dtype=jnp.bfloat16)
-    stacked = init_params_per_peer(
-        lambda k: model.init(k, jnp.zeros((1, 224, 224, 3))),
-        jax.random.key(0), n,
-    )
-    opt = optax.sgd(0.1, momentum=0.9)
-    state = init_stacked_state(stacked, opt, transport)
-
-    def loss_fn(params, batch):
-        x, y = batch
-        logits = model.apply(params, x)
-        return optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
-
-    step = make_stacked_train_step(loss_fn, opt, transport)
-    batch = (
-        jnp.zeros((n, b, 224, 224, 3), jnp.float32),
-        jnp.zeros((n, b), jnp.int32),
-    )
-    return step, (state, batch), {
-        "peers": n, "batch_per_peer": b, "dtype": "bf16",
-        "images_per_step": n * b,
-    }, None
+    return _build_resnet("ResNet50", n=8, b=8, img=224, schedule="random")
 
 
 def build_bert():
@@ -428,6 +399,17 @@ def main() -> None:
             results[f"llama3_8b_block_T{t}"] = rec
             log(f"[llama_block T={t}] {flops/1e12:.3f} TFLOP/step")
 
+    path = os.path.join(REPO, "artifacts", "mfu_accounting.json")
+    # Partial invocations (--configs subset, --llama-block alone) MERGE
+    # into the existing artifact — an accounting re-run of one config must
+    # never silently drop the others' rows.
+    existing = {}
+    if os.path.exists(path):
+        try:
+            with open(path) as f:
+                existing = json.load(f).get("configs", {})
+        except (OSError, json.JSONDecodeError):
+            existing = {}
     out = {
         "experiment": "mfu_accounting",
         "peak_tflops_bf16_v5e": V5E_BF16_PEAK / 1e12,
@@ -438,12 +420,12 @@ def main() -> None:
             "peers, one XLA program); steps/s from the chip-measured "
             "BASELINE.md table"
         ),
-        "configs": results,
+        "configs": {**existing, **results},
     }
-    path = os.path.join(REPO, "artifacts", "mfu_accounting.json")
     os.makedirs(os.path.dirname(path), exist_ok=True)
-    with open(path, "w") as f:
+    with open(path + ".tmp", "w") as f:
         json.dump(out, f, indent=1)
+    os.replace(path + ".tmp", path)
     print(json.dumps(out, indent=1))
 
 

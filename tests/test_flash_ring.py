@@ -182,6 +182,34 @@ def test_flash_ring_composes_with_peer_axis():
         )
 
 
+def test_private_pallas_api_signatures_pinned():
+    """flash_ring.py calls three PRIVATE library functions the CPU suite
+    cannot execute; pin their parameter lists so a jax upgrade that
+    reorders or renames them fails HERE (on CPU) instead of only at TPU
+    runtime inside a ring hop."""
+    import inspect
+
+    fa = pytest.importorskip(
+        "jax.experimental.pallas.ops.tpu.flash_attention"
+    )
+    assert list(inspect.signature(fa._flash_attention_impl).parameters) == [
+        "q", "k", "v", "ab", "segment_ids", "save_residuals", "causal",
+        "sm_scale", "block_b", "block_q", "block_k_major", "block_k",
+        "debug",
+    ]
+    assert list(inspect.signature(fa._flash_attention_bwd_dkv).parameters) == [
+        "q", "k", "v", "ab", "segment_ids", "l", "m", "do", "di",
+        "block_q_major", "block_q", "block_k_major", "block_k", "sm_scale",
+        "causal", "mask_value", "debug",
+    ]
+    assert list(inspect.signature(fa._flash_attention_bwd_dq).parameters) == [
+        "q", "k", "v", "ab", "segment_ids", "l", "m", "do", "di",
+        "block_q_major", "block_k_major", "block_k", "sm_scale", "causal",
+        "mask_value", "debug",
+    ]
+    assert hasattr(fa, "DEFAULT_MASK_VALUE")
+
+
 def test_jnp_twins_match_library_reference():
     """The jnp twin kernels must reproduce the library's own reference
     implementation (same residual conventions the Pallas kernels honor) —

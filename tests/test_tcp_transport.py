@@ -240,3 +240,54 @@ def test_clock_weighted_over_tcp():
         np.testing.assert_allclose(m1, v1)
     finally:
         close_all(ts)
+
+
+def test_exchange_on_device_matches_host_exchange():
+    """VERDICT r3 #6: the device-resident exchange keeps the replica a JAX
+    array, merges on-device, and produces the same numbers as the host
+    (numpy/native-axpy) exchange."""
+    import jax
+    import jax.numpy as jnp
+
+    ts = make_ring(2, schedule="ring", fetch_probability=1.0)
+    try:
+        d = 512
+        v0 = np.arange(d, dtype=np.float32)
+        v1 = np.arange(d, dtype=np.float32)[::-1].copy()
+        # Host path on transport 0 (after both publish).
+        ts[0].publish(v0, 1.0, 0.5)
+        ts[1].publish(v1, 1.0, 0.5)
+        host_merged, host_alpha, host_partner = ts[0].exchange(
+            v0, 1.0, 0.5, 0
+        )
+        assert host_alpha != 0.0
+
+        # Device path, same inputs/step: identical partner/alpha/math.
+        dev0 = jnp.asarray(v0)
+        dev_merged, dev_alpha, dev_partner = ts[0].exchange_on_device(
+            dev0, 1.0, 0.5, 0
+        )
+        assert isinstance(dev_merged, jax.Array)
+        assert dev_partner == host_partner
+        assert dev_alpha == host_alpha
+        np.testing.assert_allclose(
+            np.asarray(dev_merged), host_merged, rtol=1e-6, atol=1e-6
+        )
+    finally:
+        close_all(ts)
+
+
+def test_exchange_on_device_skip_returns_same_array():
+    """A skipped round (fetch timeout) must hand back the device array
+    untouched — no host round-trip, no copy."""
+    import jax.numpy as jnp
+
+    ts = make_ring(2, schedule="ring", fetch_probability=1.0, timeout_ms=200)
+    try:
+        dev = jnp.ones(64, jnp.float32)
+        # Partner never published: fetch returns None -> skip.
+        merged, alpha, partner = ts[0].exchange_on_device(dev, 1.0, 0.0, 0)
+        assert alpha == 0.0
+        assert merged is dev
+    finally:
+        close_all(ts)
