@@ -32,14 +32,15 @@ class BertConfig:
     dtype: jnp.dtype = jnp.float32
 
 
-def bert_base_config() -> BertConfig:
-    return BertConfig()
+def bert_base_config(dtype=None) -> BertConfig:
+    return BertConfig(**({} if dtype is None else {"dtype": dtype}))
 
 
-def bert_tiny_config() -> BertConfig:
+def bert_tiny_config(dtype=None) -> BertConfig:
     return BertConfig(
         vocab_size=128, d_model=32, n_layers=2, n_heads=4, d_ff=64,
         max_seq_len=64,
+        **({} if dtype is None else {"dtype": dtype}),
     )
 
 

@@ -34,6 +34,8 @@ def main() -> None:
     ap.add_argument("--seq-len", type=int, default=128)
     ap.add_argument("--lr", type=float, default=1e-4)
     ap.add_argument("--tiny", action="store_true", help="tiny BERT (tests)")
+    ap.add_argument("--bf16", action="store_true", help="bfloat16 compute "
+                    "(the MFU-honest dtype on TPU; BASELINE.md footnote 1)")
     ap.add_argument("--log-every", type=int, default=20)
     from dpwa_tpu.utils.launch import add_transport_args, build_transport
 
@@ -67,7 +69,15 @@ def main() -> None:
     from dpwa_tpu.utils.pytree import tree_size_bytes
 
     n = cfg.n_peers
-    mcfg = bert_tiny_config() if args.tiny else bert_base_config()
+    dtype = jnp.bfloat16 if args.bf16 else None
+    mcfg = bert_tiny_config(dtype) if args.tiny else bert_base_config(dtype)
+    if args.seq_len > mcfg.max_seq_len:
+        hint = " (tiny BERT is 64)" if args.tiny else ""
+        ap.error(
+            f"--seq-len {args.seq_len} exceeds the model's max_seq_len "
+            f"{mcfg.max_seq_len}{hint}; pass --seq-len "
+            f"{mcfg.max_seq_len} or less"
+        )
     model = BertMLM(mcfg)
     tokens0 = jnp.zeros((1, args.seq_len), jnp.int32)
     stacked = stack_params(model.init(jax.random.key(0), tokens0), n)

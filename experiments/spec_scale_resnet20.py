@@ -155,8 +155,10 @@ def main() -> None:
         if line.startswith("RESULT "):
             out = json.loads(line[len("RESULT "):])
             path = os.path.join(REPO, "artifacts", "spec_scale_resnet20.json")
-            with open(path, "w") as f:
+            # Atomic write: this artifact is ~30 min of 1-core compute.
+            with open(path + ".tmp", "w") as f:
                 json.dump(out, f, indent=1)
+            os.replace(path + ".tmp", path)
             print(json.dumps(out, indent=1))
             return
     raise RuntimeError("no RESULT line from inner run")

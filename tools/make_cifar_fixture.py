@@ -34,14 +34,16 @@ N_TEST = 256
 
 
 def main() -> None:
-    from dpwa_tpu.data import load_digits_dataset
+    # The EXACT transform the convergence studies use (no private
+    # re-implementation — if the study's upsampling ever changes, the
+    # fixture follows).
+    sys.path.insert(0, os.path.join(REPO, "experiments"))
+    from async_convergence import _cifar_shaped_digits
 
-    x_tr, y_tr, x_te, y_te = load_digits_dataset(seed=0)
+    x_tr, y_tr, x_te, y_te = _cifar_shaped_digits(0)
 
     def to_u8(x):
-        # digits arrive [N, 8, 8, 1] float in [0, 1]
-        x = np.repeat(np.repeat(x, 4, axis=1), 4, axis=2)  # -> 32x32
-        x = np.tile(x, (1, 1, 1, 3))  # -> RGB
+        # study output is float RGB in [0, 1]
         return np.clip(x * 255.0, 0, 255).astype(np.uint8)
 
     out_dir = os.path.join(REPO, "data", "cifar10_fixture")
