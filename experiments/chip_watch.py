@@ -11,12 +11,17 @@ recovers MID-round went uncaptured.  This daemon closes that hole:
   killable subprocess (the wedge hangs, it does not raise), appending one
   JSON line per probe to ``artifacts/probe_history.jsonl``;
 - on the FIRST probe that reports a non-CPU platform it runs the round's
-  chip jobs, in order of value-per-compile-risk:
+  chip jobs, in order of value-per-compile-risk (each later job gated on
+  the earlier artifacts being safely on disk, so a wedge triggered by a
+  big compile can never cost a cheaper artifact):
     1. ``experiments/llama_block_bench.py --seq-len 4096``
     2. ``python bench.py`` (full size)  ->  ``artifacts/bench_tpu_capture.json``
-    3. ``experiments/llama_block_bench.py --seq-len 8192`` (LAST: the
-       T=8192 compile is the suspected trigger of the round-3 wedge, so it
-       must not be able to cost the other two artifacts)
+    3. ``experiments/llama_block_bench.py --seq-len 8192`` (the T=8192
+       compile is the suspected trigger of the round-3 wedge)
+    4. ``experiments/flash_ring_bench.py`` (per-hop ring timing; the
+       largest compiles of the four — T_local up to 32k — hence last)
+  Jobs that fail are retried on the next alive probe until all four
+  artifacts exist.
 - ``bench.py`` reads the capture file when its own live run can only reach
   CPU, so the round's recorded headline is the chip number whenever the
   chip was alive at ANY point in the round (with full provenance fields).
@@ -182,6 +187,16 @@ def run_chip_jobs(job_timeout: float) -> dict:
             "llama-block-8192",
         )
         outcomes["llama_block_8192"] = ok8192
+        # Last in the queue (biggest compiles, T_local up to 32k): the
+        # flash-vs-einsum per-hop ring timing (VERDICT r3 #4 done
+        # criterion).  Everything above is already on disk if this one
+        # wedges the tunnel.
+        ok_hop, _ = run_job(
+            [sys.executable, "experiments/flash_ring_bench.py"],
+            job_timeout,
+            "flash-ring-hop-timing",
+        )
+        outcomes["flash_ring_hop_timing"] = ok_hop
     return outcomes
 
 
@@ -196,10 +211,15 @@ def main() -> None:
                     help="stop probing after this long (round is over)")
     ap.add_argument("--once", action="store_true",
                     help="single probe (and jobs if alive), then exit")
+    ap.add_argument(
+        "--no-rotate", action="store_true",
+        help="same-round restart: keep the existing probe history and "
+        "capture instead of rotating them to *_prev",
+    )
     args = ap.parse_args()
 
     deadline = time.monotonic() + args.max_hours * 3600
-    if not args.once:
+    if not args.once and not args.no_rotate:
         # The daemon is launched once per round: rotate any capture/history
         # left by a PREVIOUS round so a stale chip number can never be
         # promoted to this round's headline (bench.py also enforces a
@@ -230,10 +250,14 @@ def main() -> None:
             append_history(
                 {"t_utc": now_utc(), "chip_jobs": outcomes}
             )
-            # Done means the bench capture exists; block benches may have
-            # individually failed and are retried on the next alive probe.
-            jobs_done = os.path.exists(CAPTURE) and outcomes.get(
-                "llama_block_4096", False
+            # Done only when EVERY job has its artifact; any job that
+            # failed (or was gated off by an earlier failure) is retried
+            # on the next alive probe.
+            jobs_done = (
+                os.path.exists(CAPTURE)
+                and outcomes.get("llama_block_4096", False)
+                and outcomes.get("llama_block_8192", False)
+                and outcomes.get("flash_ring_hop_timing", False)
             )
         if args.once or time.monotonic() >= deadline:
             break
