@@ -215,6 +215,80 @@ def fetch_blob(
         return None
 
 
+def _host_merge(
+    vec: np.ndarray, remote_vec: np.ndarray, alpha: float
+) -> np.ndarray:
+    """Host-side ``(1-α)·vec + α·remote`` — native single-pass axpy on
+    the f32 fast path (numpy takes three passes + temps)."""
+    if vec.dtype == np.float32 and remote_vec.dtype == np.float32:
+        return native.merge_out(
+            np.ascontiguousarray(vec),
+            np.ascontiguousarray(remote_vec),
+            alpha,
+        )
+    return (
+        (1.0 - alpha) * vec.astype(np.float32)
+        + alpha * remote_vec.astype(np.float32)
+    ).astype(vec.dtype)
+
+
+class _OverlappedExchange:
+    """In-flight overlapped gossip round: the fetch runs on a daemon
+    thread while the owner computes its local step.
+
+    ``finish(pre_vec, update)`` joins the fetch and returns
+    ``(merged_plus_update, alpha, partner)`` where
+    ``merged_plus_update = (1-α)·pre + α·remote + update`` — identical
+    algebra to the SPMD ``overlap=True`` step (merge the PRE-update
+    replicas, land the local update on the merged result).  A skipped
+    round (self-pair, masked, fetch timeout) returns
+    ``pre_vec + update`` with α = 0."""
+
+    def __init__(self, transport: "TcpTransport", clock, loss, step):
+        self._t = transport
+        self._clock, self._loss = clock, loss
+        self.partner = transport.schedule.partner(step, transport.me)
+        self._participates = (
+            self.partner != transport.me
+            and transport.schedule.participates(step, transport.me)
+        )
+        self._got = None
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        if not self._participates:
+            return
+
+        def _fetch():
+            self._got = self._t.fetch(self.partner)
+
+        self._thread = threading.Thread(target=_fetch, daemon=True)
+        self._thread.start()
+
+    def finish(
+        self, pre_vec: np.ndarray, update: Optional[np.ndarray] = None
+    ) -> Tuple[np.ndarray, float, int]:
+        if self._thread is not None:
+            # The fetch itself is bounded by the transport's timeout_ms;
+            # the join timeout is a backstop against a pathological
+            # socket state, after which the round is skipped like any
+            # other failed fetch.
+            self._thread.join(
+                timeout=2.0 + self._t.config.protocol.timeout_ms / 1000.0
+            )
+        got = self._got if self._thread is not None else None
+        if got is None:
+            merged, alpha = pre_vec, 0.0
+        else:
+            remote_vec, alpha = self._t._weigh_remote(
+                got, self._clock, self._loss
+            )
+            merged = _host_merge(pre_vec, remote_vec, alpha)
+        if update is not None:
+            merged = merged + update
+        return merged, alpha, self.partner
+
+
 # Jitted on first use, never at import: this module stays importable (and
 # its CPU exchange usable) without touching a JAX backend — bench.py's TCP
 # leg runs it in a backend-pinned subprocess for exactly that reason.
@@ -283,6 +357,22 @@ class TcpTransport:
             timeout_ms = self.config.protocol.timeout_ms
         return fetch_blob(host, port, timeout_ms)
 
+    def _weigh_remote(
+        self, got: Tuple[np.ndarray, float, float], clock: float, loss: float
+    ) -> Tuple[np.ndarray, float]:
+        """Fetched blob -> (f32-ready remote vector, interpolation α):
+        the metadata weighing + bf16-wire upcast shared by every merge
+        substrate (host, device-resident, overlapped)."""
+        remote_vec, remote_clock, remote_loss = got
+        local = PeerMeta(np.float32(clock), np.float32(loss))
+        remote = PeerMeta(np.float32(remote_clock), np.float32(remote_loss))
+        alpha = float(self.interp(local, remote))
+        if ml_dtypes is not None and remote_vec.dtype == _DTYPES[3]:
+            # bf16 off the wire: upcast once, merge in f32 (same math as
+            # the ICI transport's bf16-wire merge).
+            remote_vec = remote_vec.astype(np.float32)
+        return remote_vec, alpha
+
     def _round(
         self, vec: np.ndarray, clock: float, loss: float, step: int
     ) -> Tuple[Optional[np.ndarray], float, int]:
@@ -298,14 +388,7 @@ class TcpTransport:
         got = self.fetch(partner)
         if got is None:
             return None, 0.0, partner  # dead/slow peer: skip, keep training
-        remote_vec, remote_clock, remote_loss = got
-        local = PeerMeta(np.float32(clock), np.float32(loss))
-        remote = PeerMeta(np.float32(remote_clock), np.float32(remote_loss))
-        alpha = float(self.interp(local, remote))
-        if ml_dtypes is not None and remote_vec.dtype == _DTYPES[3]:
-            # bf16 off the wire: upcast once, merge in f32 (same math as
-            # the ICI transport's bf16-wire merge).
-            remote_vec = remote_vec.astype(np.float32)
+        remote_vec, alpha = self._weigh_remote(got, clock, loss)
         return remote_vec, alpha, partner
 
     def exchange(
@@ -318,19 +401,27 @@ class TcpTransport:
         remote_vec, alpha, partner = self._round(vec, clock, loss, step)
         if remote_vec is None:
             return vec, alpha, partner
-        if vec.dtype == np.float32 and remote_vec.dtype == np.float32:
-            # Native single-pass axpy (numpy takes three passes + temps).
-            merged = native.merge_out(
-                np.ascontiguousarray(vec),
-                np.ascontiguousarray(remote_vec),
-                alpha,
-            )
-        else:
-            merged = (
-                (1.0 - alpha) * vec.astype(np.float32)
-                + alpha * remote_vec.astype(np.float32)
-            ).astype(vec.dtype)
-        return merged, alpha, partner
+        return _host_merge(vec, remote_vec, alpha), alpha, partner
+
+    def exchange_overlapped_start(
+        self, vec: np.ndarray, clock: float, loss: float, step: int
+    ) -> "_OverlappedExchange":
+        """Begin a gossip round that OVERLAPS the partner fetch with the
+        caller's compute — the TCP twin of the SPMD paths'
+        ``overlap=True`` (publish the PRE-step replica, never gate the
+        exchange wire time on this step's fwd/bwd).
+
+        Publishes ``vec`` (the pre-step replica), resolves
+        partner/participation, and starts the fetch on a daemon thread;
+        the caller runs its local step, then calls
+        :meth:`_OverlappedExchange.finish` with its pre-step vector and
+        the step's update to get ``merge(pre, remote) + update`` — the
+        exact ``overlap=True`` algebra of
+        :func:`dpwa_tpu.train.make_gossip_train_step`."""
+        self.publish(vec, clock, loss)
+        ex = _OverlappedExchange(self, clock, loss, step)
+        ex.start()
+        return ex
 
     def exchange_on_device(
         self, vec_dev, clock: float, loss: float, step: int

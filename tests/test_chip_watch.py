@@ -1,0 +1,105 @@
+"""The chip watcher's recovery-job orchestration (experiments/chip_watch.py).
+
+The round's headline TPU artifacts depend on this logic running
+unattended at the single moment the wedge-prone tunnel recovers, so the
+gating invariants are pinned here with run_job stubbed out:
+
+- jobs run cheapest-compile-first;
+- the 8192-block and flash-ring jobs (the suspected wedge triggers) are
+  gated on BOTH cheaper artifacts existing — a transient bench failure
+  must not let the big compiles run and risk wedging away the headline;
+- bench output that is itself a replayed capture is never re-stamped.
+"""
+
+import importlib.util
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def load_chip_watch():
+    spec = importlib.util.spec_from_file_location(
+        "chip_watch", os.path.join(REPO, "experiments", "chip_watch.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_big_compiles_gated_on_cheap_artifacts(monkeypatch, tmp_path):
+    cw = load_chip_watch()
+    calls = []
+
+    def fake_run_job(cmd, timeout_s, tag):
+        calls.append(tag)
+        # 4096 succeeds, bench FAILS: the gated jobs must not run.
+        return tag == "llama-block-4096", ""
+
+    monkeypatch.setattr(cw, "run_job", fake_run_job)
+    monkeypatch.setattr(cw, "BLOCK_ARTIFACT", str(tmp_path / "none.json"))
+    outcomes = cw.run_chip_jobs(10.0)
+    assert calls == ["llama-block-4096", "bench-full"]
+    assert outcomes["llama_block_4096"] is True
+    assert outcomes["bench_full"] is False
+    assert "llama_block_8192" not in outcomes
+    assert "flash_ring_hop_timing" not in outcomes
+
+
+def test_all_jobs_run_in_risk_order_on_success(monkeypatch, tmp_path):
+    cw = load_chip_watch()
+    calls = []
+    capture = tmp_path / "cap.json"
+
+    bench_json = json.dumps(
+        {
+            "metric": "pairwise_avg_bandwidth", "value": 500.0,
+            "unit": "GB/s/chip", "vs_baseline": 100.0, "backend": "tpu",
+        }
+    )
+
+    def fake_run_job(cmd, timeout_s, tag):
+        calls.append(tag)
+        return True, bench_json + "\n" if tag == "bench-full" else ""
+
+    monkeypatch.setattr(cw, "run_job", fake_run_job)
+    monkeypatch.setattr(cw, "CAPTURE", str(capture))
+    monkeypatch.setattr(cw, "BLOCK_ARTIFACT", str(tmp_path / "none.json"))
+    outcomes = cw.run_chip_jobs(10.0)
+    assert calls == [
+        "llama-block-4096",
+        "bench-full",
+        "llama-block-8192",
+        "flash-ring-hop-timing",
+    ]
+    assert all(outcomes.values()), outcomes
+    # The capture file carries the provenance stamp.
+    cap = json.loads(capture.read_text())
+    assert cap["backend"] == "tpu"
+    assert "captured_at_utc" in cap
+
+
+def test_capture_rejects_replayed_bench_output(monkeypatch, tmp_path):
+    """bench.py output that replays an EXISTING capture (live run fell
+    back to CPU) must not be re-stamped as a fresh measurement."""
+    cw = load_chip_watch()
+    monkeypatch.setattr(cw, "CAPTURE", str(tmp_path / "cap.json"))
+    replay = json.dumps(
+        {
+            "metric": "pairwise_avg_bandwidth", "value": 657.5,
+            "unit": "GB/s/chip", "vs_baseline": 3665.0, "backend": "tpu",
+            "captured_at_utc": "2026-07-30T00:00:00Z",
+            "live_run_backend": "cpu",
+        }
+    )
+    assert cw.capture_bench(replay) is False
+    assert not os.path.exists(str(tmp_path / "cap.json"))
+    # A CPU result is likewise never captured.
+    cpu = json.dumps(
+        {
+            "metric": "pairwise_avg_bandwidth", "value": 0.25,
+            "unit": "GB/s/chip", "vs_baseline": 0.8, "backend": "cpu",
+        }
+    )
+    assert cw.capture_bench(cpu) is False

@@ -291,3 +291,41 @@ def test_exchange_on_device_skip_returns_same_array():
         assert merged is dev
     finally:
         close_all(ts)
+
+
+def test_exchange_overlapped_matches_sequential_algebra():
+    """The overlapped round must produce exactly
+    merge(pre_local, pre_remote) + update — the SPMD overlap=True
+    algebra — with the same alpha the blocking exchange would use."""
+    ts = make_ring(2, schedule="ring", fetch_probability=1.0)
+    try:
+        d = 256
+        pre0 = np.arange(d, dtype=np.float32)
+        pre1 = np.arange(d, dtype=np.float32)[::-1].copy()
+        update0 = np.full(d, 0.25, np.float32)
+        # Both peers publish their PRE-step replicas (start() publishes
+        # for node0; node1 publishes manually).
+        ts[1].publish(pre1, 1.0, 0.5)
+        ex = ts[0].exchange_overlapped_start(pre0, 1.0, 0.5, 0)
+        # ... node0's local step would run here, overlapping the fetch ...
+        merged, alpha, partner = ex.finish(pre0, update0)
+        assert partner == 1 and alpha != 0.0
+        want = (1.0 - alpha) * pre0 + alpha * pre1 + update0
+        np.testing.assert_allclose(merged, want, rtol=1e-6, atol=1e-6)
+    finally:
+        close_all(ts)
+
+
+def test_exchange_overlapped_skip_keeps_update():
+    """A failed fetch (partner never published) degrades to plain local
+    SGD: pre + update, alpha 0 — the timeout-skip elasticity."""
+    ts = make_ring(2, schedule="ring", fetch_probability=1.0, timeout_ms=200)
+    try:
+        pre = np.ones(64, np.float32)
+        update = np.full(64, -0.5, np.float32)
+        ex = ts[0].exchange_overlapped_start(pre, 1.0, 0.0, 0)
+        merged, alpha, partner = ex.finish(pre, update)
+        assert alpha == 0.0
+        np.testing.assert_array_equal(merged, pre + update)
+    finally:
+        close_all(ts)
