@@ -43,6 +43,12 @@ class LlamaConfig:
     # exact ring attention over the axis and rope positions are globally
     # offset by the device's block index.  None = single-device attention.
     sp_axis: Optional[str] = None
+    # Sequence layout over sp_axis: "contiguous" (device i holds block i)
+    # or "zigzag" (device i holds global chunks i and 2n-1-i — the
+    # causal-load-balanced layout of ops/zigzag_ring.py; callers shard
+    # tokens/targets with zigzag_shard, and the model supplies matching
+    # rope positions internally).
+    sp_layout: str = "contiguous"
     # Single-device attention implementation: "auto" uses the Pallas TPU
     # flash kernel when the backend is TPU and the shapes fit its tiling
     # (T and head_dim multiples of 128), else the dense O(T^2) einsum;
@@ -54,6 +60,10 @@ class LlamaConfig:
         if self.attn_impl not in ("auto", "flash", "dense"):
             raise ValueError(
                 f"attn_impl must be auto|flash|dense, got {self.attn_impl!r}"
+            )
+        if self.sp_layout not in ("contiguous", "zigzag"):
+            raise ValueError(
+                f"sp_layout must be contiguous|zigzag, got {self.sp_layout!r}"
             )
 
     @property
@@ -173,6 +183,23 @@ class Attention(nn.Module):
             # stay GROUPED (KV heads) through the ring — expanded per
             # block inside the kernel — so GQA's bandwidth saving holds
             # on the fabric.
+            if cfg.sp_layout == "zigzag":
+                # Causal-load-balanced layout: every device computes the
+                # same number of half-length panels per hop
+                # (ops/zigzag_ring.py) — no device idles on skipped
+                # future blocks.
+                from dpwa_tpu.ops.zigzag_ring import (
+                    zigzag_ring_attention_local,
+                )
+
+                # attn_impl maps onto the panel kernels: dense pins the
+                # jnp einsum panels; auto/flash let the resolver pick
+                # the Pallas kernels on TPU (jnp twins elsewhere).
+                out = zigzag_ring_attention_local(
+                    q, k, v, axis_name=cfg.sp_axis,
+                    impl="jnp" if cfg.attn_impl == "dense" else None,
+                ).reshape(B, T, H * D)
+                return dense(cfg.d_model, "wo")(out)
             from dpwa_tpu.ops.ring_attention import ring_attention_local
 
             # attn_impl maps onto the ring hop implementation: auto/flash
@@ -265,9 +292,16 @@ class Llama(nn.Module):
         )(tokens)
         positions = jnp.arange(T)
         if cfg.sp_axis is not None:
-            # Inside shard_map: ``tokens`` is this device's contiguous
-            # sequence block; rope needs the GLOBAL positions.
-            positions = positions + jax.lax.axis_index(cfg.sp_axis) * T
+            if cfg.sp_layout == "zigzag":
+                # Device holds global chunks (i, 2n-1-i); rope positions
+                # must follow the same zigzag map as the data.
+                from dpwa_tpu.ops.zigzag_ring import zigzag_positions_local
+
+                positions = zigzag_positions_local(T, cfg.sp_axis)
+            else:
+                # Inside shard_map: ``tokens`` is this device's contiguous
+                # sequence block; rope needs the GLOBAL positions.
+                positions = positions + jax.lax.axis_index(cfg.sp_axis) * T
         for i in range(cfg.n_layers):
             x = Block(cfg, name=f"layer_{i}")(x, positions)
         x = RMSNorm(dtype=cfg.dtype, name="final_norm")(x)
