@@ -65,6 +65,13 @@ class LlamaConfig:
             raise ValueError(
                 f"sp_layout must be contiguous|zigzag, got {self.sp_layout!r}"
             )
+        if self.sp_layout != "contiguous" and self.sp_axis is None:
+            # Silently ignoring the layout would train single-device
+            # attention on zigzag-permuted data — scrambled sequences.
+            raise ValueError(
+                "sp_layout='zigzag' requires sp_axis (the layout only "
+                "exists for the sequence-parallel ring)"
+            )
 
     @property
     def kv_heads(self) -> int:

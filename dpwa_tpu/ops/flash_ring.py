@@ -81,16 +81,25 @@ def _hop_fwd_pallas(q, k, v, causal: bool, scale: float):
     return o.astype(jnp.float32), lse.astype(jnp.float32)
 
 
-def _hop_fwd_jnp(q, k, v, causal: bool, scale: float):
-    """jnp twin: same contract, same residual conventions as the kernel."""
+# Above this many query rows, the jnp twins process q in chunks so the
+# score panel peaks at [B, H, chunk, T_k] instead of [B, H, T_q, T_k] —
+# the same memory profile as ring_attention.py's q-chunked einsum hop.
+# Matters off-TPU and for flash-ineligible shapes at long T, where the
+# twins ARE the execution path, not just the test harness.
+_JNP_Q_CHUNK = 512
+
+
+def _hop_fwd_jnp_panel(q, k, v, causal: bool, scale: float, row0: int):
+    """One q-panel of the twin forward; ``row0`` is the panel's global
+    row offset within the hop (causality compares k-column <= row)."""
     s = jnp.einsum(
         "bhqd,bhkd->bhqk",
         q.astype(jnp.float32),
         k.astype(jnp.float32),
     ) * scale
     if causal:
-        T = q.shape[2]
-        mask = jnp.tril(jnp.ones((T, T), bool))
+        rows = row0 + jnp.arange(q.shape[2])
+        mask = jnp.arange(k.shape[2])[None, :] <= rows[:, None]
         s = jnp.where(mask[None, None], s, _NEG_INF)
     m = jnp.max(s, axis=-1)
     p = jnp.exp(s - m[..., None])
@@ -98,6 +107,29 @@ def _hop_fwd_jnp(q, k, v, causal: bool, scale: float):
     o = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
     o = o / jnp.maximum(l, 1e-30)[..., None]
     lse = m + jnp.log(jnp.maximum(l, 1e-30))
+    return o, lse
+
+
+def _hop_fwd_jnp(q, k, v, causal: bool, scale: float):
+    """jnp twin: same contract, same residual conventions as the kernel."""
+    B, H, T, D = q.shape
+    if T <= _JNP_Q_CHUNK or T % _JNP_Q_CHUNK:
+        return _hop_fwd_jnp_panel(q, k, v, causal, scale, 0)
+    nc = T // _JNP_Q_CHUNK
+    qs = q.reshape(B, H, nc, _JNP_Q_CHUNK, D).transpose(2, 0, 1, 3, 4)
+
+    def chunk(_, xs):
+        qc, i = xs
+        o, lse = _hop_fwd_jnp_panel(
+            qc, k, v, causal, scale, i * _JNP_Q_CHUNK
+        )
+        return None, (o, lse)
+
+    _, (o, lse) = lax.scan(
+        jax.checkpoint(chunk), None, (qs, jnp.arange(nc))
+    )
+    o = o.transpose(1, 2, 0, 3, 4).reshape(B, H, T, D)
+    lse = lse.transpose(1, 2, 0, 3).reshape(B, H, T)
     return o, lse
 
 
@@ -132,13 +164,13 @@ def _hop_bwd_pallas(q, k, v, lse, do, di, causal: bool, scale: float):
     )
 
 
-def _hop_bwd_jnp(q, k, v, lse, do, di, causal: bool, scale: float):
+def _hop_bwd_jnp_panel(q, k, v, lse, do, di, causal, scale, row0):
     q32, k32, v32 = (t.astype(jnp.float32) for t in (q, k, v))
     do32 = do.astype(jnp.float32)
     s = jnp.einsum("bhqd,bhkd->bhqk", q32, k32) * scale
     if causal:
-        T = q.shape[2]
-        mask = jnp.tril(jnp.ones((T, T), bool))
+        rows = row0 + jnp.arange(q.shape[2])
+        mask = jnp.arange(k.shape[2])[None, :] <= rows[:, None]
         s = jnp.where(mask[None, None], s, _NEG_INF)
     p = jnp.exp(s - lse[..., None])  # global softmax, this block's columns
     dv = jnp.einsum("bhqk,bhqd->bhkd", p, do32)
@@ -146,6 +178,34 @@ def _hop_bwd_jnp(q, k, v, lse, do, di, causal: bool, scale: float):
     ds = (dp - di[..., None]) * p * scale
     dq = jnp.einsum("bhqk,bhkd->bhqd", ds, k32)
     dk = jnp.einsum("bhqk,bhqd->bhkd", ds, q32)
+    return dq, dk, dv
+
+
+def _hop_bwd_jnp(q, k, v, lse, do, di, causal: bool, scale: float):
+    B, H, T, D = q.shape
+    if T <= _JNP_Q_CHUNK or T % _JNP_Q_CHUNK:
+        return _hop_bwd_jnp_panel(q, k, v, lse, do, di, causal, scale, 0)
+    nc = T // _JNP_Q_CHUNK
+
+    def rows(t):  # [B, H, T, ...] -> per-chunk leading axis
+        return t.reshape(
+            B, H, nc, _JNP_Q_CHUNK, *t.shape[3:]
+        ).transpose(2, 0, 1, 3, *range(4, t.ndim + 1))
+
+    def chunk(carry, xs):
+        dk_acc, dv_acc = carry
+        qc, lsec, doc, dic, i = xs
+        dq_c, dk_c, dv_c = _hop_bwd_jnp_panel(
+            qc, k, v, lsec, doc, dic, causal, scale, i * _JNP_Q_CHUNK
+        )
+        return (dk_acc + dk_c, dv_acc + dv_c), dq_c
+
+    (dk, dv), dq = lax.scan(
+        jax.checkpoint(chunk),
+        (jnp.zeros_like(k, jnp.float32), jnp.zeros_like(v, jnp.float32)),
+        (rows(q), rows(lse), rows(do), rows(di), jnp.arange(nc)),
+    )
+    dq = dq.transpose(1, 2, 0, 3, 4).reshape(B, H, T, D)
     return dq, dk, dv
 
 

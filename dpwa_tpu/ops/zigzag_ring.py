@@ -43,6 +43,7 @@ from jax import lax
 
 from dpwa_tpu.ops.flash_ring import (
     _NEG_INF,
+    _expand_kv as _expand,
     _hop_bwd_jnp,
     _hop_bwd_pallas,
     _hop_fwd_jnp,
@@ -125,11 +126,6 @@ def zigzag_ring_attention_local(
     non-causal."""
     out, _ = _zz_fwd_parts(q, k, v, axis_name, impl)
     return out
-
-
-def _expand(t, H):
-    KV = t.shape[1]
-    return t if KV == H else jnp.repeat(t, H // KV, axis=1)
 
 
 def _zz_fwd_parts(q, k, v, axis_name, impl):

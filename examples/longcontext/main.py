@@ -46,6 +46,13 @@ def main() -> None:
         "train rank-RANK LoRA adapters, and gossip ONLY the adapters "
         "over the peers axis (0 = full-weight gossip)",
     )
+    ap.add_argument(
+        "--sp-layout", choices=("contiguous", "zigzag"),
+        default="contiguous",
+        help="zigzag balances causal ring attention work across sp "
+        "devices (ops/zigzag_ring.py); data is zigzag-sharded here, the "
+        "model handles rope positions",
+    )
     args = ap.parse_args()
 
     from dpwa_tpu.config import make_local_config
@@ -80,7 +87,9 @@ def main() -> None:
         max_seq_len=T,
         lora_rank=args.lora,
     )
-    model = Llama(LlamaConfig(**base, sp_axis="sp"))
+    model = Llama(
+        LlamaConfig(**base, sp_axis="sp", sp_layout=args.sp_layout)
+    )
     init_model = Llama(LlamaConfig(**base))  # init runs outside shard_map
 
     mesh = make_sp_mesh(cfg, sp)
@@ -126,9 +135,15 @@ def main() -> None:
         for _ in range(T):
             toks.append(table[toks[-1]])
         toks = np.concatenate(toks, axis=-1)
+        inputs, targets = toks[..., :-1], toks[..., 1:]
+        if args.sp_layout == "zigzag":
+            from dpwa_tpu.ops.zigzag_ring import zigzag_shard
+
+            inputs = zigzag_shard(inputs, args.sp, axis=2)
+            targets = zigzag_shard(targets, args.sp, axis=2)
         return (
-            jax.device_put(toks[..., :-1], sh),
-            jax.device_put(toks[..., 1:], sh),
+            jax.device_put(inputs, sh),
+            jax.device_put(targets, sh),
         )
 
     state, losses, info = step_fn(state, batch())

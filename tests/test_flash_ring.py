@@ -240,3 +240,40 @@ def test_jnp_twins_match_library_reference():
             np.asarray(m_ref + jnp.log(l_ref)),
             rtol=2e-5, atol=2e-6,
         )
+
+
+def test_jnp_twin_q_chunking_is_exact():
+    """Above _JNP_Q_CHUNK rows the twins process q in chunks (capping the
+    score panel like the einsum hop's q-chunking); the chunked path must
+    be bit-comparable to the one-panel math, fwd and bwd."""
+    from dpwa_tpu.ops.flash_ring import (
+        _JNP_Q_CHUNK,
+        _hop_bwd_jnp,
+        _hop_bwd_jnp_panel,
+        _hop_fwd_jnp,
+        _hop_fwd_jnp_panel,
+    )
+
+    B, H, T, D = 1, 2, 2 * _JNP_Q_CHUNK, 8
+    ks = jax.random.split(jax.random.key(11), 5)
+    q, k, v, do = (
+        jax.random.normal(kk, (B, H, T, D), jnp.float32) for kk in ks[:4]
+    )
+    scale = 0.3
+    for causal in (False, True):
+        o_c, lse_c = _hop_fwd_jnp(q, k, v, causal, scale)
+        o_p, lse_p = _hop_fwd_jnp_panel(q, k, v, causal, scale, 0)
+        np.testing.assert_allclose(
+            np.asarray(o_c), np.asarray(o_p), rtol=1e-6, atol=1e-6
+        )
+        np.testing.assert_allclose(
+            np.asarray(lse_c), np.asarray(lse_p), rtol=1e-6, atol=1e-6
+        )
+        di = jnp.sum(o_p * do, axis=-1)
+        g_c = _hop_bwd_jnp(q, k, v, lse_p, do, di, causal, scale)
+        g_p = _hop_bwd_jnp_panel(q, k, v, lse_p, do, di, causal, scale, 0)
+        for a, b, name in zip(g_c, g_p, ("dq", "dk", "dv")):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-5,
+                err_msg=name,
+            )
