@@ -111,12 +111,19 @@ def _hop_fwd_jnp_panel(q, k, v, causal: bool, scale: float, row0: int):
 
 
 def _hop_fwd_jnp(q, k, v, causal: bool, scale: float):
-    """jnp twin: same contract, same residual conventions as the kernel."""
+    """jnp twin: same contract, same residual conventions as the kernel.
+
+    (No remat here on purpose: the twins only run inside the ring
+    custom-vjp's hand-written primal/backward, which autodiff never
+    traces through, so checkpoint annotations would be dead weight.)"""
     B, H, T, D = q.shape
-    if T <= _JNP_Q_CHUNK or T % _JNP_Q_CHUNK:
+    if T <= _JNP_Q_CHUNK:
         return _hop_fwd_jnp_panel(q, k, v, causal, scale, 0)
-    nc = T // _JNP_Q_CHUNK
-    qs = q.reshape(B, H, nc, _JNP_Q_CHUNK, D).transpose(2, 0, 1, 3, 4)
+    nc, rem = divmod(T, _JNP_Q_CHUNK)
+    Tp = nc * _JNP_Q_CHUNK
+    qs = q[:, :, :Tp].reshape(
+        B, H, nc, _JNP_Q_CHUNK, D
+    ).transpose(2, 0, 1, 3, 4)
 
     def chunk(_, xs):
         qc, i = xs
@@ -125,11 +132,17 @@ def _hop_fwd_jnp(q, k, v, causal: bool, scale: float):
         )
         return None, (o, lse)
 
-    _, (o, lse) = lax.scan(
-        jax.checkpoint(chunk), None, (qs, jnp.arange(nc))
-    )
-    o = o.transpose(1, 2, 0, 3, 4).reshape(B, H, T, D)
-    lse = lse.transpose(1, 2, 0, 3).reshape(B, H, T)
+    _, (o, lse) = lax.scan(chunk, None, (qs, jnp.arange(nc)))
+    o = o.transpose(1, 2, 0, 3, 4).reshape(B, H, Tp, D)
+    lse = lse.transpose(1, 2, 0, 3).reshape(B, H, Tp)
+    if rem:
+        # Non-divisible tail: one final sub-chunk panel keeps the memory
+        # bound for every T, not just multiples of the chunk.
+        o_r, lse_r = _hop_fwd_jnp_panel(
+            q[:, :, Tp:], k, v, causal, scale, Tp
+        )
+        o = jnp.concatenate([o, o_r], axis=2)
+        lse = jnp.concatenate([lse, lse_r], axis=2)
     return o, lse
 
 
@@ -183,12 +196,13 @@ def _hop_bwd_jnp_panel(q, k, v, lse, do, di, causal, scale, row0):
 
 def _hop_bwd_jnp(q, k, v, lse, do, di, causal: bool, scale: float):
     B, H, T, D = q.shape
-    if T <= _JNP_Q_CHUNK or T % _JNP_Q_CHUNK:
+    if T <= _JNP_Q_CHUNK:
         return _hop_bwd_jnp_panel(q, k, v, lse, do, di, causal, scale, 0)
-    nc = T // _JNP_Q_CHUNK
+    nc, rem = divmod(T, _JNP_Q_CHUNK)
+    Tp = nc * _JNP_Q_CHUNK
 
-    def rows(t):  # [B, H, T, ...] -> per-chunk leading axis
-        return t.reshape(
+    def rows(t):  # [B, H, Tp, ...] -> per-chunk leading axis
+        return t[:, :, :Tp].reshape(
             B, H, nc, _JNP_Q_CHUNK, *t.shape[3:]
         ).transpose(2, 0, 1, 3, *range(4, t.ndim + 1))
 
@@ -201,11 +215,19 @@ def _hop_bwd_jnp(q, k, v, lse, do, di, causal: bool, scale: float):
         return (dk_acc + dk_c, dv_acc + dv_c), dq_c
 
     (dk, dv), dq = lax.scan(
-        jax.checkpoint(chunk),
+        chunk,
         (jnp.zeros_like(k, jnp.float32), jnp.zeros_like(v, jnp.float32)),
         (rows(q), rows(lse), rows(do), rows(di), jnp.arange(nc)),
     )
-    dq = dq.transpose(1, 2, 0, 3, 4).reshape(B, H, T, D)
+    dq = dq.transpose(1, 2, 0, 3, 4).reshape(B, H, Tp, D)
+    if rem:
+        dq_r, dk_r, dv_r = _hop_bwd_jnp_panel(
+            q[:, :, Tp:], k, v, lse[:, :, Tp:], do[:, :, Tp:],
+            di[:, :, Tp:], causal, scale, Tp,
+        )
+        dq = jnp.concatenate([dq, dq_r], axis=2)
+        dk = dk + dk_r
+        dv = dv + dv_r
     return dq, dk, dv
 
 

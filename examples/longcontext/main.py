@@ -75,8 +75,12 @@ def main() -> None:
     )
 
     n, sp, T = args.peers, args.sp, args.seq_len
-    if T % sp:
-        raise SystemExit(f"--seq-len {T} must divide by --sp {sp}")
+    div = 2 * sp if args.sp_layout == "zigzag" else sp
+    if T % div:
+        raise SystemExit(
+            f"--seq-len {T} must divide by {div} "
+            f"({'2*sp for the zigzag layout' if div != sp else '--sp'})"
+        )
     base = dict(
         vocab_size=256,
         d_model=args.d_model,

@@ -254,12 +254,26 @@ def test_jnp_twin_q_chunking_is_exact():
         _hop_fwd_jnp_panel,
     )
 
-    B, H, T, D = 1, 2, 2 * _JNP_Q_CHUNK, 8
+    B, H, D = 1, 2, 8
+    scale = 0.3
+    # Divisible AND remainder shapes: the non-divisible tail must go
+    # through its own sub-chunk panel, never a full-T fallback.
+    for T in (2 * _JNP_Q_CHUNK, _JNP_Q_CHUNK + 100):
+        _check_chunking_shape(B, H, T, D, scale)
+
+
+def _check_chunking_shape(B, H, T, D, scale):
+    from dpwa_tpu.ops.flash_ring import (
+        _hop_bwd_jnp,
+        _hop_bwd_jnp_panel,
+        _hop_fwd_jnp,
+        _hop_fwd_jnp_panel,
+    )
+
     ks = jax.random.split(jax.random.key(11), 5)
     q, k, v, do = (
         jax.random.normal(kk, (B, H, T, D), jnp.float32) for kk in ks[:4]
     )
-    scale = 0.3
     for causal in (False, True):
         o_c, lse_c = _hop_fwd_jnp(q, k, v, causal, scale)
         o_p, lse_p = _hop_fwd_jnp_panel(q, k, v, causal, scale, 0)
