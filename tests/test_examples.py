@@ -72,3 +72,35 @@ def test_cifar10_example_reads_data_dir():
     m = re.search(r"mean test accuracy: ([0-9.]+)", proc.stdout)
     assert m, proc.stdout
     assert "synthetic" not in proc.stdout
+
+
+def test_longcontext_example_both_layouts():
+    """The longcontext example trains on the 2-D (peers, sp) mesh in both
+    sequence layouts; zigzag must land on the same loss as contiguous
+    (identical math, different work distribution)."""
+    from dpwa_tpu.utils.launch import child_process_env
+
+    env = child_process_env(REPO)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    finals = {}
+    for layout in ("contiguous", "zigzag"):
+        cmd = [
+            sys.executable,
+            os.path.join(REPO, "examples", "longcontext", "main.py"),
+            "--steps", "8",
+            "--seq-len", "64",
+            "--n-layers", "2",
+            "--d-model", "64",
+            "--log-every", "100",
+            "--sp-layout", layout,
+        ]
+        proc = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=420, env=env,
+            cwd=REPO,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        m = re.search(r"final mean loss ([0-9.]+)", proc.stdout)
+        assert m, proc.stdout
+        finals[layout] = float(m.group(1))
+    assert abs(finals["contiguous"] - finals["zigzag"]) < 2e-3, finals
