@@ -49,6 +49,11 @@ class LlamaConfig:
     # tokens/targets with zigzag_shard, and the model supplies matching
     # rope positions internally).
     sp_layout: str = "contiguous"
+    # Sequence-parallel strategy over sp_axis: "ring" (K/V blocks rotate
+    # by ppermute — ops/ring_attention.py and friends) or "a2a"
+    # (Ulysses-style: all-to-all to head-sharded attention over the full
+    # sequence — ops/ulysses.py; needs n_heads % sp == 0).
+    sp_strategy: str = "ring"
     # Single-device attention implementation: "auto" uses the Pallas TPU
     # flash kernel when the backend is TPU and the shapes fit its tiling
     # (T and head_dim multiples of 128), else the dense O(T^2) einsum;
@@ -71,6 +76,15 @@ class LlamaConfig:
             raise ValueError(
                 "sp_layout='zigzag' requires sp_axis (the layout only "
                 "exists for the sequence-parallel ring)"
+            )
+        if self.sp_strategy not in ("ring", "a2a"):
+            raise ValueError(
+                f"sp_strategy must be ring|a2a, got {self.sp_strategy!r}"
+            )
+        if self.sp_strategy == "a2a" and self.sp_layout != "contiguous":
+            raise ValueError(
+                "sp_strategy='a2a' shards heads, not sequence stripes — "
+                "the zigzag layout only applies to the ring strategy"
             )
 
     @property
@@ -190,6 +204,16 @@ class Attention(nn.Module):
             # stay GROUPED (KV heads) through the ring — expanded per
             # block inside the kernel — so GQA's bandwidth saving holds
             # on the fabric.
+            if cfg.sp_strategy == "a2a":
+                # Ulysses-style: all-to-all to head-sharded attention
+                # over the full sequence (ops/ulysses.py), then back.
+                from dpwa_tpu.ops.ulysses import ulysses_attention_local
+
+                out = ulysses_attention_local(
+                    q, k, v, axis_name=cfg.sp_axis, causal=True,
+                    impl=cfg.attn_impl,
+                ).reshape(B, T, H * D)
+                return dense(cfg.d_model, "wo")(out)
             if cfg.sp_layout == "zigzag":
                 # Causal-load-balanced layout: every device computes the
                 # same number of half-length panels per hop
@@ -217,42 +241,16 @@ class Attention(nn.Module):
                 impl="xla" if cfg.attn_impl == "dense" else cfg.attn_impl,
             ).reshape(B, T, H * D)
             return dense(cfg.d_model, "wo")(out)
-        if KV != H:  # GQA: repeat kv heads
-            rep = H // KV
-            k = jnp.repeat(k, rep, axis=2)
-            v = jnp.repeat(v, rep, axis=2)
-        use_flash = cfg.attn_impl == "flash" or (
-            cfg.attn_impl == "auto"
-            and jax.default_backend() == "tpu"
-            and D % 128 == 0
-            and T % 128 == 0
-        )
-        if use_flash:
-            # Pallas TPU flash attention (jax.experimental.pallas.ops):
-            # O(T) memory — score panels live in VMEM tiles, never HBM —
-            # which is what makes long single-device sequences fit at all
-            # (the dense path materializes [B,H,T,T] f32; see
-            # artifacts/attention_memory.json for measured max-T).
-            from jax.experimental.pallas.ops.tpu.flash_attention import (
-                flash_attention,
-            )
+        # The framework's ONE single-device attention (GQA expansion,
+        # flash-vs-dense dispatch, f32 accumulation) — shared with the
+        # a2a strategy's per-device compute.  Flash: O(T) memory, score
+        # panels in VMEM tiles, never HBM (what makes long single-device
+        # sequences fit at all; artifacts/attention_memory.json).
+        from dpwa_tpu.ops.ulysses import single_device_attention
 
-            out = flash_attention(
-                q.transpose(0, 2, 1, 3),
-                k.transpose(0, 2, 1, 3),
-                v.transpose(0, 2, 1, 3),
-                causal=True,
-                sm_scale=float(1.0 / (D ** 0.5)),
-            )
-            out = out.transpose(0, 2, 1, 3).reshape(B, T, H * D)
-            return dense(cfg.d_model, "wo")(out)
-        scores = jnp.einsum("bthd,bshd->bhts", q, k) / jnp.sqrt(D).astype(
-            cfg.dtype
-        )
-        mask = jnp.tril(jnp.ones((T, T), bool))
-        scores = jnp.where(mask[None, None], scores, -jnp.inf)
-        probs = jax.nn.softmax(scores.astype(jnp.float32), -1).astype(cfg.dtype)
-        out = jnp.einsum("bhts,bshd->bthd", probs, v).reshape(B, T, H * D)
+        out = single_device_attention(
+            q, k, v, causal=True, impl=cfg.attn_impl
+        ).reshape(B, T, H * D)
         return dense(cfg.d_model, "wo")(out)
 
 
