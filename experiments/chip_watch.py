@@ -16,12 +16,16 @@ recovers MID-round went uncaptured.  This daemon closes that hole:
   big compile can never cost a cheaper artifact):
     1. ``experiments/llama_block_bench.py --seq-len 4096``
     2. ``python bench.py`` (full size)  ->  ``artifacts/bench_tpu_capture.json``
-    3. ``experiments/llama_block_bench.py --seq-len 8192`` (the T=8192
-       compile is the suspected trigger of the round-3 wedge)
-    4. ``experiments/flash_ring_bench.py`` (per-hop ring timing; the
-       largest compiles of the four — T_local up to 32k — hence last)
-  Jobs that fail are retried on the next alive probe until all four
-  artifacts exist.
+    3. ``experiments/train_steps_refresh.py`` (example steps/s incl. the
+       bf16 BERT row — compiles that all succeeded on-chip in round 2)
+    4. ``experiments/flash_ring_bench.py`` (per-hop ring timing)
+    5. ``experiments/llama_block_bench.py --seq-len 8192`` — LAST: this
+       exact compile has taken the tunnel down in two separate rounds
+       (r3 wedge; r4 UNAVAILABLE + dead backend), so it must not be able
+       to cost any other artifact.
+  Done-state is derived from the artifacts themselves (``job_state``), so
+  a watcher restarted mid-round retries exactly the jobs whose artifacts
+  are missing, until all five exist.
 - ``bench.py`` reads the capture file when its own live run can only reach
   CPU, so the round's recorded headline is the chip number whenever the
   chip was alive at ANY point in the round (with full provenance fields).
@@ -93,29 +97,40 @@ def probe(timeout_s: float) -> tuple[str | None, bool]:
 
 
 def run_job(cmd: list[str], timeout_s: float, tag: str) -> tuple[bool, str]:
-    """Run one chip job; (ok, stdout).  Timeouts kill the child — a wedged
-    compile must not freeze the watcher itself."""
+    """Run one chip job; (ok, stdout).  Timeouts kill the child's whole
+    process GROUP — the steps-refresh job spawns example grandchildren,
+    and an orphaned example mid-compile would keep holding the wedge-prone
+    tunnel after the watchdog fired."""
+    import signal
+
     log(f"{tag}: {' '.join(cmd)}")
+    proc = subprocess.Popen(
+        cmd,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+        env=os.environ.copy(),
+        cwd=REPO,
+        start_new_session=True,
+    )
     try:
-        proc = subprocess.run(
-            cmd,
-            capture_output=True,
-            text=True,
-            timeout=timeout_s,
-            env=os.environ.copy(),
-            cwd=REPO,
-        )
+        stdout, stderr = proc.communicate(timeout=timeout_s)
     except subprocess.TimeoutExpired:
-        log(f"{tag}: HUNG past {timeout_s:.0f}s — killed")
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+        proc.communicate()
+        log(f"{tag}: HUNG past {timeout_s:.0f}s — process group killed")
         return False, ""
-    tail = (proc.stderr or "").strip().splitlines()[-3:]
+    tail = (stderr or "").strip().splitlines()[-3:]
     for t in tail:
         log(f"{tag} stderr| {t}")
     if proc.returncode != 0:
         log(f"{tag}: failed rc={proc.returncode}")
-        return False, proc.stdout or ""
+        return False, stdout or ""
     log(f"{tag}: ok")
-    return True, proc.stdout or ""
+    return True, stdout or ""
 
 
 def capture_bench(stdout: str) -> bool:
@@ -151,53 +166,193 @@ def capture_bench(stdout: str) -> bool:
     return True
 
 
+def _read_json(path: str) -> dict:
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return {}
+
+
+_REFRESH_NAMES_CACHE: list | None = None
+
+# Fallback if the refresh script can't be imported (e.g. a syntax error
+# mid-edit): the daemon must keep probing rather than die inside
+# job_state().  Kept in sync with train_steps_refresh.CONFIGS by
+# tests/test_chip_watch.py.
+_REFRESH_NAMES_STATIC = [
+    "resnet20_cifar10",
+    "resnet50_imagenet",
+    "bert_base_mlm",
+    "bert_base_mlm_bf16",
+    "llama_lora_tiny",
+]
+
+
+def _refresh_config_names() -> list:
+    """The steps-refresh job's expected config rows, read once from the
+    script itself (single source of truth; it imports only stdlib)."""
+    global _REFRESH_NAMES_CACHE
+    if _REFRESH_NAMES_CACHE is None:
+        import importlib.util
+
+        try:
+            spec = importlib.util.spec_from_file_location(
+                "_train_steps_refresh",
+                os.path.join(REPO, "experiments", "train_steps_refresh.py"),
+            )
+            mod = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(mod)
+            _REFRESH_NAMES_CACHE = list(mod.CONFIGS)
+        except Exception as e:  # noqa: BLE001 — daemon must outlive this
+            log(f"train_steps_refresh.py unreadable ({e}); using static "
+                "config list")
+            _REFRESH_NAMES_CACHE = list(_REFRESH_NAMES_STATIC)
+    return _REFRESH_NAMES_CACHE
+
+
+def _chip_backend(rec: dict) -> bool:
+    return rec.get("backend") in ("tpu", "axon")
+
+
+def job_state() -> dict:
+    """Which chip artifacts are already on disk (judged from the
+    artifacts themselves, not watcher memory — a restarted watcher must
+    retry exactly the jobs whose artifacts are missing)."""
+    block4096 = _read_json(os.path.join(ART, "llama_block_real_dims_T4096.json"))
+    block_main = _read_json(BLOCK_ARTIFACT)
+    hop = _read_json(os.path.join(ART, "attention_memory.json")).get(
+        "flash_ring_hop_timing", {}
+    )
+    refresh = _read_json(
+        os.path.join(ART, "train_steps_refresh.json")
+    ).get("configs", {})
+    # Done requires every EXPECTED config row ok, not just "all rows
+    # present are ok" — the refresh script writes rows as they land, so a
+    # killed run leaves a partial artifact that must count as not-done.
+    expected = set(_refresh_config_names())
+    return {
+        "llama_block_4096": _chip_backend(block4096),
+        "bench_full": _chip_backend(_read_json(CAPTURE)),
+        "train_steps_refresh": expected.issubset(refresh)
+        and all(refresh[name].get("ok") for name in expected),
+        "llama_block_8192": (
+            _chip_backend(block_main)
+            and block_main.get("block", {}).get("seq_len") == 8192
+        ),
+        "flash_ring_hop_timing": _chip_backend(hop),
+    }
+
+
 def run_chip_jobs(job_timeout: float) -> dict:
-    """The round's chip work, cheapest-compile first.  Each job's outcome
-    is recorded; a failure (or fresh wedge) mid-sequence keeps earlier
-    artifacts."""
-    outcomes = {}
-    ok4096, _ = run_job(
-        [sys.executable, "experiments/llama_block_bench.py",
-         "--seq-len", "4096"],
-        job_timeout,
-        "llama-block-4096",
-    )
-    outcomes["llama_block_4096"] = ok4096
-    if ok4096 and os.path.exists(BLOCK_ARTIFACT):
-        # Keep the 4096 result under its own name: the 8192 run (if it
-        # survives the compile) overwrites the main artifact.
-        shutil.copyfile(
-            BLOCK_ARTIFACT,
-            os.path.join(ART, "llama_block_real_dims_T4096.json"),
-        )
+    """The round's chip work, value-per-compile-risk first.  Each job's
+    outcome is recorded; a failure (or fresh wedge) mid-sequence keeps
+    earlier artifacts.  Already-landed jobs (per ``job_state``) are
+    skipped, so a watcher restarted mid-round retries only what's
+    missing.
 
-    ok_bench, stdout = run_job(
-        [sys.executable, "bench.py"], job_timeout, "bench-full"
-    )
-    outcomes["bench_full"] = ok_bench and capture_bench(stdout)
-
-    if ok4096 and outcomes["bench_full"]:
-        # Only attempt the native-context compile once BOTH cheaper
-        # artifacts are safely on disk — a wedge triggered here must not
-        # be able to cost the headline bench capture.
-        ok8192, _ = run_job(
+    Outcome values keep the probe history honest about what actually ran
+    at this timestamp: True/False = ran this probe (ok/failed);
+    ``"already_done"`` = skipped, artifact landed earlier;
+    ``"gated"`` = not attempted because an upstream gate stayed closed."""
+    done = job_state()
+    outcomes = {
+        k: ("already_done" if v else "gated") for k, v in done.items()
+    }
+    if not done["llama_block_4096"]:
+        ok4096, _ = run_job(
             [sys.executable, "experiments/llama_block_bench.py",
-             "--seq-len", "8192"],
+             "--seq-len", "4096"],
             job_timeout,
-            "llama-block-8192",
+            "llama-block-4096",
         )
-        outcomes["llama_block_8192"] = ok8192
-        # Last in the queue (biggest compiles, T_local up to 32k): the
-        # flash-vs-einsum per-hop ring timing (VERDICT r3 #4 done
-        # criterion).  Everything above is already on disk if this one
-        # wedges the tunnel.
-        ok_hop, _ = run_job(
-            [sys.executable, "experiments/flash_ring_bench.py"],
+        outcomes["llama_block_4096"] = ok4096
+        if ok4096 and os.path.exists(BLOCK_ARTIFACT):
+            # Keep the 4096 result under its own name: the 8192 run (if
+            # it survives the compile) overwrites the main artifact.
+            shutil.copyfile(
+                BLOCK_ARTIFACT,
+                os.path.join(ART, "llama_block_real_dims_T4096.json"),
+            )
+
+    if not done["bench_full"]:
+        ok_bench, stdout = run_job(
+            [sys.executable, "bench.py"], job_timeout, "bench-full"
+        )
+        outcomes["bench_full"] = ok_bench and capture_bench(stdout)
+
+    if (
+        outcomes["llama_block_4096"]
+        and outcomes["bench_full"]
+        and not done["train_steps_refresh"]
+    ):
+        # Example-CLI steps/s refresh (incl. the bf16 BERT row): these
+        # compiles all succeeded on-chip in round 2, so they sit between
+        # the headline and the big-compile jobs in risk order.
+        ok_refresh, _ = run_job(
+            [sys.executable, "experiments/train_steps_refresh.py"],
             job_timeout,
-            "flash-ring-hop-timing",
+            "train-steps-refresh",
         )
-        outcomes["flash_ring_hop_timing"] = ok_hop
+        outcomes["train_steps_refresh"] = ok_refresh
+
+    if outcomes["llama_block_4096"] and outcomes["bench_full"]:
+        # Big-compile jobs only once both cheaper artifacts are safely on
+        # disk.  Flash-ring hop timing goes FIRST now: the block@8192
+        # fwd compile has taken the tunnel down in two separate rounds
+        # (r3 wedge; r4 UNAVAILABLE then backend dead), so it runs LAST —
+        # it must not keep costing the hop-timing artifact.
+        if not done["flash_ring_hop_timing"]:
+            ok_hop, _ = run_job(
+                [sys.executable, "experiments/flash_ring_bench.py"],
+                job_timeout,
+                "flash-ring-hop-timing",
+            )
+            outcomes["flash_ring_hop_timing"] = ok_hop
+        if outcomes["flash_ring_hop_timing"] and not done["llama_block_8192"]:
+            ok8192, _ = run_job(
+                [sys.executable, "experiments/llama_block_bench.py",
+                 "--seq-len", "8192"],
+                job_timeout,
+                "llama-block-8192",
+            )
+            outcomes["llama_block_8192"] = ok8192
     return outcomes
+
+
+def rotate_round_artifacts() -> None:
+    """New-round launch: rotate EVERY artifact job_state() consults (not
+    just capture/history) so a fresh round re-measures all five jobs — a
+    previous round's block timing or steps/s surviving rotation would
+    make job_state() skip those jobs and silently promote stale numbers
+    (bench.py also enforces a freshness bound on captured_at_utc as a
+    second line of defense)."""
+    for path in (
+        CAPTURE,
+        HISTORY,
+        BLOCK_ARTIFACT,
+        os.path.join(ART, "llama_block_real_dims_T4096.json"),
+        os.path.join(ART, "train_steps_refresh.json"),
+    ):
+        if os.path.exists(path):
+            root, ext = os.path.splitext(path)
+            os.replace(path, f"{root}_prev{ext}")
+            log(f"rotated stale {os.path.basename(path)} from a "
+                "previous round")
+    # attention_memory.json holds non-watcher data (the memory-ceiling
+    # sweep) alongside the hop-timing key — pop only our key.
+    mem_path = os.path.join(ART, "attention_memory.json")
+    mem = _read_json(mem_path)
+    stale_hop = mem.pop("flash_ring_hop_timing", None)
+    if stale_hop is not None:
+        with open(
+            os.path.join(ART, "flash_ring_hop_timing_prev.json"), "w"
+        ) as f:
+            json.dump(stale_hop, f, indent=1)
+        with open(mem_path + ".tmp", "w") as f:
+            json.dump(mem, f, indent=1)
+        os.replace(mem_path + ".tmp", mem_path)
+        log("rotated stale flash_ring_hop_timing from a previous round")
 
 
 def main() -> None:
@@ -205,8 +360,10 @@ def main() -> None:
     ap.add_argument("--interval", type=float, default=1200.0,
                     help="seconds between probes")
     ap.add_argument("--probe-timeout", type=float, default=120.0)
-    ap.add_argument("--job-timeout", type=float, default=3000.0,
-                    help="per chip-job watchdog")
+    ap.add_argument("--job-timeout", type=float, default=5400.0,
+                    help="per chip-job watchdog (must exceed the "
+                    "steps-refresh job's worst case: 5 example configs "
+                    "x its 900 s per-example budget)")
     ap.add_argument("--max-hours", type=float, default=14.0,
                     help="stop probing after this long (round is over)")
     ap.add_argument("--once", action="store_true",
@@ -214,25 +371,20 @@ def main() -> None:
     ap.add_argument(
         "--no-rotate", action="store_true",
         help="same-round restart: keep the existing probe history and "
-        "capture instead of rotating them to *_prev",
+        "chip-job artifacts instead of rotating them to *_prev",
     )
     args = ap.parse_args()
 
     deadline = time.monotonic() + args.max_hours * 3600
     if not args.once and not args.no_rotate:
-        # The daemon is launched once per round: rotate any capture/history
-        # left by a PREVIOUS round so a stale chip number can never be
-        # promoted to this round's headline (bench.py also enforces a
-        # freshness bound on captured_at_utc as a second line of defense).
-        for path in (CAPTURE, HISTORY):
-            if os.path.exists(path):
-                root, ext = os.path.splitext(path)
-                os.replace(path, f"{root}_prev{ext}")
-                log(f"rotated stale {os.path.basename(path)} from a "
-                    "previous round")
-    jobs_done = os.path.exists(CAPTURE)
+        rotate_round_artifacts()
+    state = job_state()
+    jobs_done = all(state.values())
     if jobs_done:
-        log(f"capture already exists ({CAPTURE}); probing for history only")
+        log("all five chip artifacts already landed; probing for history only")
+    else:
+        missing = [k for k, v in state.items() if not v]
+        log(f"chip jobs still missing artifacts: {missing}")
     while True:
         platform, hung = probe(args.probe_timeout)
         alive = platform is not None and platform != "cpu"
@@ -253,12 +405,7 @@ def main() -> None:
             # Done only when EVERY job has its artifact; any job that
             # failed (or was gated off by an earlier failure) is retried
             # on the next alive probe.
-            jobs_done = (
-                os.path.exists(CAPTURE)
-                and outcomes.get("llama_block_4096", False)
-                and outcomes.get("llama_block_8192", False)
-                and outcomes.get("flash_ring_hop_timing", False)
-            )
+            jobs_done = all(job_state().values())
         if args.once or time.monotonic() >= deadline:
             break
         time.sleep(args.interval)

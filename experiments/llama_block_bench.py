@@ -82,6 +82,18 @@ def main() -> None:
     import numpy as np
     import optax
 
+    if not args.cpu_witness and jax.default_backend() not in ("tpu", "axon"):
+        # A silent CPU fallback must never write a number under the
+        # real-dims artifact name BASELINE.md cites (every other watcher
+        # job refuses non-chip backends; this script must too).
+        print(
+            f"refusing to run: backend is {jax.default_backend()!r}, not "
+            "the chip — use --cpu-witness for the forced-CPU code-path "
+            "witness",
+            file=sys.stderr,
+        )
+        sys.exit(3)
+
     from dpwa_tpu.models.llama import (
         Block,
         LlamaConfig,

@@ -4,10 +4,13 @@ The round's headline TPU artifacts depend on this logic running
 unattended at the single moment the wedge-prone tunnel recovers, so the
 gating invariants are pinned here with run_job stubbed out:
 
-- jobs run cheapest-compile-first;
-- the 8192-block and flash-ring jobs (the suspected wedge triggers) are
-  gated on BOTH cheaper artifacts existing — a transient bench failure
-  must not let the big compiles run and risk wedging away the headline;
+- jobs run cheapest-compile-first, with the block@8192 compile LAST (it
+  has taken the tunnel down in two separate rounds);
+- the big-compile jobs are gated on BOTH cheaper artifacts existing — a
+  transient bench failure must not let the big compiles run and risk
+  wedging away the headline;
+- a restarted watcher derives done-state from the artifacts themselves
+  and retries exactly the jobs whose artifacts are missing;
 - bench output that is itself a replayed capture is never re-stamped.
 """
 
@@ -28,8 +31,17 @@ def load_chip_watch():
     return mod
 
 
+def isolate(cw, monkeypatch, tmp_path):
+    """Point every artifact path the watcher consults at an empty tmp
+    dir — job_state() must see the TEST's world, not the repo's."""
+    monkeypatch.setattr(cw, "ART", str(tmp_path))
+    monkeypatch.setattr(cw, "CAPTURE", str(tmp_path / "cap.json"))
+    monkeypatch.setattr(cw, "BLOCK_ARTIFACT", str(tmp_path / "block.json"))
+
+
 def test_big_compiles_gated_on_cheap_artifacts(monkeypatch, tmp_path):
     cw = load_chip_watch()
+    isolate(cw, monkeypatch, tmp_path)
     calls = []
 
     def fake_run_job(cmd, timeout_s, tag):
@@ -38,19 +50,21 @@ def test_big_compiles_gated_on_cheap_artifacts(monkeypatch, tmp_path):
         return tag == "llama-block-4096", ""
 
     monkeypatch.setattr(cw, "run_job", fake_run_job)
-    monkeypatch.setattr(cw, "BLOCK_ARTIFACT", str(tmp_path / "none.json"))
     outcomes = cw.run_chip_jobs(10.0)
     assert calls == ["llama-block-4096", "bench-full"]
     assert outcomes["llama_block_4096"] is True
     assert outcomes["bench_full"] is False
-    assert "llama_block_8192" not in outcomes
-    assert "flash_ring_hop_timing" not in outcomes
+    # Jobs never attempted stay marked "gated" (vs False = ran, failed) —
+    # the probe-history record distinguishes the two.
+    assert outcomes["train_steps_refresh"] == "gated"
+    assert outcomes["llama_block_8192"] == "gated"
+    assert outcomes["flash_ring_hop_timing"] == "gated"
 
 
 def test_all_jobs_run_in_risk_order_on_success(monkeypatch, tmp_path):
     cw = load_chip_watch()
+    isolate(cw, monkeypatch, tmp_path)
     calls = []
-    capture = tmp_path / "cap.json"
 
     bench_json = json.dumps(
         {
@@ -64,20 +78,140 @@ def test_all_jobs_run_in_risk_order_on_success(monkeypatch, tmp_path):
         return True, bench_json + "\n" if tag == "bench-full" else ""
 
     monkeypatch.setattr(cw, "run_job", fake_run_job)
-    monkeypatch.setattr(cw, "CAPTURE", str(capture))
-    monkeypatch.setattr(cw, "BLOCK_ARTIFACT", str(tmp_path / "none.json"))
     outcomes = cw.run_chip_jobs(10.0)
+    # flash-ring BEFORE the 8192 compile: the repeat wedge-trigger must
+    # not be able to cost the hop-timing artifact.
     assert calls == [
         "llama-block-4096",
         "bench-full",
-        "llama-block-8192",
+        "train-steps-refresh",
         "flash-ring-hop-timing",
+        "llama-block-8192",
     ]
     assert all(outcomes.values()), outcomes
     # The capture file carries the provenance stamp.
-    cap = json.loads(capture.read_text())
+    cap = json.loads((tmp_path / "cap.json").read_text())
     assert cap["backend"] == "tpu"
     assert "captured_at_utc" in cap
+
+
+def test_restart_retries_only_missing_jobs(monkeypatch, tmp_path):
+    """A watcher restarted mid-round (e.g. after a builder-session
+    restart) must skip jobs whose artifacts already landed and retry the
+    rest — the exact r4 situation: 4096 + bench captured, the two
+    big-compile jobs lost to the tunnel dying again."""
+    cw = load_chip_watch()
+    isolate(cw, monkeypatch, tmp_path)
+    (tmp_path / "llama_block_real_dims_T4096.json").write_text(
+        json.dumps({"backend": "tpu", "block": {"seq_len": 4096}})
+    )
+    (tmp_path / "cap.json").write_text(
+        json.dumps({"backend": "tpu", "value": 645.9})
+    )
+    state = cw.job_state()
+    assert state == {
+        "llama_block_4096": True,
+        "bench_full": True,
+        "train_steps_refresh": False,
+        "llama_block_8192": False,
+        "flash_ring_hop_timing": False,
+    }
+    calls = []
+
+    def fake_run_job(cmd, timeout_s, tag):
+        calls.append(tag)
+        return True, ""
+
+    monkeypatch.setattr(cw, "run_job", fake_run_job)
+    outcomes = cw.run_chip_jobs(10.0)
+    assert calls == [
+        "train-steps-refresh",
+        "flash-ring-hop-timing",
+        "llama-block-8192",
+    ]
+    # Skipped jobs are recorded as already_done, not as a fresh run.
+    assert outcomes["llama_block_4096"] == "already_done"
+    assert outcomes["bench_full"] == "already_done"
+    assert outcomes["train_steps_refresh"] is True
+    assert outcomes["flash_ring_hop_timing"] is True
+    assert outcomes["llama_block_8192"] is True
+
+    # Once the artifacts exist with chip backends, job_state reports all
+    # done (the daemon stops launching jobs, probes for history only).
+    (tmp_path / "block.json").write_text(
+        json.dumps({"backend": "tpu", "block": {"seq_len": 8192}})
+    )
+    (tmp_path / "attention_memory.json").write_text(
+        json.dumps({"flash_ring_hop_timing": {"backend": "tpu"}})
+    )
+    (tmp_path / "train_steps_refresh.json").write_text(
+        json.dumps(
+            {
+                "configs": {
+                    n: {"ok": True}
+                    for n in (
+                        "resnet20_cifar10", "resnet50_imagenet",
+                        "bert_base_mlm", "bert_base_mlm_bf16",
+                        "llama_lora_tiny",
+                    )
+                }
+            }
+        )
+    )
+    assert all(cw.job_state().values())
+
+
+def test_new_round_rotation_resets_every_job(monkeypatch, tmp_path):
+    """A new-round launch must rotate EVERY artifact job_state consults —
+    any row surviving rotation would make the new round silently reuse a
+    previous round's measurement — while attention_memory.json keeps its
+    non-watcher keys (the memory-ceiling sweep is round-3 history, not a
+    watcher product)."""
+    cw = load_chip_watch()
+    isolate(cw, monkeypatch, tmp_path)
+    monkeypatch.setattr(cw, "HISTORY", str(tmp_path / "probe_history.jsonl"))
+    (tmp_path / "llama_block_real_dims_T4096.json").write_text(
+        json.dumps({"backend": "tpu", "block": {"seq_len": 4096}})
+    )
+    (tmp_path / "block.json").write_text(
+        json.dumps({"backend": "tpu", "block": {"seq_len": 8192}})
+    )
+    (tmp_path / "cap.json").write_text(json.dumps({"backend": "tpu"}))
+    (tmp_path / "probe_history.jsonl").write_text("{}\n")
+    (tmp_path / "train_steps_refresh.json").write_text(
+        json.dumps(
+            {
+                "configs": {
+                    n: {"ok": True}
+                    for n in (
+                        "resnet20_cifar10", "resnet50_imagenet",
+                        "bert_base_mlm", "bert_base_mlm_bf16",
+                        "llama_lora_tiny",
+                    )
+                }
+            }
+        )
+    )
+    (tmp_path / "attention_memory.json").write_text(
+        json.dumps(
+            {
+                "memory_ceiling": {"max_T": 131072},
+                "flash_ring_hop_timing": {"backend": "tpu"},
+            }
+        )
+    )
+    assert all(cw.job_state().values())
+    cw.rotate_round_artifacts()
+    assert not any(cw.job_state().values())
+    # Originals preserved under *_prev; non-watcher keys untouched.
+    assert (tmp_path / "cap_prev.json").exists()
+    assert (tmp_path / "block_prev.json").exists()
+    assert (tmp_path / "llama_block_real_dims_T4096_prev.json").exists()
+    assert (tmp_path / "train_steps_refresh_prev.json").exists()
+    assert (tmp_path / "probe_history_prev.jsonl").exists()
+    assert (tmp_path / "flash_ring_hop_timing_prev.json").exists()
+    mem = json.loads((tmp_path / "attention_memory.json").read_text())
+    assert mem == {"memory_ceiling": {"max_T": 131072}}
 
 
 def test_capture_rejects_replayed_bench_output(monkeypatch, tmp_path):
@@ -103,3 +237,14 @@ def test_capture_rejects_replayed_bench_output(monkeypatch, tmp_path):
         }
     )
     assert cw.capture_bench(cpu) is False
+
+
+def test_static_refresh_names_in_sync():
+    """chip_watch's fallback list must track train_steps_refresh.CONFIGS."""
+    cw = load_chip_watch()
+    spec = importlib.util.spec_from_file_location(
+        "tsr", os.path.join(REPO, "experiments", "train_steps_refresh.py")
+    )
+    tsr = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(tsr)
+    assert cw._REFRESH_NAMES_STATIC == list(tsr.CONFIGS)
