@@ -24,7 +24,7 @@ Schema::
       group_size: 0             # hierarchical: peers per host group (0 = auto)
       inter_period: 4           # hierarchical: cross-group exchange cadence
       drop_probability: 0.0     # fault injection: drop pairs at this rate
-      wire_dtype: f32           # f32 | bf16 (shipped replica compressed)
+      wire_dtype: f32           # f32 | bf16 | int8 (shipped replica compressed)
     interpolation:
       type: constant            # constant | clock | loss
       factor: 0.5               # constant alpha (0.5 == (local+remote)/2)
@@ -81,7 +81,7 @@ class ProtocolConfig:
             raise ValueError(f"unknown schedule {self.schedule!r}")
         if self.mode not in ("pairwise", "pull"):
             raise ValueError(f"unknown protocol mode {self.mode!r}")
-        if self.wire_dtype not in ("f32", "bf16"):
+        if self.wire_dtype not in ("f32", "bf16", "int8"):
             raise ValueError(f"unknown wire_dtype {self.wire_dtype!r}")
 
 

@@ -1,0 +1,205 @@
+"""int8 stochastic-rounding wire compression for gossip exchanges.
+
+``protocol.wire_dtype: int8`` compresses the SHIPPED replica to one byte
+per element plus one f32 scale per :data:`CHUNK` elements — 3.9x fewer
+wire bytes than f32 (the bf16 wire halves them; this quarters them), with
+the local replica and all merge arithmetic staying f32.  The reference
+has no compression at all (its wire is pickled f64/f32 numpy — SURVEY.md
+§2 "TCP transport" row; mount empty); bf16 and int8 wires are rebuild
+extensions motivated by the DCN/TCP fabric being the gossip bottleneck
+(BASELINE.md: 0.15–0.3 GB/s TCP vs 645.9 GB/s on-chip).
+
+Scheme: per-chunk absmax scaling, ``scale = max|chunk| / 127``, and
+**stochastic rounding** ``q = floor(v/scale + u)``, ``u ~ U[0,1)``.
+Stochastic rounding is the load-bearing choice: it makes the quantizer
+unbiased (``E[q·scale] = v`` exactly), so repeated gossip averaging sees
+zero-mean noise instead of a systematic pull toward the int8 grid —
+deterministic rounding at α=0.5 freezes any coordinate pair whose gap is
+under one grid step, a real convergence failure mode at consensus time
+when replicas are already close.
+
+Two implementations with one contract:
+
+- the jittable JAX path (:func:`fake_quant_wire`) used by the SPMD
+  transports to emulate the wire in-graph — keyed on
+  ``(seed, step, sender)`` so the ICI and stacked transports produce
+  BIT-IDENTICAL merges (same guarantee the bf16 wire has);
+- the numpy path (:func:`quantize_np` / :func:`dequantize_np`) used by
+  the TCP transport's publish/fetch codec — keyed on
+  ``(seed, clock, sender)`` via ``numpy.random.Philox``.  The two RNGs
+  differ, so TCP merges match the SPMD ones in distribution, not bits
+  (documented non-goal; the bf16 wire's determinism comes free from
+  rounding, stochastic rounding priced it in).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Tuple
+
+import numpy as np
+
+CHUNK = 256  # f32 scale per 256 int8 elements: 1.6 % metadata overhead
+
+# Domain-separation constant so wire-quantization draws never collide
+# with the participation/fault streams (schedules.participation_draw /
+# fault_draw fold different data but share the schedule seed).
+_WIRE_SALT = 0x51A7
+
+
+def _n_chunks(n: int) -> int:
+    return max(1, math.ceil(n / CHUNK))
+
+
+# --------------------------------------------------------------------------
+# JAX path (SPMD transports; jit/shard_map-safe, static shapes)
+# --------------------------------------------------------------------------
+
+
+def wire_key(seed: int, step, sender, leaf: int = 0):
+    """Per-(step, sender, leaf) threefry key for the shipped-copy
+    quantization — the leaf index keeps same-shaped pytree leaves from
+    sharing rounding noise."""
+    import jax
+
+    key = jax.random.key(seed ^ _WIRE_SALT)
+    key = jax.random.fold_in(jax.random.fold_in(key, step), sender)
+    return jax.random.fold_in(key, leaf)
+
+
+def quantize(v, key) -> Tuple["jax.Array", "jax.Array"]:  # noqa: F821
+    """f32 array (any shape) -> (int8[K, CHUNK], f32 scales[K])."""
+    import jax
+    import jax.numpy as jnp
+
+    flat = v.reshape(-1)
+    n = flat.shape[0]
+    k = _n_chunks(n)
+    padded = jnp.pad(flat, (0, k * CHUNK - n))
+    chunks = padded.reshape(k, CHUNK)
+    scale = jnp.max(jnp.abs(chunks), axis=1) / 127.0
+    safe = jnp.where(scale > 0, scale, 1.0)
+    r = chunks / safe[:, None]
+    u = jax.random.uniform(key, chunks.shape, dtype=chunks.dtype)
+    q = jnp.clip(jnp.floor(r + u), -127, 127).astype(jnp.int8)
+    q = jnp.where(scale[:, None] > 0, q, jnp.int8(0))
+    return q, scale.astype(jnp.float32)
+
+
+def dequantize(q, scale, shape):
+    """(int8[K, CHUNK], f32[K]) -> f32 array of ``shape``."""
+    import jax.numpy as jnp
+
+    flat = (q.astype(jnp.float32) * scale[:, None]).reshape(-1)
+    n = math.prod(shape) if shape else 1
+    return flat[:n].reshape(shape)
+
+
+def fake_quant_wire(v, seed: int, step, sender, leaf: int = 0):
+    """Quantize-dequantize ``v`` exactly as the wire would — the in-graph
+    emulation the SPMD transports apply to the SHIPPED copy (f32 leaves
+    only; callers gate on dtype)."""
+    q, scale = quantize(v, wire_key(seed, step, sender, leaf))
+    return dequantize(q, scale, v.shape)
+
+
+def fake_quant_tree(params, seed: int, step, sender):
+    """Apply :func:`fake_quant_wire` to every f32 leaf of a pytree, with
+    the leaf's flatten-order index folded into its key.  Both SPMD
+    transports build their shipped copy through THIS function, so their
+    per-leaf keys — and therefore their merges — are bit-identical."""
+    import jax
+    import jax.numpy as jnp
+
+    leaves, treedef = jax.tree.flatten(params)
+    out = [
+        fake_quant_wire(v, seed, step, sender, leaf=i)
+        if v.dtype == jnp.float32
+        else v
+        for i, v in enumerate(leaves)
+    ]
+    return jax.tree.unflatten(treedef, out)
+
+
+# --------------------------------------------------------------------------
+# numpy path (TCP transport codec; free-running host processes)
+# --------------------------------------------------------------------------
+
+
+def _np_rng(seed: int, clock: float, sender: int) -> np.random.Generator:
+    # Philox takes a 128-bit key as two u64 words: (seed, sender) in one,
+    # the publish clock in the other.
+    k0 = ((seed ^ _WIRE_SALT) & 0xFFFFFFFF) | ((sender & 0xFFFFFFFF) << 32)
+    k1 = int(clock) & 0xFFFFFFFFFFFFFFFF
+    return np.random.Generator(np.random.Philox(key=[k0, k1]))
+
+
+def quantize_np(
+    vec: np.ndarray, seed: int, clock: float, sender: int
+) -> Tuple[np.ndarray, np.ndarray]:
+    """f32[n] -> (int8[n], f32 scales[K]) with stochastic rounding."""
+    flat = np.ascontiguousarray(vec, dtype=np.float32).reshape(-1)
+    n = flat.shape[0]
+    k = _n_chunks(n)
+    padded = np.zeros(k * CHUNK, np.float32)
+    padded[:n] = flat
+    chunks = padded.reshape(k, CHUNK)
+    scale = (np.max(np.abs(chunks), axis=1) / 127.0).astype(np.float32)
+    safe = np.where(scale > 0, scale, 1.0).astype(np.float32)
+    u = _np_rng(seed, clock, sender).random(
+        chunks.shape, dtype=np.float32
+    )
+    q = np.clip(np.floor(chunks / safe[:, None] + u), -127, 127).astype(
+        np.int8
+    )
+    q[scale == 0, :] = 0
+    return q.reshape(-1)[:n].copy(), scale
+
+
+def dequantize_np(q: np.ndarray, scale: np.ndarray) -> np.ndarray:
+    """(int8[n], f32[K]) -> f32[n]."""
+    n = q.shape[0]
+    k = _n_chunks(n)
+    padded = np.zeros(k * CHUNK, np.int8)
+    padded[:n] = q
+    out = padded.reshape(k, CHUNK).astype(np.float32) * scale[:, None]
+    return out.reshape(-1)[:n].copy()
+
+
+# TCP wire payload for dtype code 4 (int8-chunked):
+#   u64 n_elems | f32 scales[ceil(n/CHUNK)] | int8 q[n]
+_LEN = np.dtype("<u8")
+
+
+def encode_int8_payload(
+    vec: np.ndarray, seed: int, clock: float, sender: int
+) -> np.ndarray:
+    q, scale = quantize_np(vec, seed, clock, sender)
+    n = q.shape[0]
+    buf = np.empty(8 + 4 * scale.shape[0] + n, np.uint8)
+    buf[:8] = np.frombuffer(np.uint64(n).tobytes(), np.uint8)
+    buf[8:8 + 4 * scale.shape[0]] = np.frombuffer(
+        scale.astype("<f4").tobytes(), np.uint8
+    )
+    buf[8 + 4 * scale.shape[0]:] = q.view(np.uint8)
+    return buf
+
+
+def decode_int8_payload(buf: np.ndarray) -> np.ndarray:
+    """uint8 payload -> f32[n]; raises ValueError on malformed payloads
+    (callers treat that as a skipped fetch)."""
+    raw = np.ascontiguousarray(buf, dtype=np.uint8)
+    if raw.size < 8:
+        raise ValueError("int8 wire payload shorter than its length field")
+    n = int(np.frombuffer(raw[:8].tobytes(), "<u8")[0])
+    k = _n_chunks(n)
+    if raw.size != 8 + 4 * k + n:
+        raise ValueError(
+            f"int8 wire payload size {raw.size} != {8 + 4 * k + n} "
+            f"expected for n={n}"
+        )
+    scale = np.frombuffer(raw[8:8 + 4 * k].tobytes(), "<f4").astype(
+        np.float32
+    )
+    q = raw[8 + 4 * k:].view(np.int8)
+    return dequantize_np(q, scale)

@@ -87,9 +87,14 @@ def gossip_exchange_local(
 
         return apply
 
-    # wire_dtype=bf16: only the SHIPPED copy is compressed — the collective
-    # moves half the ICI/DCN bytes; the local replica and the merge math
-    # stay f32 (the partner's contribution arrives rounded, scaled by α).
+    # Compressed wire: only the SHIPPED copy is compressed — bf16 halves
+    # the ICI/DCN bytes; int8 quarters them for real (the collective
+    # moves the ``(int8 q, f32 scales)`` encoding, NOT a dequantized f32
+    # copy — the receiver decodes after the ppermute); the local replica
+    # and the merge math stay f32 (the partner's contribution arrives
+    # rounded, scaled by α).  Stochastic rounding keeps the quantizer
+    # unbiased (ops/quantize.py).
+    decode_remote = None
     if schedule.wire_dtype == "bf16":
         wire_params = jax.tree.map(
             lambda v: v.astype(jnp.bfloat16)
@@ -97,6 +102,37 @@ def gossip_exchange_local(
             else v,
             params,
         )
+    elif schedule.wire_dtype == "int8":
+        from dpwa_tpu.ops import quantize as qz
+
+        # Each device quantizes ITS OWN copy (sender-keyed, per-leaf) —
+        # the stacked twin derives the same (step, sender, leaf) keys and
+        # dequantize commutes with its gather elementwise, so the two
+        # transports stay bit-identical.
+        leaves, treedef = jax.tree.flatten(params)
+        enc = [
+            qz.quantize(v, qz.wire_key(schedule.seed, step, me, leaf=i))
+            if v.dtype == jnp.float32
+            else v
+            for i, v in enumerate(leaves)
+        ]
+        # (q, scales) tuples become subtrees: ppermute moves the int8
+        # codes and the tiny f32 scale vectors as separate leaves.
+        wire_params = jax.tree.unflatten(treedef, enc)
+
+        def decode_remote(remote_tree):
+            flat = jax.tree.leaves(remote_tree)
+            out, j = [], 0
+            for v in leaves:
+                if v.dtype == jnp.float32:
+                    q, s = flat[j], flat[j + 1]
+                    j += 2
+                    out.append(qz.dequantize(q, s, v.shape))
+                else:
+                    out.append(flat[j])
+                    j += 1
+            return jax.tree.unflatten(treedef, out)
+
     else:
         wire_params = params
     remote_params, remote_meta = lax.switch(
@@ -104,6 +140,8 @@ def gossip_exchange_local(
         [make_branch(p) for p in schedule.pool],
         (wire_params, meta),
     )
+    if decode_remote is not None:
+        remote_params = decode_remote(remote_params)
 
     # Pull mode: the pull is one-sided, so the puller draws alone (the
     # reference's per-process fetch decision); pairwise: both members of a

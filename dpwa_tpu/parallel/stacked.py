@@ -86,11 +86,25 @@ def stacked_gossip_exchange(
     alpha = jax.vmap(interp)(meta, remote_meta)
     alpha = jnp.where(participated, alpha, 0.0).astype(jnp.float32)
 
-    def merge(x):
+    if schedule.wire_dtype == "int8":
+        from dpwa_tpu.ops.quantize import fake_quant_tree
+
+        # Emulate the wire per SENDER: row s of every stacked leaf is
+        # quantized with sender s's key (vmap over the peer axis), then
+        # gathered by the receiver — the same (step, sender, leaf) key
+        # derivation as the ICI transport, so the merges stay
+        # bit-identical across the two.
+        wire_params = jax.vmap(
+            lambda row, s: fake_quant_tree(row, schedule.seed, step, s)
+        )(params, me)
+    else:
+        wire_params = params
+
+    def merge(x, xw):
         a = alpha.reshape((n,) + (1,) * (x.ndim - 1)).astype(
             jnp.promote_types(x.dtype, jnp.float32)
         )
-        y = x[partner]
+        y = xw[partner]
         if schedule.wire_dtype == "bf16" and x.dtype == jnp.float32:
             # Emulate the wire: the partner's contribution is what would
             # have arrived over the fabric — bf16-rounded.  Keeps the
@@ -100,7 +114,7 @@ def stacked_gossip_exchange(
             x.dtype
         )
 
-    merged = jax.tree.map(merge, params)
+    merged = jax.tree.map(merge, params, wire_params)
     return merged, ExchangeInfo(partner, alpha, participated)
 
 

@@ -55,6 +55,10 @@ try:  # bf16 wire code (protocol.wire_dtype: bf16) — ml_dtypes ships w/ jax
 except ImportError:  # pragma: no cover - ml_dtypes is a jax dependency
     ml_dtypes = None
 _DTYPE_CODES = {v: k for k, v in _DTYPES.items()}
+# Code 4 is NOT a flat numpy dtype: int8-chunked payload
+# (u64 n | f32 scales | int8 q — ops/quantize.py), decoded to f32 by
+# fetch_blob.  protocol.wire_dtype: int8.
+_INT8_CHUNKED = 4
 _MAX_BLOB = 1 << 34  # 16 GiB sanity bound on advertised payload size
 
 
@@ -68,22 +72,30 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     return bytes(buf)
 
 
-def _frame(vec: np.ndarray, clock: float, loss: float) -> bytes:
+def _frame(
+    vec: np.ndarray, clock: float, loss: float, code: Optional[int] = None
+) -> bytes:
     """Header + raw vector bytes — the one definition of the wire format,
-    shared by the Python and native Rx servers."""
+    shared by the Python and native Rx servers.
+
+    ``code`` overrides the dtype byte for structured payloads
+    (``_INT8_CHUNKED``: ``vec`` is the already-encoded uint8 buffer)."""
     vec = np.ascontiguousarray(vec)
-    # Exact-dtype lookup first (covers bf16, whose custom numpy dtype
-    # has no byte-order variants), then the byte-order-normalized
-    # form, then an f32 fallback.
-    code = _DTYPE_CODES.get(vec.dtype)
     if code is None:
-        try:
-            code = _DTYPE_CODES.get(np.dtype(vec.dtype.newbyteorder("<")))
-        except (TypeError, ValueError):  # pragma: no cover
-            code = None
-    if code is None:
-        vec = vec.astype("<f4")
-        code = _DTYPE_CODES[np.dtype("<f4")]
+        # Exact-dtype lookup first (covers bf16, whose custom numpy dtype
+        # has no byte-order variants), then the byte-order-normalized
+        # form, then an f32 fallback.
+        code = _DTYPE_CODES.get(vec.dtype)
+        if code is None:
+            try:
+                code = _DTYPE_CODES.get(
+                    np.dtype(vec.dtype.newbyteorder("<"))
+                )
+            except (TypeError, ValueError):  # pragma: no cover
+                code = None
+        if code is None:
+            vec = vec.astype("<f4")
+            code = _DTYPE_CODES[np.dtype("<f4")]
     data = vec.tobytes()
     header = _HDR.pack(_MAGIC, 1, code, float(clock), float(loss), len(data))
     return header + data
@@ -110,8 +122,14 @@ class PeerServer:
         )
         self._thread.start()
 
-    def publish(self, vec: np.ndarray, clock: float, loss: float) -> None:
-        payload = _frame(vec, clock, loss)
+    def publish(
+        self,
+        vec: np.ndarray,
+        clock: float,
+        loss: float,
+        code: Optional[int] = None,
+    ) -> None:
+        payload = _frame(vec, clock, loss, code)
         with self._lock:
             self._payload = payload
 
@@ -167,8 +185,14 @@ class NativePeerServer:
         self._srv = native.NativeRxServer(host, port)
         self.port = self._srv.port
 
-    def publish(self, vec: np.ndarray, clock: float, loss: float) -> None:
-        self._srv.publish_framed(_frame(vec, clock, loss))
+    def publish(
+        self,
+        vec: np.ndarray,
+        clock: float,
+        loss: float,
+        code: Optional[int] = None,
+    ) -> None:
+        self._srv.publish_framed(_frame(vec, clock, loss, code))
 
     def close(self) -> None:
         self._srv.close()
@@ -204,12 +228,26 @@ def fetch_blob(
             sock.sendall(_REQ)
             raw = _recv_exact(sock, _HDR.size)
             magic, version, code, clock, loss, nbytes = _HDR.unpack(raw)
-            if magic != _MAGIC or version != 1 or code not in _DTYPES:
+            if magic != _MAGIC or version != 1 or (
+                code not in _DTYPES and code != _INT8_CHUNKED
+            ):
                 return None
             if nbytes > _MAX_BLOB:
                 return None
             data = _recv_exact(sock, nbytes)
-            vec = np.frombuffer(data, dtype=_DTYPES[code]).copy()
+            if code == _INT8_CHUNKED:
+                # Receiver-side dequantize: the wire moved 1 byte/elem
+                # (+ scales); the merge math runs on the f32 decode.
+                from dpwa_tpu.ops.quantize import decode_int8_payload
+
+                try:
+                    vec = decode_int8_payload(
+                        np.frombuffer(data, dtype=np.uint8)
+                    )
+                except ValueError:
+                    return None  # malformed payload == skipped fetch
+            else:
+                vec = np.frombuffer(data, dtype=_DTYPES[code]).copy()
             return vec, clock, loss
     except (OSError, ConnectionError):
         return None
@@ -323,6 +361,7 @@ class TcpTransport:
         self.schedule: Schedule = build_schedule(config)
         self.interp = make_interpolation(config.interpolation)
         self._wire_bf16 = config.protocol.wire_dtype == "bf16"
+        self._wire_int8 = config.protocol.wire_dtype == "int8"
         if self._wire_bf16 and ml_dtypes is None:  # pragma: no cover
             raise RuntimeError("wire_dtype bf16 requires ml_dtypes")
         spec = config.nodes[self.me]
@@ -341,10 +380,20 @@ class TcpTransport:
         self._ports[index] = (host, port)
 
     def publish(self, vec: np.ndarray, clock: float, loss: float) -> None:
-        # wire_dtype bf16: only the PUBLISHED (served) copy is compressed —
-        # half the wire bytes; the local replica stays f32 (mirrors the
-        # ICI transport, which casts the shipped copy before the
-        # collective).
+        # Compressed wire: only the PUBLISHED (served) copy is compressed
+        # — bf16 halves the wire bytes, int8 quarters them; the local
+        # replica stays f32 (mirrors the ICI transport, which compresses
+        # the shipped copy before the collective).  int8 is quantized
+        # with stochastic rounding keyed on (seed, clock, me) and
+        # dequantized by the FETCHING side (ops/quantize.py).
+        if self._wire_int8 and vec.dtype == np.float32:
+            from dpwa_tpu.ops.quantize import encode_int8_payload
+
+            payload = encode_int8_payload(
+                vec, self.schedule.seed, clock, self.me
+            )
+            self.server.publish(payload, clock, loss, code=_INT8_CHUNKED)
+            return
         if self._wire_bf16 and vec.dtype == np.float32:
             vec = vec.astype(_DTYPES[3])
         self.server.publish(vec, clock, loss)

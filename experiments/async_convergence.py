@@ -609,7 +609,7 @@ def main() -> int:
     r.add_argument("--seeds", default="0,1,2")
     r.add_argument("--steps", type=int, default=STEPS)
     r.add_argument(
-        "--wire-dtype", choices=("f32", "bf16"), default=None,
+        "--wire-dtype", choices=("f32", "bf16", "int8"), default=None,
         help="bf16 runs the whole study with the compressed wire and "
         "writes artifacts to artifacts/async_convergence_bf16w/",
     )

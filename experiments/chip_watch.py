@@ -369,14 +369,23 @@ def main() -> None:
     ap.add_argument("--once", action="store_true",
                     help="single probe (and jobs if alive), then exit")
     ap.add_argument(
+        "--new-round", action="store_true",
+        help="FIRST launch of a round: rotate the previous round's probe "
+        "history and chip-job artifacts to *_prev so every job "
+        "re-measures.  Default (no flag) RESUMES: artifacts are kept and "
+        "only missing jobs retry — the safe behavior for a mid-round "
+        "restart (forgetting a flag must never destroy landed chip "
+        "artifacts; bench.py's freshness bound on captured_at_utc is the "
+        "backstop against a stale capture being promoted).",
+    )
+    ap.add_argument(
         "--no-rotate", action="store_true",
-        help="same-round restart: keep the existing probe history and "
-        "chip-job artifacts instead of rotating them to *_prev",
+        help=argparse.SUPPRESS,  # legacy alias of the (now default) resume
     )
     args = ap.parse_args()
 
     deadline = time.monotonic() + args.max_hours * 3600
-    if not args.once and not args.no_rotate:
+    if args.new_round and not args.once:
         rotate_round_artifacts()
     state = job_state()
     jobs_done = all(state.values())

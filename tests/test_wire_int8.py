@@ -1,0 +1,258 @@
+"""int8 stochastic-rounding wire: `protocol.wire_dtype: int8`.
+
+The third member of the compressed-wire family (f32 | bf16 | int8 —
+`ops/quantize.py`): the SHIPPED replica moves as one int8 per element
+plus one f32 scale per 256-element chunk (~3.9x fewer bytes than f32);
+the local replica and the merge arithmetic stay f32.  Stochastic
+rounding makes the quantizer unbiased, which is the property gossip
+averaging needs (deterministic rounding freezes coordinate pairs whose
+gap is below one grid step).  These tests pin the quantizer's error
+bound and unbiasedness, ICI/stacked bit-parity, the TCP payload format
+and its compression ratio, and convergence under the compressed wire.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from dpwa_tpu.config import make_local_config
+from dpwa_tpu.interpolation import PeerMeta
+from dpwa_tpu.ops import quantize as qz
+from dpwa_tpu.parallel.ici import IciTransport
+from dpwa_tpu.parallel.mesh import make_mesh
+from dpwa_tpu.parallel.stacked import StackedTransport
+from dpwa_tpu.parallel.tcp import TcpTransport
+
+N = 8
+
+
+def _payload(seed=0, shape=(N, 300)):
+    rng = np.random.default_rng(seed)
+    return (rng.standard_normal(shape) * 1.7).astype(np.float32)
+
+
+def test_config_accepts_int8_rejects_unknown():
+    cfg = make_local_config(4, wire_dtype="int8")
+    assert cfg.protocol.wire_dtype == "int8"
+    with pytest.raises(ValueError):
+        make_local_config(4, wire_dtype="int4")
+
+
+def test_quantize_roundtrip_error_bound():
+    # Stochastic rounding moves each element by < 1 grid step:
+    # |dequant(quant(v)) - v| < scale of its chunk.
+    v = jnp.asarray(_payload(seed=1, shape=(1000,)))
+    q, scale = qz.quantize(v, jax.random.key(0))
+    back = qz.dequantize(q, scale, v.shape)
+    err = np.abs(np.asarray(back) - np.asarray(v))
+    k = qz._n_chunks(v.shape[0])
+    per_elem_scale = np.repeat(np.asarray(scale), qz.CHUNK)[: v.shape[0]]
+    assert (err <= per_elem_scale + 1e-7).all()
+    # numpy path obeys the same bound and produces the same scales.
+    qn, scale_n = qz.quantize_np(np.asarray(v), 0, 0.0, 0)
+    np.testing.assert_allclose(scale_n, np.asarray(scale), rtol=1e-6)
+    back_n = qz.dequantize_np(qn, scale_n)
+    assert (np.abs(back_n - np.asarray(v)) <= per_elem_scale + 1e-7).all()
+    assert scale_n.shape == (k,)
+
+
+def test_quantize_unbiased():
+    # E[dequant(quant(v))] = v: averaging over many independent keys
+    # converges to the original (the property gossip averaging relies
+    # on; deterministic rounding fails this on sub-grid offsets).
+    v = jnp.asarray(_payload(seed=2, shape=(512,)))
+    reps = 400
+    acc = np.zeros(v.shape, np.float64)
+    for i in range(reps):
+        q, s = qz.quantize(v, jax.random.key(i))
+        acc += np.asarray(qz.dequantize(q, s, v.shape), np.float64)
+    mean = acc / reps
+    _, scale = qz.quantize(v, jax.random.key(0))
+    per_elem_scale = np.repeat(np.asarray(scale), qz.CHUNK)[: v.shape[0]]
+    # 5 sigma of the mean-of-reps noise (per-element sd <= scale/2).
+    tol = 5 * per_elem_scale / 2 / np.sqrt(reps) + 1e-7
+    assert (np.abs(mean - np.asarray(v)) <= tol).all()
+
+
+def test_quantize_edge_cases():
+    # All-zero chunks decode to exact zeros; lengths that are not chunk
+    # multiples round-trip at the right length; extreme magnitudes hold
+    # the error bound.
+    z = jnp.zeros(qz.CHUNK * 2 + 17, jnp.float32)
+    q, s = qz.quantize(z, jax.random.key(0))
+    np.testing.assert_array_equal(np.asarray(qz.dequantize(q, s, z.shape)), 0)
+    v = jnp.asarray(
+        np.array([1e-30, -1e-30, 1e30, -1e30, 0.0], np.float32)
+    )
+    q, s = qz.quantize(v, jax.random.key(1))
+    back = np.asarray(qz.dequantize(q, s, v.shape))
+    assert back.shape == v.shape
+    assert np.isfinite(back).all()
+    scale = float(np.asarray(s)[0])
+    assert (np.abs(back - np.asarray(v)) <= scale + 1e-7).all()
+
+
+def test_ici_int8_wire_quantizes_remote_only():
+    cfg = make_local_config(N, schedule="ring", wire_dtype="int8")
+    t = IciTransport(cfg, mesh=make_mesh(cfg))
+    x = _payload()
+    meta = PeerMeta(jnp.ones(N), jnp.ones(N))
+    merged, info = t.exchange({"w": jnp.asarray(x)}, meta, 0)
+    partner = np.asarray(info.partner)
+    # Recompute the shipped copy with the same per-sender keys.
+    wire = np.stack(
+        [
+            np.asarray(
+                qz.fake_quant_tree(
+                    {"w": jnp.asarray(x[s])}, cfg.protocol.seed, 0, s
+                )["w"]
+            )
+            for s in range(N)
+        ]
+    )
+    expect = 0.5 * x + 0.5 * wire[partner]
+    np.testing.assert_allclose(
+        np.asarray(merged["w"]), expect, rtol=1e-6, atol=1e-7
+    )
+    # Quantization must be real (not the exact-f32 merge) ...
+    exact = 0.5 * x + 0.5 * x[partner]
+    assert not np.allclose(np.asarray(merged["w"]), exact, atol=1e-7)
+    # ... and bounded by one grid step on the remote half.
+    err = np.abs(np.asarray(merged["w"]) - exact)
+    assert err.max() < 0.5 * np.abs(x).max() / 127 * 1.01
+
+
+def test_stacked_matches_ici_int8_bitwise():
+    cfg = make_local_config(
+        N, schedule="random", fetch_probability=0.6, wire_dtype="int8"
+    )
+    x = _payload(seed=2)
+    x2 = _payload(seed=3, shape=(N, 7, 11))  # 2nd leaf, same-dtype, odd dims
+    meta = PeerMeta(jnp.ones(N), jnp.ones(N))
+    ici = IciTransport(cfg, mesh=make_mesh(cfg))
+    st = StackedTransport(cfg)
+    tree = {"w": jnp.asarray(x), "b": jnp.asarray(x2)}
+    a, ia = ici.exchange(tree, meta, 5)
+    b, ib = st.exchange(tree, meta, 5)
+    np.testing.assert_array_equal(
+        np.asarray(ia.partner), np.asarray(ib.partner)
+    )
+    # Same (step, sender, leaf) keys on both transports -> bit equality.
+    np.testing.assert_array_equal(np.asarray(a["w"]), np.asarray(b["w"]))
+    np.testing.assert_array_equal(np.asarray(a["b"]), np.asarray(b["b"]))
+
+
+def test_ici_int8_collective_ships_s8_bytes():
+    """The compression must be real on the fabric: the compiled ICI
+    exchange's collective-permute operands include the s8 codes (and the
+    tiny f32 scale vectors), NOT a dequantized f32 copy of the params —
+    that is the 3.9x ICI/DCN byte saving the wire exists for."""
+    import re
+
+    cfg = make_local_config(N, schedule="ring", wire_dtype="int8")
+    t = IciTransport(cfg, mesh=make_mesh(cfg))
+    x = jnp.asarray(_payload())
+    meta = PeerMeta(jnp.ones(N), jnp.ones(N))
+    hlo = (
+        jax.jit(lambda p, m: t.exchange(p, m, 0))
+        .lower({"w": x}, meta)
+        .compile()
+        .as_text()
+    )
+    permuted = re.findall(r"= (\w+)\[([\d,]*)\][^ ]* collective-permute", hlo)
+    assert any(ty == "s8" for ty, _ in permuted), permuted
+    # No f32 operand of the collective may be params-sized (the scales
+    # are 127x smaller); a full-size f32 permute would mean the encoding
+    # rode ALONGSIDE an uncompressed copy.
+    import math
+
+    per_peer = x.shape[1]
+    for ty, dims in permuted:
+        if ty == "f32":
+            size = math.prod(int(d) for d in dims.split(",") if d)
+            assert size < per_peer / 10, (ty, dims)
+
+
+def test_tcp_int8_roundtrip_compression_and_merge():
+    cfg = make_local_config(
+        2, base_port=0, schedule="ring", wire_dtype="int8"
+    )
+    ts = [TcpTransport(cfg, f"node{i}") for i in range(2)]
+    for t in ts:
+        for i, other in enumerate(ts):
+            t.set_peer_port(i, other.port)
+    try:
+        n = 4096
+        vecs = [_payload(seed=i, shape=(n,)) for i in range(2)]
+        for i, t in enumerate(ts):
+            t.publish(vecs[i], 1.0, 0.5)
+        got = ts[0].fetch(1)
+        assert got is not None
+        remote, clock, loss = got
+        assert clock == 1.0 and loss == 0.5
+        # Fetch hands back the f32 DECODE of the compressed payload...
+        assert remote.dtype == np.float32 and remote.shape == (n,)
+        scale = np.abs(vecs[1]).reshape(-1, qz.CHUNK).max(axis=1) / 127
+        per_elem = np.repeat(scale, qz.CHUNK)
+        assert (np.abs(remote - vecs[1]) <= per_elem + 1e-7).all()
+        assert not np.allclose(remote, vecs[1], atol=1e-7)
+        # ... and the wire payload itself was ~4x smaller than f32.
+        payload = qz.encode_int8_payload(
+            vecs[1], cfg.protocol.seed, 1.0, 1
+        )
+        assert payload.nbytes < vecs[1].nbytes / 3.8
+        np.testing.assert_allclose(
+            qz.decode_int8_payload(payload), remote, rtol=0, atol=0
+        )
+        # The merge consumes the decode: (1-a)x + a*decode.
+        merged, alpha, partner = ts[0].exchange(vecs[0], 2.0, 0.5, 0)
+        assert alpha == 0.5 and partner == 1
+        np.testing.assert_allclose(
+            merged, 0.5 * vecs[0] + 0.5 * remote, rtol=1e-6, atol=1e-7
+        )
+    finally:
+        for t in ts:
+            t.close()
+
+
+def test_decode_rejects_malformed_payload():
+    with pytest.raises(ValueError):
+        qz.decode_int8_payload(np.zeros(3, np.uint8))
+    good = qz.encode_int8_payload(_payload(shape=(500,)), 0, 0.0, 0)
+    with pytest.raises(ValueError):
+        qz.decode_int8_payload(good[:-1])  # truncated
+
+
+def test_int8_wire_training_converges():
+    from dpwa_tpu.data import load_digits_dataset, peer_batches
+    from dpwa_tpu.models.mnist import SmallNet
+    from dpwa_tpu.parallel.stacked import (
+        init_stacked_state,
+        make_stacked_train_step,
+    )
+    from dpwa_tpu.train import make_gossip_eval_fn, stack_params
+
+    x_tr, y_tr, x_te, y_te = load_digits_dataset()
+    model = SmallNet()
+    params0 = model.init(jax.random.key(0), jnp.zeros((1, 8, 8, 1)))
+    cfg = make_local_config(N, schedule="ring", wire_dtype="int8")
+    transport = StackedTransport(cfg)
+    opt = optax.sgd(0.05, momentum=0.9)
+    state = init_stacked_state(stack_params(params0, N), opt, transport)
+
+    def loss_fn(params, batch):
+        x, y = batch
+        logits = model.apply(params, x)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, y
+        ).mean()
+
+    step = make_stacked_train_step(loss_fn, opt, transport)
+    batches = peer_batches(x_tr, y_tr, N, 32, seed=0)
+    for _ in range(80):
+        state, _, _ = step(state, next(batches))
+    eval_fn = make_gossip_eval_fn(model.apply)
+    accs = np.asarray(eval_fn(state.params, x_te, y_te))
+    assert accs.min() > 0.85, accs
