@@ -1,2 +1,6 @@
 from dpwa_tpu.adapters.jax_adapter import DpwaJaxAdapter  # noqa: F401
-from dpwa_tpu.adapters.tcp_adapter import DpwaTcpAdapter  # noqa: F401
+from dpwa_tpu.adapters.tcp_adapter import (  # noqa: F401
+    DpwaPyTorchAdapter,
+    DpwaTcpAdapter,
+    DpwaTorchAdapter,
+)

@@ -101,3 +101,13 @@ def test_torch_adapter_reference_surface():
     finally:
         a0.close()
         a1.close()
+
+
+def test_package_exports_drop_in_import_path():
+    """docs/migration.md's drop-in contract: a reference user changes ONLY
+    the import line — `from dpwa_tpu.adapters import DpwaPyTorchAdapter`
+    must resolve (to the torch adapter) at the package level."""
+    import dpwa_tpu.adapters as pkg
+
+    assert pkg.DpwaPyTorchAdapter is pkg.DpwaTorchAdapter
+    assert hasattr(pkg, "DpwaTcpAdapter") and hasattr(pkg, "DpwaJaxAdapter")
