@@ -40,4 +40,87 @@ uint64_t dpwa_checksum(const uint8_t* data, size_t n) {
   return h;
 }
 
+// int8 stochastic-rounding quantizer (the wire_dtype: int8 codec's hot
+// loop — ops/quantize.py).  Per-`chunk` absmax scales; the dither is a
+// counter-based splitmix64 of (key, element index), so the result is
+// deterministic for a given key, order-independent, and the loop stays a
+// single streaming pass (numpy's Generator.random alone costs more than
+// the localhost byte saving; this runs at memory bandwidth).
+static inline uint64_t dpwa_mix64(uint64_t x) {
+  x += 0x9E3779B97F4A7C15ull;
+  x = (x ^ (x >> 30)) * 0xBF58476D1CE4E5B9ull;
+  x = (x ^ (x >> 27)) * 0x94D049BB133111EBull;
+  return x ^ (x >> 31);
+}
+
+void dpwa_quantize_sr(const float* src, size_t n, size_t chunk, int8_t* q,
+                      float* scales, uint64_t k0, uint64_t k1) {
+  const size_t nchunks = (n + chunk - 1) / chunk;
+  const uint64_t key = dpwa_mix64(k0) ^ (k1 * 0xD1B54A32D192ED03ull);
+  const float inv24 = 1.0f / 16777216.0f;  // 2^-24: 24-bit uniform [0,1)
+  for (size_t c = 0; c < nchunks; ++c) {
+    const size_t lo = c * chunk;
+    const size_t hi = lo + chunk < n ? lo + chunk : n;
+    float m = 0.0f;
+    for (size_t i = lo; i < hi; ++i) {
+      const float a = src[i] < 0 ? -src[i] : src[i];
+      if (a > m) m = a;
+    }
+    const float s = m / 127.0f;
+    scales[c] = s;
+    if (s == 0.0f) {
+      for (size_t i = lo; i < hi; ++i) q[i] = 0;
+      continue;
+    }
+    const float inv = 1.0f / s;
+    // One mix64 feeds TWO elements (24-bit slices of the 64-bit hash —
+    // independent uniform dithers): unrolled so the hash, the loop's
+    // hot cost, genuinely runs once per pair instead of hoping the
+    // optimizer CSEs it across iterations.  Pairing is by GLOBAL index
+    // (i>>1), so the dither for element i never depends on its chunk.
+    size_t i = lo;
+    if (i < hi && (i & 1)) {  // odd leading element: high slice alone
+      const uint64_t r = dpwa_mix64(key + (i >> 1));
+      const float u = (float)((r >> 24) & 0xFFFFFFull) * inv24;
+      float t = __builtin_floorf(src[i] * inv + u);
+      if (t > 127.0f) t = 127.0f;
+      if (t < -127.0f) t = -127.0f;
+      q[i] = (int8_t)t;
+      ++i;
+    }
+    for (; i + 1 < hi; i += 2) {
+      const uint64_t r = dpwa_mix64(key + (i >> 1));
+      const float u0 = (float)(r & 0xFFFFFFull) * inv24;
+      const float u1 = (float)((r >> 24) & 0xFFFFFFull) * inv24;
+      float t0 = __builtin_floorf(src[i] * inv + u0);
+      float t1 = __builtin_floorf(src[i + 1] * inv + u1);
+      if (t0 > 127.0f) t0 = 127.0f;
+      if (t0 < -127.0f) t0 = -127.0f;
+      if (t1 > 127.0f) t1 = 127.0f;
+      if (t1 < -127.0f) t1 = -127.0f;
+      q[i] = (int8_t)t0;
+      q[i + 1] = (int8_t)t1;
+    }
+    if (i < hi) {  // even trailing element: low slice alone
+      const uint64_t r = dpwa_mix64(key + (i >> 1));
+      const float u = (float)(r & 0xFFFFFFull) * inv24;
+      float t = __builtin_floorf(src[i] * inv + u);
+      if (t > 127.0f) t = 127.0f;
+      if (t < -127.0f) t = -127.0f;
+      q[i] = (int8_t)t;
+    }
+  }
+}
+
+void dpwa_dequantize(const int8_t* q, const float* scales, size_t n,
+                     size_t chunk, float* dst) {
+  const size_t nchunks = (n + chunk - 1) / chunk;
+  for (size_t c = 0; c < nchunks; ++c) {
+    const size_t lo = c * chunk;
+    const size_t hi = lo + chunk < n ? lo + chunk : n;
+    const float s = scales[c];
+    for (size_t i = lo; i < hi; ++i) dst[i] = (float)q[i] * s;
+  }
+}
+
 }  // extern "C"

@@ -126,19 +126,43 @@ def fake_quant_tree(params, seed: int, step, sender):
 # --------------------------------------------------------------------------
 
 
-def _np_rng(seed: int, clock: float, sender: int) -> np.random.Generator:
-    # Philox takes a 128-bit key as two u64 words: (seed, sender) in one,
-    # the publish clock in the other.
+def _np_key_words(seed: int, clock: float, sender: int) -> Tuple[int, int]:
+    """One logical 128-bit key for both host codecs: (seed, sender) in
+    one u64 word, the publish clock in the other."""
     k0 = ((seed ^ _WIRE_SALT) & 0xFFFFFFFF) | ((sender & 0xFFFFFFFF) << 32)
     k1 = int(clock) & 0xFFFFFFFFFFFFFFFF
-    return np.random.Generator(np.random.Philox(key=[k0, k1]))
+    return k0, k1
+
+
+def _np_rng(seed: int, clock: float, sender: int) -> np.random.Generator:
+    return np.random.Generator(
+        np.random.Philox(key=list(_np_key_words(seed, clock, sender)))
+    )
 
 
 def quantize_np(
-    vec: np.ndarray, seed: int, clock: float, sender: int
+    vec: np.ndarray, seed: int, clock: float, sender: int,
+    impl: str = "auto",
 ) -> Tuple[np.ndarray, np.ndarray]:
-    """f32[n] -> (int8[n], f32 scales[K]) with stochastic rounding."""
+    """f32[n] -> (int8[n], f32 scales[K]) with stochastic rounding.
+
+    ``impl="auto"`` uses the native single-pass kernel
+    (``native.quantize_sr``, splitmix64 dither) when the library is
+    available — the codec is memory-bandwidth work, and numpy's
+    ``Generator.random`` alone costs more than the int8 byte saving on
+    a cheap fabric — with this numpy/Philox path as the fallback.  The
+    two dither streams differ, so ``impl="numpy"`` pins this path where
+    a test needs it; both satisfy the same contract (unbiased, error
+    < 1 grid step, deterministic per (seed, clock, sender))."""
     flat = np.ascontiguousarray(vec, dtype=np.float32).reshape(-1)
+    if impl == "auto":
+        from dpwa_tpu import native
+
+        out = native.quantize_sr(
+            flat, CHUNK, *_np_key_words(seed, clock, sender)
+        )
+        if out is not None:
+            return out
     n = flat.shape[0]
     k = _n_chunks(n)
     padded = np.zeros(k * CHUNK, np.float32)
@@ -156,8 +180,29 @@ def quantize_np(
     return q.reshape(-1)[:n].copy(), scale
 
 
-def dequantize_np(q: np.ndarray, scale: np.ndarray) -> np.ndarray:
-    """(int8[n], f32[K]) -> f32[n]."""
+def dequantize_np(
+    q: np.ndarray, scale: np.ndarray, impl: str = "auto"
+) -> np.ndarray:
+    """(int8[n], f32[K]) -> f32[n] (native one-pass decode when
+    available; the two impls are bit-identical here — no RNG)."""
+    if q.shape[0] > 0 and scale.shape[0] != _n_chunks(q.shape[0]):
+        # Checked HERE for both impls: the native kernel would read past
+        # a short scales buffer, and numpy's broadcasting would silently
+        # smear one scale across every chunk.
+        raise ValueError(
+            f"scales has {scale.shape[0]} entries; "
+            f"{_n_chunks(q.shape[0])} expected for n={q.shape[0]}"
+        )
+    if impl == "auto":
+        from dpwa_tpu import native
+
+        out = native.dequantize(
+            np.ascontiguousarray(q),
+            np.ascontiguousarray(scale, dtype=np.float32),
+            CHUNK,
+        )
+        if out is not None:
+            return out
     n = q.shape[0]
     k = _n_chunks(n)
     padded = np.zeros(k * CHUNK, np.int8)

@@ -50,12 +50,46 @@ def test_quantize_roundtrip_error_bound():
     k = qz._n_chunks(v.shape[0])
     per_elem_scale = np.repeat(np.asarray(scale), qz.CHUNK)[: v.shape[0]]
     assert (err <= per_elem_scale + 1e-7).all()
-    # numpy path obeys the same bound and produces the same scales.
-    qn, scale_n = qz.quantize_np(np.asarray(v), 0, 0.0, 0)
-    np.testing.assert_allclose(scale_n, np.asarray(scale), rtol=1e-6)
-    back_n = qz.dequantize_np(qn, scale_n)
-    assert (np.abs(back_n - np.asarray(v)) <= per_elem_scale + 1e-7).all()
-    assert scale_n.shape == (k,)
+    # Both host codecs (numpy/Philox and the native splitmix64 kernel)
+    # obey the same bound, produce the same scales, and are
+    # deterministic; their dither streams differ by design.
+    for impl in ("numpy", "auto"):
+        qn, scale_n = qz.quantize_np(np.asarray(v), 0, 0.0, 0, impl=impl)
+        np.testing.assert_allclose(scale_n, np.asarray(scale), rtol=1e-6)
+        back_n = qz.dequantize_np(qn, scale_n, impl=impl)
+        assert (
+            np.abs(back_n - np.asarray(v)) <= per_elem_scale + 1e-7
+        ).all(), impl
+        assert scale_n.shape == (k,)
+        qn2, _ = qz.quantize_np(np.asarray(v), 0, 0.0, 0, impl=impl)
+        np.testing.assert_array_equal(qn, qn2)
+    # Decode is RNG-free: both impls bit-match on the same input.
+    q_auto, s_auto = qz.quantize_np(np.asarray(v), 0, 0.0, 0)
+    np.testing.assert_array_equal(
+        qz.dequantize_np(q_auto, s_auto, impl="numpy"),
+        qz.dequantize_np(q_auto, s_auto, impl="auto"),
+    )
+
+
+def test_native_quantizer_unbiased():
+    """The native splitmix64 dither must be unbiased like the other two
+    codecs — averaging dequantized replicas over many clocks converges
+    to the original."""
+    from dpwa_tpu import native
+
+    v = _payload(seed=7, shape=(512,))
+    if native.quantize_sr(v, qz.CHUNK, 0, 0) is None:
+        pytest.skip("native library unavailable on this box")
+    reps = 400
+    acc = np.zeros(v.shape, np.float64)
+    for clock in range(reps):
+        q, s = qz.quantize_np(v, 0, float(clock), 0)  # auto -> native here
+        acc += qz.dequantize_np(q, s).astype(np.float64)
+    mean = acc / reps
+    _, scale = qz.quantize_np(v, 0, 0.0, 0)
+    per_elem_scale = np.repeat(scale, qz.CHUNK)[: v.shape[0]]
+    tol = 5 * per_elem_scale / 2 / np.sqrt(reps) + 1e-7
+    assert (np.abs(mean - v) <= tol).all()
 
 
 def test_quantize_unbiased():
@@ -223,6 +257,22 @@ def test_decode_rejects_malformed_payload():
     good = qz.encode_int8_payload(_payload(shape=(500,)), 0, 0.0, 0)
     with pytest.raises(ValueError):
         qz.decode_int8_payload(good[:-1])  # truncated
+    # Short scales: rejected for BOTH impls (native would read OOB,
+    # numpy would silently broadcast one scale over every chunk).
+    for impl in ("numpy", "auto"):
+        with pytest.raises(ValueError):
+            qz.dequantize_np(
+                np.zeros(600, np.int8), np.zeros(1, np.float32), impl=impl
+            )
+
+
+def test_empty_vector_roundtrip_both_impls():
+    """n=0: the native kernel writes nothing — the wrapper must hand
+    back the numpy contract (one zero scale), not uninitialized heap."""
+    for impl in ("numpy", "auto"):
+        q, s = qz.quantize_np(np.zeros(0, np.float32), 0, 0.0, 0, impl=impl)
+        assert q.size == 0 and s.tolist() == [0.0], (impl, s)
+        assert qz.dequantize_np(q, s, impl=impl).size == 0
 
 
 def test_int8_wire_training_converges():
