@@ -113,3 +113,30 @@ def tree_size_bytes(tree: PyTree) -> int:
         leaf.size * leaf.dtype.itemsize
         for leaf in jax.tree_util.tree_leaves(tree)
     )
+
+
+def tree_wire_bytes(tree: PyTree, wire_dtype: str = "f32") -> int:
+    """Per-exchange bytes actually SHIPPED at a wire format.
+
+    ``protocol.wire_dtype`` compresses only f32 leaves (bf16: 2 bytes/
+    element; int8: 1 byte/element + one f32 scale per
+    :data:`dpwa_tpu.ops.quantize.CHUNK` elements); other dtypes ship
+    as-is.  This is the number ``exchanged_bytes`` metrics should report
+    under a compressed wire — ``tree_size_bytes`` is the uncompressed
+    replica size."""
+    if wire_dtype not in ("f32", "bf16", "int8"):
+        raise ValueError(f"unknown wire_dtype {wire_dtype!r}")
+    if wire_dtype == "f32":
+        return tree_size_bytes(tree)
+    from dpwa_tpu.ops.quantize import _n_chunks
+
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        if leaf.dtype == jnp.float32:
+            if wire_dtype == "bf16":
+                total += leaf.size * 2
+            else:  # int8
+                total += leaf.size + 4 * _n_chunks(leaf.size)
+        else:
+            total += leaf.size * leaf.dtype.itemsize
+    return total

@@ -66,7 +66,7 @@ def main() -> None:
         mlm_mask_batch,
     )
     from dpwa_tpu.train import stack_params
-    from dpwa_tpu.utils.pytree import tree_size_bytes
+    from dpwa_tpu.utils.pytree import tree_wire_bytes
 
     n = cfg.n_peers
     dtype = jnp.bfloat16 if args.bf16 else None
@@ -84,7 +84,10 @@ def main() -> None:
     opt = optax.adamw(args.lr)
     state = bundle.init_state(stacked, opt, transport)
     step_fn = bundle.make_step(mlm_loss_fn(model), opt, transport)
-    payload = tree_size_bytes(jax.tree.map(lambda v: v[0], stacked))
+    payload = tree_wire_bytes(
+        jax.tree.map(lambda v: v[0], stacked),
+        cfg.protocol.wire_dtype,
+    )
     print(
         f"BERT {'tiny' if args.tiny else 'base'} x{n} peers "
         f"({n // args.group_size} groups), payload {payload/1e6:.1f} MB",

@@ -110,7 +110,7 @@ def main() -> None:
         init_params_per_peer,
         make_gossip_eval_fn,
     )
-    from dpwa_tpu.utils.pytree import tree_size_bytes
+    from dpwa_tpu.utils.pytree import tree_wire_bytes
 
     try:
         x_tr, y_tr, x_te, y_te = load_cifar10(args.data_dir)
@@ -145,7 +145,10 @@ def main() -> None:
         return optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
 
     step_fn = make_step(loss_fn, opt, transport)
-    payload = tree_size_bytes(jax.tree.map(lambda v: v[0], stacked))
+    payload = tree_wire_bytes(
+        jax.tree.map(lambda v: v[0], stacked),
+        cfg.protocol.wire_dtype,
+    )
     metrics = MetricsLogger(stream=sys.stdout, every=args.log_every)
     if args.synthetic:
         # Synthetic throughput mode: pre-stage a small pool of device

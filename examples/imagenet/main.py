@@ -65,7 +65,7 @@ def main() -> None:
     from dpwa_tpu.metrics import MetricsLogger
     from dpwa_tpu.models.resnet import ResNet50
     from dpwa_tpu.train import init_params_per_peer
-    from dpwa_tpu.utils.pytree import tree_size_bytes
+    from dpwa_tpu.utils.pytree import tree_wire_bytes
 
     n = cfg.n_peers
     S = args.image_size
@@ -81,7 +81,10 @@ def main() -> None:
         return optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
 
     step_fn = bundle.make_step(loss_fn, opt, transport)
-    payload = tree_size_bytes(jax.tree.map(lambda v: v[0], stacked))
+    payload = tree_wire_bytes(
+        jax.tree.map(lambda v: v[0], stacked),
+        cfg.protocol.wire_dtype,
+    )
     print(
         f"ResNet-50 x{n} peers, payload {payload/1e6:.1f} MB/exchange, "
         f"random-pair pool of {transport.schedule.pool_size}",

@@ -60,7 +60,11 @@ def main() -> None:
         lora_optimizer,
     )
     from dpwa_tpu.train import init_params_per_peer
-    from dpwa_tpu.utils.pytree import partition, tree_size_bytes
+    from dpwa_tpu.utils.pytree import (
+        partition,
+        tree_size_bytes,
+        tree_wire_bytes,
+    )
 
     n = cfg.n_peers
     if args.full_size:
@@ -92,8 +96,9 @@ def main() -> None:
     one = jax.tree.map(lambda v: v[0], stacked)
     lora_sel, _ = partition(one, lora_filter)
     total = tree_size_bytes(one)
-    lora_bytes = tree_size_bytes(
-        {i: l for i, l in enumerate(jax.tree.leaves(lora_sel))}
+    lora_bytes = tree_wire_bytes(
+        {i: l for i, l in enumerate(jax.tree.leaves(lora_sel))},
+        cfg.protocol.wire_dtype,
     )
     print(
         f"Llama {'3-8B' if args.full_size else 'tiny'} x{n} peers; "

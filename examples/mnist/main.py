@@ -141,7 +141,7 @@ def run_single_process(args, stacked: bool) -> None:
     )
     from dpwa_tpu.metrics import MetricsLogger
     from dpwa_tpu.train import init_params_per_peer, make_gossip_eval_fn
-    from dpwa_tpu.utils.pytree import tree_size_bytes
+    from dpwa_tpu.utils.pytree import tree_wire_bytes
 
     n = cfg.n_peers
     if stacked:
@@ -180,7 +180,10 @@ def run_single_process(args, stacked: bool) -> None:
     opt = optax.adam(args.lr)
     state = init_state(stacked_params, opt, transport)
     step_fn = make_step(make_loss(model), opt, transport)
-    payload = tree_size_bytes(jax.tree.map(lambda v: v[0], stacked_params))
+    payload = tree_wire_bytes(
+        jax.tree.map(lambda v: v[0], stacked_params),
+        cfg.protocol.wire_dtype,
+    )
 
     metrics = MetricsLogger(stream=sys.stdout, every=args.log_every)
     stream = peer_batches(

@@ -56,3 +56,28 @@ def test_subset_ravel_empty_match():
 
 def test_tree_size_bytes():
     assert tree_size_bytes(_tree()) == (6 + 3 + 4 + 4) * 4
+
+
+def test_tree_wire_bytes_per_format():
+    """exchanged_bytes must reflect the wire format: bf16 halves f32
+    leaves, int8 ships 1 byte/elem + one f32 scale per 256-chunk;
+    non-f32 leaves ship as-is under every format."""
+    import numpy as np
+
+    from dpwa_tpu.utils.pytree import tree_size_bytes, tree_wire_bytes
+
+    tree = {
+        "w": np.zeros(1000, np.float32),
+        "idx": np.zeros(10, np.int32),
+    }
+    f32 = tree_wire_bytes(tree, "f32")
+    assert f32 == tree_size_bytes(tree) == 4000 + 40
+    assert tree_wire_bytes(tree, "bf16") == 2000 + 40
+    # 1000 elems -> 4 chunks of 256 -> 16 scale bytes
+    assert tree_wire_bytes(tree, "int8") == 1000 + 16 + 40
+    with pytest.raises(ValueError):
+        tree_wire_bytes(tree, "fp4")
+    # Unknown formats are rejected even when no f32 leaf would reach the
+    # per-leaf branch.
+    with pytest.raises(ValueError):
+        tree_wire_bytes({"idx": np.zeros(4, np.int32)}, "fp4")
