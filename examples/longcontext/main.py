@@ -53,7 +53,19 @@ def main() -> None:
         "devices (ops/zigzag_ring.py); data is zigzag-sharded here, the "
         "model handles rope positions",
     )
+    ap.add_argument(
+        "--sp-strategy", choices=("ring", "a2a"), default="ring",
+        help="'ring': K/V blocks rotate over the sp axis (flash-kernel "
+        "hops); 'a2a': Ulysses all-to-all to head-sharded attention "
+        "over the full sequence (ops/ulysses.py)",
+    )
     args = ap.parse_args()
+    if args.sp_strategy == "a2a" and args.sp_layout == "zigzag":
+        raise SystemExit(
+            "--sp-layout zigzag balances the causal RING; the a2a "
+            "strategy attends over the full sequence and needs the "
+            "contiguous layout"
+        )
 
     from dpwa_tpu.config import make_local_config
     from dpwa_tpu.utils.devices import ensure_devices
@@ -92,7 +104,12 @@ def main() -> None:
         lora_rank=args.lora,
     )
     model = Llama(
-        LlamaConfig(**base, sp_axis="sp", sp_layout=args.sp_layout)
+        LlamaConfig(
+            **base,
+            sp_axis="sp",
+            sp_layout=args.sp_layout,
+            sp_strategy=args.sp_strategy,
+        )
     )
     init_model = Llama(LlamaConfig(**base))  # init runs outside shard_map
 
