@@ -74,17 +74,27 @@ def test_cifar10_example_reads_data_dir():
     assert "synthetic" not in proc.stdout
 
 
-def test_longcontext_example_both_layouts():
-    """The longcontext example trains on the 2-D (peers, sp) mesh in both
-    sequence layouts; zigzag must land on the same loss as contiguous
-    (identical math, different work distribution)."""
+def test_longcontext_example_exact_variants():
+    """The longcontext example trains on the 2-D (peers, sp) mesh in
+    every exact-attention variant — both ring layouts (contiguous,
+    zigzag) and the Ulysses a2a strategy — and all must land on the
+    same loss (identical math, different collectives/work
+    distribution)."""
     from dpwa_tpu.utils.launch import child_process_env
 
     env = child_process_env(REPO)
     env["JAX_PLATFORMS"] = "cpu"
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
     finals = {}
-    for layout in ("contiguous", "zigzag"):
+    # Three exact-attention variants: both ring layouts and the Ulysses
+    # a2a strategy must land on the same loss (identical math, different
+    # collectives/work distribution).
+    variants = {
+        "contiguous": [],
+        "zigzag": ["--sp-layout", "zigzag"],
+        "a2a": ["--sp-strategy", "a2a"],
+    }
+    for variant, extra in variants.items():
         cmd = [
             sys.executable,
             os.path.join(REPO, "examples", "longcontext", "main.py"),
@@ -93,7 +103,7 @@ def test_longcontext_example_both_layouts():
             "--n-layers", "2",
             "--d-model", "64",
             "--log-every", "100",
-            "--sp-layout", layout,
+            *extra,
         ]
         proc = subprocess.run(
             cmd, capture_output=True, text=True, timeout=420, env=env,
@@ -102,5 +112,6 @@ def test_longcontext_example_both_layouts():
         assert proc.returncode == 0, proc.stdout + proc.stderr
         m = re.search(r"final mean loss ([0-9.]+)", proc.stdout)
         assert m, proc.stdout
-        finals[layout] = float(m.group(1))
+        finals[variant] = float(m.group(1))
     assert abs(finals["contiguous"] - finals["zigzag"]) < 2e-3, finals
+    assert abs(finals["contiguous"] - finals["a2a"]) < 2e-3, finals

@@ -93,11 +93,15 @@ def test_llama3_8b_config_real_dims():
     assert cfg.kv_heads == 8 and cfg.d_ff == 14336
 
 
-def test_lora_subset_gossip_leaves_base_untouched():
+@pytest.mark.parametrize("wire", ["f32", "int8"])
+def test_lora_subset_gossip_leaves_base_untouched(wire):
+    """Base weights stay bit-identical under subset gossip — including
+    under the int8 stochastic-rounding wire, which must quantize ONLY
+    the exchanged (LoRA) leaves."""
     n = 4
     cfg = tiny_cfg()
     model = Llama(cfg)
-    dcfg = make_local_config(n, schedule="ring")
+    dcfg = make_local_config(n, schedule="ring", wire_dtype=wire)
     transport = IciTransport(dcfg, mesh=make_mesh(dcfg, jax.devices()[:n]))
 
     tokens0 = jnp.zeros((1, 8), jnp.int32)
