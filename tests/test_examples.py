@@ -86,11 +86,8 @@ def test_longcontext_example_exact_variants():
     env["JAX_PLATFORMS"] = "cpu"
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
     finals = {}
-    # Three exact-attention variants: both ring layouts and the Ulysses
-    # a2a strategy must land on the same loss (identical math, different
-    # collectives/work distribution).
     variants = {
-        "contiguous": [],
+        "contiguous": ["--sp-layout", "contiguous", "--sp-strategy", "ring"],
         "zigzag": ["--sp-layout", "zigzag"],
         "a2a": ["--sp-strategy", "a2a"],
     }

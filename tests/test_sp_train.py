@@ -51,9 +51,15 @@ def _init_params():
     return stack_params(p0, N_PEERS)
 
 
-def test_sp_matches_unsharded_training():
+@pytest.mark.parametrize("wire", ["f32", "int8"])
+def test_sp_matches_unsharded_training(wire):
+    """2-D (peers x sp) trajectory equals the 1-D twin — including under
+    the int8 stochastic-rounding wire: the exchange keys off the PEERS
+    axis index only, so every sp-replicated copy of a leaf quantizes
+    identically (a global-device-index key would silently desynchronize
+    the sp replicas)."""
     inputs, targets = _data()
-    cfg = make_local_config(N_PEERS, schedule="ring")
+    cfg = make_local_config(N_PEERS, schedule="ring", wire_dtype=wire)
     opt = optax.sgd(0.1, momentum=0.9)
     stacked = _init_params()
 
