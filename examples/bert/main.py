@@ -50,7 +50,10 @@ def main() -> None:
         group_size=args.group_size,
         inter_period=args.inter_period,
     )
-    bundle = build_transport(cfg, args.transport, args.devices)
+    bundle = build_transport(
+        cfg, args.transport, args.devices, wire_dtype=args.wire_dtype
+    )
+    cfg = bundle.config  # effective config (wire_dtype applied)
     transport = bundle.transport
 
     import jax

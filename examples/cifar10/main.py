@@ -97,7 +97,10 @@ def main() -> None:
         else os.path.join(here, args.config)
     )
     cfg = load_config(cfg_path)
-    bundle = build_transport(cfg, args.transport, args.devices)
+    bundle = build_transport(
+        cfg, args.transport, args.devices, wire_dtype=args.wire_dtype
+    )
+    cfg = bundle.config  # effective config (wire_dtype applied)
 
     import jax
     import jax.numpy as jnp

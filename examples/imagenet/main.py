@@ -55,7 +55,10 @@ def main() -> None:
     else:
         # Programmatic equivalent of a 32-node YAML (same schema).
         cfg = make_local_config(args.peers, schedule="random", pool_size=32)
-    bundle = build_transport(cfg, args.transport, args.devices)
+    bundle = build_transport(
+        cfg, args.transport, args.devices, wire_dtype=args.wire_dtype
+    )
+    cfg = bundle.config  # effective config (wire_dtype applied)
     transport = bundle.transport
 
     import jax

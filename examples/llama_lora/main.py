@@ -44,7 +44,10 @@ def main() -> None:
     from dpwa_tpu.config import make_local_config
 
     cfg = make_local_config(args.peers, schedule="random", pool_size=16)
-    bundle = build_transport(cfg, args.transport, args.devices)
+    bundle = build_transport(
+        cfg, args.transport, args.devices, wire_dtype=args.wire_dtype
+    )
+    cfg = bundle.config  # effective config (wire_dtype applied)
     transport = bundle.transport
 
     import jax
