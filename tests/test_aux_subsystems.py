@@ -59,13 +59,9 @@ def test_dropped_peer_keeps_training():
     np.testing.assert_array_equal(np.asarray(out["w"]), np.asarray(params["w"]))
 
 
-def test_checkpoint_roundtrip(tmp_path):
-    from dpwa_tpu.checkpoint import restore_checkpoint, save_checkpoint
-
-    n = 8
-    cfg = make_local_config(n, schedule="ring")
-    transport = IciTransport(cfg, mesh=make_mesh(cfg))
-
+def _mlp_checkpoint_scaffold(n, transport):
+    """Shared scaffold for the checkpoint tests: a tiny MLP gossip state
+    trained 3 steps, plus its loss_fn/step_fn/batch."""
     import flax.linen as nn
 
     class MLP(nn.Module):
@@ -88,6 +84,18 @@ def test_checkpoint_roundtrip(tmp_path):
     batch = (jnp.ones((n, 4, 5)), jnp.zeros((n, 4), jnp.int32))
     for _ in range(3):
         state, _, _ = step_fn(state, batch)
+    return model, opt, loss_fn, step_fn, batch, state
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    from dpwa_tpu.checkpoint import restore_checkpoint, save_checkpoint
+
+    n = 8
+    cfg = make_local_config(n, schedule="ring")
+    transport = IciTransport(cfg, mesh=make_mesh(cfg))
+    model, opt, loss_fn, step_fn, batch, state = _mlp_checkpoint_scaffold(
+        n, transport
+    )
 
     ckpt_dir = str(tmp_path / "ckpt")
     save_checkpoint(ckpt_dir, state)
@@ -379,3 +387,33 @@ def test_metrics_log_exchange(tmp_path):
     (rec,) = [json.loads(l) for l in open(path)]
     assert rec["exchanged_bytes"] == 32 * 4
     assert rec["partner"] == [1, 0, 3, 2]
+
+
+def test_checkpoint_resume_across_wire_dtype_change(tmp_path):
+    """An operator may enable wire compression mid-training: a checkpoint
+    saved under the f32 wire restores into an int8-wire transport (the
+    wire is stateless) and training continues on the same schedule
+    sequence."""
+    from dpwa_tpu.checkpoint import restore_checkpoint, save_checkpoint
+
+    n = 8
+    cfg_f32 = make_local_config(n, schedule="ring")
+    t_f32 = IciTransport(cfg_f32, mesh=make_mesh(cfg_f32))
+    model, opt, loss_fn, step_f32, batch, state = _mlp_checkpoint_scaffold(
+        n, t_f32
+    )
+    ckpt_dir = str(tmp_path / "ckpt")
+    save_checkpoint(ckpt_dir, state)
+
+    cfg_int8 = make_local_config(n, schedule="ring", wire_dtype="int8")
+    t_int8 = IciTransport(cfg_int8, mesh=make_mesh(cfg_int8))
+    restored = restore_checkpoint(ckpt_dir, like=state)
+    step_int8 = make_gossip_train_step(loss_fn, opt, t_int8)
+    s2, losses, i2 = step_int8(restored, batch)
+    # Same schedule position (step 3's partners), training proceeds.
+    _, _, i1 = step_f32(state, batch)
+    np.testing.assert_array_equal(
+        np.asarray(i1.partner), np.asarray(i2.partner)
+    )
+    assert int(s2.step) == 4
+    assert np.isfinite(np.asarray(losses)).all()

@@ -4,6 +4,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
+import pytest
 
 from dpwa_tpu.config import make_local_config
 from dpwa_tpu.models.bert import (
@@ -52,12 +53,18 @@ def test_mlm_corruption():
     assert 0.1 < weights.mean() < 0.5
 
 
-def test_bert_hierarchical_gossip_trains():
+@pytest.mark.parametrize("wire", ["f32", "int8"])
+def test_bert_hierarchical_gossip_trains(wire):
     """8 peers in 2 groups of 4: intra-group ring slots + inter-group slot;
-    MLM loss on a learnable synthetic language decreases."""
+    MLM loss on a learnable synthetic language decreases.  Runs under
+    both the plain and the int8 compressed wire — every slot's pairing
+    invariant (involution + intra/inter group membership) must hold and
+    training must still converge (pins the schedule x wire
+    interaction; the other int8 convergence tests use ring/random)."""
     n = 8
     cfg = make_local_config(
-        n, schedule="hierarchical", group_size=4, inter_period=4
+        n, schedule="hierarchical", group_size=4, inter_period=4,
+        wire_dtype=wire,
     )
     transport = IciTransport(cfg, mesh=make_mesh(cfg))
     # 2 groups -> one tournament round of inter_period slots; the pool
@@ -106,3 +113,4 @@ def test_bert_hierarchical_gossip_trains():
             assert (groups[partner] == groups).all()
     final_losses = np.asarray(losses)
     assert final_losses.mean() < first_losses.mean()
+
