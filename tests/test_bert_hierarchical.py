@@ -113,4 +113,3 @@ def test_bert_hierarchical_gossip_trains(wire):
             assert (groups[partner] == groups).all()
     final_losses = np.asarray(losses)
     assert final_losses.mean() < first_losses.mean()
-
