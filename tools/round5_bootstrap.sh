@@ -1,0 +1,53 @@
+#!/usr/bin/env bash
+# Round-5 first actions (CHANGELOG.md round-4 handoff note, executable).
+#
+# Order matters:
+# 1. Probe the tunnel ONCE, bounded, BEFORE any watcher runs (two jax
+#    clients racing for the tunneled chip can false-negative or wedge
+#    it; `timeout -k` guarantees SIGKILL on a truly wedged import —
+#    see artifacts/chip_tunnel_incident_*).
+# 2. Kill any leftover previous-round watcher, then launch this round's
+#    with --new-round: that flag rotates last round's chip artifacts so
+#    every job re-measures on recovery.  A surviving old watcher (or a
+#    plain launch) would RESUME the previous round's artifacts and
+#    silently promote stale numbers as this round's results.
+# 3. Check the reference mount: empty through rounds 1-4; if populated,
+#    SURVEY.md §0 mandates the fidelity audit as the round's first task.
+set -u
+cd "$(dirname "$0")/.." || exit 1
+
+echo "== 1. bounded tunnel probe (before any watcher) =="
+if timeout -k 10 90 python -c \
+    "import jax; print('platform:', jax.devices()[0].platform)"; then
+  echo "tunnel ALIVE — the watcher will run the chip jobs on first probe"
+else
+  echo "tunnel wedged/dead (expected; the watcher keeps probing)"
+fi
+
+echo "== 2. chip watcher (new round) =="
+# Tight pattern: match the interpreter invocation, not editors/greps.
+if pgrep -f 'python[^ ]* .*experiments/chip_watch\.py' >/dev/null; then
+  echo "killing the previous round's watcher (its resume state would"
+  echo "promote last round's chip numbers as this round's):"
+  pgrep -af 'python[^ ]* .*experiments/chip_watch\.py'
+  pkill -f 'python[^ ]* .*experiments/chip_watch\.py'
+  sleep 2
+fi
+nohup setsid python experiments/chip_watch.py --new-round \
+  --interval 900 --max-hours 13 \
+  >> artifacts/chip_watch_r05_daemon.log 2>&1 < /dev/null &
+sleep 3
+if pgrep -f 'python[^ ]* .*experiments/chip_watch\.py' >/dev/null; then
+  echo "watcher running (log: artifacts/chip_watch_r05_daemon.log)"
+else
+  echo "!! watcher DIED at startup — check artifacts/chip_watch_r05_daemon.log"
+fi
+
+echo "== 3. reference mount =="
+n_ref=$(find /root/reference -type f 2>/dev/null | wc -l)
+echo "/root/reference files: ${n_ref}"
+if [ "${n_ref}" -gt 0 ]; then
+  echo ">>> MOUNT POPULATED: run the SURVEY.md §0 fidelity audit FIRST <<<"
+fi
+
+echo "== 4. suite sanity (optional, ~14 min): python -m pytest tests/ -q =="
