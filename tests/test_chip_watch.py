@@ -22,13 +22,18 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def load_chip_watch():
+def load_module(name, relpath):
+    """Load a repo script (not on the import path) as a module."""
     spec = importlib.util.spec_from_file_location(
-        "chip_watch", os.path.join(REPO, "experiments", "chip_watch.py")
+        name, os.path.join(REPO, relpath)
     )
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
     return mod
+
+
+def load_chip_watch():
+    return load_module("chip_watch", os.path.join("experiments", "chip_watch.py"))
 
 
 def isolate(cw, monkeypatch, tmp_path):
@@ -242,9 +247,36 @@ def test_capture_rejects_replayed_bench_output(monkeypatch, tmp_path):
 def test_static_refresh_names_in_sync():
     """chip_watch's fallback list must track train_steps_refresh.CONFIGS."""
     cw = load_chip_watch()
-    spec = importlib.util.spec_from_file_location(
-        "tsr", os.path.join(REPO, "experiments", "train_steps_refresh.py")
+    tsr = load_module(
+        "tsr", os.path.join("experiments", "train_steps_refresh.py")
     )
-    tsr = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(tsr)
     assert cw._REFRESH_NAMES_STATIC == list(tsr.CONFIGS)
+
+
+def test_bench_capture_freshness_gate():
+    """bench.py's replay gate (driver-critical: it decides whether the
+    round's BENCH json carries a chip number or a CPU fallback): a
+    capture is fresh within CAPTURE_MAX_AGE_H, and stale/garbage/future
+    stamps are rejected."""
+    import datetime
+
+    bench = load_module("bench_mod", "bench.py")
+
+    def stamp(delta):
+        return (
+            datetime.datetime.now(datetime.timezone.utc) + delta
+        ).strftime("%Y-%m-%dT%H:%M:%SZ")
+
+    h = datetime.timedelta(hours=1)
+    assert bench._capture_is_fresh({"captured_at_utc": stamp(-1 * h)})
+    assert bench._capture_is_fresh(
+        {"captured_at_utc": stamp(-(bench.CAPTURE_MAX_AGE_H - 0.1) * h)}
+    )
+    # Older than the window: stale (a previous round's number).
+    assert not bench._capture_is_fresh(
+        {"captured_at_utc": stamp(-(bench.CAPTURE_MAX_AGE_H + 0.1) * h)}
+    )
+    # From the future beyond clock skew, missing, or garbage: rejected.
+    assert not bench._capture_is_fresh({"captured_at_utc": stamp(+1 * h)})
+    assert not bench._capture_is_fresh({})
+    assert not bench._capture_is_fresh({"captured_at_utc": "yesterday"})
