@@ -80,12 +80,43 @@ def loss_weighted(factor: float = 1.0) -> Interpolation:
     return alpha
 
 
+def _clamped(strategy: Interpolation) -> Interpolation:
+    """Restrict α to [0, 1] so the merge is always an interpolation.
+
+    ``loss_weighted`` is unbounded on raw metadata: a negative local loss
+    (continuous-density NLL, reward-style objectives) or ``local ≫ remote``
+    drives α outside [0, 1], silently turning ``(1−α)x + αy`` into
+    extrapolation on every transport.  ``clock_weighted`` is safe only
+    because clocks are nonnegative by construction, and any strategy with
+    ``factor > 1`` can overshoot — so the clamp is applied uniformly here
+    rather than per-strategy.
+
+    A non-finite α (NaN/inf metadata makes the ratio NaN, and
+    ``jnp.clip`` propagates NaN) resolves by which side is sick: if the
+    LOCAL metadata is non-finite and the peer's is healthy, α = 1 —
+    adopting the healthy peer is exactly the rescue gossip offers a
+    diverged replica.  In every other non-finite case α = 0 (keep the
+    local replica, the same keep-training posture as a failed fetch)."""
+
+    def alpha(local: PeerMeta, remote: PeerMeta) -> jnp.ndarray:
+        a = strategy(local, remote)
+        local_ok = jnp.isfinite(local.clock) & jnp.isfinite(local.loss)
+        remote_ok = jnp.isfinite(remote.clock) & jnp.isfinite(remote.loss)
+        rescue = jnp.where(~local_ok & remote_ok, 1.0, 0.0)
+        a = jnp.where(jnp.isfinite(a), a, rescue)
+        return jnp.clip(a, 0.0, 1.0)
+
+    return alpha
+
+
 def make_interpolation(config: InterpolationConfig) -> Interpolation:
-    """Factory from the YAML ``interpolation:`` section."""
+    """Factory from the YAML ``interpolation:`` section.
+
+    Every returned strategy is clamped to α ∈ [0, 1] (see ``_clamped``)."""
     if config.type == "constant":
-        return constant(config.factor)
+        return _clamped(constant(config.factor))
     if config.type == "clock":
-        return clock_weighted(config.factor)
+        return _clamped(clock_weighted(config.factor))
     if config.type == "loss":
-        return loss_weighted(config.factor)
+        return _clamped(loss_weighted(config.factor))
     raise ValueError(f"unknown interpolation type {config.type!r}")

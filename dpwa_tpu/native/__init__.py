@@ -10,8 +10,11 @@ reference-equivalent CPU path — the TPU path's "native layer" is XLA/Pallas.
 from __future__ import annotations
 
 import ctypes
+import hashlib
 import os
+import platform
 import subprocess
+import sys
 import tempfile
 import threading
 from typing import Optional
@@ -23,9 +26,144 @@ _SRCS = [
     os.path.join(os.path.dirname(__file__), "rx_server.cpp"),
 ]
 _LIB = os.path.join(os.path.dirname(__file__), "_libdpwa_native.so")
+_HOSTINFO = _LIB + ".host"
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 _tried = False
+
+
+def _host_fingerprint() -> str:
+    """ISA identity of this machine.
+
+    ``-march=native`` bakes host-specific instructions into the cached
+    .so; a copy carried to a different machine (tar/rsync preserves
+    mtimes, so the source-staleness check never fires) would dlopen
+    cleanly — symbol presence says nothing about ISA — and then SIGILL
+    mid-training.  The cpuinfo flags/Features line IS the capability set
+    on x86/arm, so (arch, flags) pins exactly what -march=native keyed
+    the build on."""
+    parts = [platform.machine()]
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith(("flags", "Features")):
+                    parts.append(line.strip())
+                    break
+    except OSError:
+        pass
+    return hashlib.sha256("|".join(parts).encode()).hexdigest()
+
+
+def _sidecar_content() -> str:
+    """What a valid host record must say: this host's ISA fingerprint
+    tied to the EXACT .so bytes (so a freshly rsync'ed foreign .so can't
+    ride a stale record, wherever the record lives)."""
+    h = hashlib.sha256()
+    try:
+        with open(_LIB, "rb") as f:
+            h.update(f.read())
+    except OSError:
+        return ""
+    return _host_fingerprint() + "|" + h.hexdigest()
+
+
+def _hostinfo_paths() -> list:
+    """Candidate record locations: beside the .so, else the tempdir
+    (read-only installs can't write the package dir; without a fallback
+    every process would re-pay the failed-build + subprocess-smoke
+    sequence at startup, forever)."""
+    key = hashlib.sha256(_LIB.encode()).hexdigest()[:16]
+    return [
+        _HOSTINFO,
+        os.path.join(tempfile.gettempdir(), f"dpwa_native_{key}.host"),
+    ]
+
+
+def _write_hostinfo() -> None:
+    """Record the validated (host, .so) pair at the first writable
+    location (atomic, like the .so install itself); best-effort — if
+    nowhere is writable the next load just re-validates."""
+    content = _sidecar_content()
+    if not content:
+        return
+    for path in _hostinfo_paths():
+        tmp = None
+        try:
+            fd, tmp = tempfile.mkstemp(
+                suffix=".host.tmp", dir=os.path.dirname(path)
+            )
+            with os.fdopen(fd, "w") as f:
+                f.write(content)
+            os.replace(tmp, path)
+            return
+        except OSError:
+            if tmp is not None:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+
+
+def _hostinfo_matches() -> bool:
+    want = _sidecar_content()
+    if not want:
+        return False
+    for path in _hostinfo_paths():
+        try:
+            with open(path) as f:
+                if f.read().strip() == want:
+                    return True
+        except OSError:
+            continue
+    return False
+
+
+def _smoke_ok() -> bool:
+    """Execute the cached .so's hot loops in a THROWAWAY subprocess.
+
+    Last resort for a foreign .so when no toolchain can rebuild it: if
+    the code contains instructions this CPU lacks, the child dies with
+    SIGILL and the caller degrades to numpy instead of crashing the
+    training process."""
+    code = (
+        "import ctypes\n"
+        f"lib = ctypes.CDLL({_LIB!r})\n"
+        "lib.dpwa_checksum.restype = ctypes.c_uint64\n"
+        "lib.dpwa_checksum((ctypes.c_uint8 * 8)(*range(8)),"
+        " ctypes.c_size_t(8))\n"
+        "dst = (ctypes.c_float * 512)()\n"
+        "src = (ctypes.c_float * 512)(*([1.5] * 512))\n"
+        "lib.dpwa_merge_inplace(dst, src, ctypes.c_float(0.5),"
+        " ctypes.c_size_t(512))\n"
+        "if hasattr(lib, 'dpwa_quantize_sr'):\n"
+        "    q = (ctypes.c_int8 * 512)()\n"
+        "    s = (ctypes.c_float * 2)()\n"
+        "    lib.dpwa_quantize_sr(src, ctypes.c_size_t(512),"
+        " ctypes.c_size_t(256), q, s,"
+        " ctypes.c_uint64(1), ctypes.c_uint64(2))\n"
+        # rx_server.cpp is a separate translation unit: its loops can use
+        # ISA the kernel TU happens to avoid, so a pass must cover it too.
+        "if hasattr(lib, 'dpwa_server_create'):\n"
+        "    lib.dpwa_server_create.restype = ctypes.c_void_p\n"
+        "    h = lib.dpwa_server_create(b'127.0.0.1', 0)\n"
+        "    if h:\n"
+        "        lib.dpwa_server_port.argtypes = [ctypes.c_void_p]\n"
+        "        lib.dpwa_server_port(ctypes.c_void_p(h))\n"
+        "        lib.dpwa_server_publish(ctypes.c_void_p(h), b'x' * 64,"
+        " ctypes.c_size_t(64))\n"
+        "        lib.dpwa_server_close(ctypes.c_void_p(h))\n"
+    )
+    try:
+        return (
+            subprocess.run(
+                [sys.executable, "-c", code],
+                capture_output=True,
+                timeout=60,
+            ).returncode
+            == 0
+        )
+    except subprocess.SubprocessError:
+        return False
 
 
 def _build() -> bool:
@@ -61,6 +199,7 @@ def _build() -> bool:
                 if not extra:
                     raise
         os.replace(tmp, _LIB)
+        _write_hostinfo()
         return True
     except (OSError, subprocess.SubprocessError):
         # Covers an unwritable package dir (mkstemp) the same as a failed
@@ -84,6 +223,18 @@ def load() -> Optional[ctypes.CDLL]:
             os.path.getmtime(_LIB) < os.path.getmtime(src) for src in _SRCS
         ):
             if not _build():
+                return None
+        elif not _hostinfo_matches():
+            # Fresh-looking .so but no record it was built on THIS host
+            # (or the record disagrees): likely carried over from another
+            # machine with -march=native ISA baked in.  Rebuild; if no
+            # toolchain, prove executability in a sacrificial subprocess
+            # before trusting it in-process.
+            if _build():
+                pass
+            elif _smoke_ok():
+                _write_hostinfo()
+            else:
                 return None
         try:
             lib = ctypes.CDLL(_LIB)

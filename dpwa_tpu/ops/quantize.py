@@ -128,9 +128,16 @@ def fake_quant_tree(params, seed: int, step, sender):
 
 def _np_key_words(seed: int, clock: float, sender: int) -> Tuple[int, int]:
     """One logical 128-bit key for both host codecs: (seed, sender) in
-    one u64 word, the publish clock in the other."""
+    one u64 word, the publish clock in the other.
+
+    The clock word is the full IEEE-754 bit pattern, not ``int(clock)``:
+    free-running publishers stamp fractional clocks, and truncation would
+    hand e.g. clock 1.0 and 1.5 the same dither stream, breaking the
+    documented per-(seed, clock, sender) uniqueness.  (Decode never
+    derives the key — scales ride the payload — so only stream
+    distinctness is at stake.)"""
     k0 = ((seed ^ _WIRE_SALT) & 0xFFFFFFFF) | ((sender & 0xFFFFFFFF) << 32)
-    k1 = int(clock) & 0xFFFFFFFFFFFFFFFF
+    k1 = int(np.float64(clock).view(np.uint64))
     return k0, k1
 
 

@@ -32,6 +32,7 @@ from __future__ import annotations
 import socket
 import struct
 import threading
+import time
 from typing import Optional, Tuple
 
 import numpy as np
@@ -60,11 +61,44 @@ _DTYPE_CODES = {v: k for k, v in _DTYPES.items()}
 # fetch_blob.  protocol.wire_dtype: int8.
 _INT8_CHUNKED = 4
 _MAX_BLOB = 1 << 34  # 16 GiB sanity bound on advertised payload size
+# Deadline floor for the payload read: once the header advertises nbytes,
+# the fetch budget grows by nbytes at this rate, so a healthy peer
+# streaming a large replica is never killed by a fixed timeout_ms sized
+# for the rendezvous (100 MB at 500 ms would otherwise fail FOREVER,
+# silently disabling gossip), while a trickling peer — orders of
+# magnitude below any real fabric — still gets dropped promptly.
+_MIN_WIRE_BANDWIDTH = 10 * 1024 * 1024  # bytes/s
 
 
-def _recv_exact(sock: socket.socket, n: int) -> bytes:
+def _recv_exact(
+    sock: socket.socket,
+    n: int,
+    deadline: Optional[float] = None,
+    per_byte_s: float = 0.0,
+) -> bytes:
+    """Read exactly ``n`` bytes.
+
+    With ``deadline`` (a ``time.monotonic`` instant) the WHOLE read must
+    finish by that wall-clock point: the socket timeout is re-derived from
+    the remaining budget before every ``recv``.  A plain ``settimeout``
+    restarts on each successful recv, so a peer trickling one byte at a
+    time could pin the caller indefinitely — precisely the slow peer the
+    skip semantics exist for.
+
+    ``per_byte_s`` grows the deadline with bytes ACTUALLY RECEIVED (not
+    the advertised size): a healthy stream earns budget as it flows,
+    while a peer that advertised a huge payload and then stalls is still
+    dropped at the base deadline — trusting the advertisement up front
+    would let a malicious 16 GiB header pin the fetch for minutes."""
     buf = bytearray()
     while len(buf) < n:
+        if deadline is not None:
+            remaining = (
+                deadline + len(buf) * per_byte_s - time.monotonic()
+            )
+            if remaining <= 0:
+                raise socket.timeout("cumulative fetch deadline exceeded")
+            sock.settimeout(remaining)
         chunk = sock.recv(min(1 << 20, n - len(buf)))
         if not chunk:
             raise ConnectionError("peer closed mid-message")
@@ -219,14 +253,25 @@ def fetch_blob(
     """Connect to a peer's Rx thread and pull its latest blob.
 
     Returns None on timeout / refused connection / malformed reply — the
-    caller skips the merge and keeps training, like the reference."""
+    caller skips the merge and keeps training, like the reference.
+
+    ``timeout_ms`` is a CUMULATIVE wall-clock budget enforced via a
+    monotonic deadline threaded through :func:`_recv_exact` — not a
+    per-recv timer a trickling peer could keep resetting.  It covers
+    connect + request + header outright; the payload read then earns
+    ``1 / _MIN_WIRE_BANDWIDTH`` extra seconds per byte received, so the
+    budget scales with the replica actually flowing instead of rejecting
+    every blob larger than bandwidth × timeout_ms — and a peer that
+    merely ADVERTISES a huge payload earns nothing."""
+    deadline = time.monotonic() + timeout_ms / 1000.0
     try:
         with socket.create_connection(
             (host, port), timeout=timeout_ms / 1000.0
         ) as sock:
-            sock.settimeout(timeout_ms / 1000.0)
+            # create_connection leaves the connect timeout on the socket,
+            # bounding sendall; both recv loops run against the deadline.
             sock.sendall(_REQ)
-            raw = _recv_exact(sock, _HDR.size)
+            raw = _recv_exact(sock, _HDR.size, deadline)
             magic, version, code, clock, loss, nbytes = _HDR.unpack(raw)
             if magic != _MAGIC or version != 1 or (
                 code not in _DTYPES and code != _INT8_CHUNKED
@@ -234,7 +279,9 @@ def fetch_blob(
                 return None
             if nbytes > _MAX_BLOB:
                 return None
-            data = _recv_exact(sock, nbytes)
+            data = _recv_exact(
+                sock, nbytes, deadline, 1.0 / _MIN_WIRE_BANDWIDTH
+            )
             if code == _INT8_CHUNKED:
                 # Receiver-side dequantize: the wire moved 1 byte/elem
                 # (+ scales); the merge math runs on the f32 decode.
@@ -282,9 +329,16 @@ class _OverlappedExchange:
     round (self-pair, masked, fetch timeout) returns
     ``pre_vec + update`` with α = 0."""
 
-    def __init__(self, transport: "TcpTransport", clock, loss, step):
+    def __init__(
+        self, transport: "TcpTransport", clock, loss, step,
+        expected_nbytes: int = 0,
+    ):
         self._t = transport
         self._clock, self._loss = clock, loss
+        # Gossip replicas are symmetric: the partner's payload is the
+        # same size as what we just published.  Sizes the join backstop
+        # the same way fetch_blob's deadline scales.
+        self._expected_nbytes = expected_nbytes
         self.partner = transport.schedule.partner(step, transport.me)
         self._participates = (
             self.partner != transport.me
@@ -307,12 +361,16 @@ class _OverlappedExchange:
         self, pre_vec: np.ndarray, update: Optional[np.ndarray] = None
     ) -> Tuple[np.ndarray, float, int]:
         if self._thread is not None:
-            # The fetch itself is bounded by the transport's timeout_ms;
-            # the join timeout is a backstop against a pathological
-            # socket state, after which the round is skipped like any
-            # other failed fetch.
+            # The fetch itself is bounded by timeout_ms plus the
+            # per-byte-received extension (fetch_blob's scaled deadline);
+            # the join backstop must allow the same worst case — a fixed
+            # 2.5 s join would abandon large-replica fetches the deadline
+            # deliberately tolerates, silently skipping every merge.  A
+            # timed-out join skips the round like any other failed fetch.
             self._thread.join(
-                timeout=2.0 + self._t.config.protocol.timeout_ms / 1000.0
+                timeout=2.0
+                + self._t.config.protocol.timeout_ms / 1000.0
+                + self._expected_nbytes / _MIN_WIRE_BANDWIDTH
             )
         got = self._got if self._thread is not None else None
         if got is None:
@@ -468,7 +526,9 @@ class TcpTransport:
         exact ``overlap=True`` algebra of
         :func:`dpwa_tpu.train.make_gossip_train_step`."""
         self.publish(vec, clock, loss)
-        ex = _OverlappedExchange(self, clock, loss, step)
+        ex = _OverlappedExchange(
+            self, clock, loss, step, expected_nbytes=int(vec.nbytes)
+        )
         ex.start()
         return ex
 

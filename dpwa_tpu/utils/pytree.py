@@ -119,24 +119,33 @@ def tree_wire_bytes(tree: PyTree, wire_dtype: str = "f32") -> int:
     """Per-exchange bytes actually SHIPPED at a wire format.
 
     ``protocol.wire_dtype`` compresses only f32 leaves (bf16: 2 bytes/
-    element; int8: 1 byte/element + one f32 scale per
-    :data:`dpwa_tpu.ops.quantize.CHUNK` elements); other dtypes ship
-    as-is.  This is the number ``exchanged_bytes`` metrics should report
-    under a compressed wire — ``tree_size_bytes`` is the uncompressed
-    replica size."""
+    element; int8: 1 byte per element PADDED to whole
+    :data:`dpwa_tpu.ops.quantize.CHUNK`-element chunks — the ICI
+    collective ships the padded code block — plus one f32 scale per
+    chunk); other dtypes ship as-is.  This is the number
+    ``exchanged_bytes`` metrics should report under a compressed wire —
+    ``tree_size_bytes`` is the uncompressed replica size.
+
+    The int8 figure is exact for the ICI collective, which quantizes and
+    ships each leaf's padded code block.  The TCP transport instead
+    quantizes the FLATTENED replica — one stream of 8-byte length +
+    4 bytes/chunk scales + UNPADDED codes, inside a 30-byte frame — so
+    for trees with many small f32 leaves this per-leaf figure overstates
+    TCP traffic (up to CHUNK−1 padding bytes per leaf, and one whole
+    chunk for a zero-size leaf) and omits the fixed framing."""
     if wire_dtype not in ("f32", "bf16", "int8"):
         raise ValueError(f"unknown wire_dtype {wire_dtype!r}")
     if wire_dtype == "f32":
         return tree_size_bytes(tree)
-    from dpwa_tpu.ops.quantize import _n_chunks
+    from dpwa_tpu.ops.quantize import CHUNK, _n_chunks
 
     total = 0
     for leaf in jax.tree_util.tree_leaves(tree):
         if leaf.dtype == jnp.float32:
             if wire_dtype == "bf16":
                 total += leaf.size * 2
-            else:  # int8
-                total += leaf.size + 4 * _n_chunks(leaf.size)
+            else:  # int8: padded codes + scales, as the collective ships
+                total += (CHUNK + 4) * _n_chunks(leaf.size)
         else:
             total += leaf.size * leaf.dtype.itemsize
     return total

@@ -95,6 +95,21 @@ def test_clock_weighted_fresh_peer_takes_everything():
     np.testing.assert_allclose(np.asarray(merged["w"][1]), np.ones(4))
 
 
+def test_negative_loss_alpha_clamped_on_ici():
+    # A negative local loss must not push α outside [0, 1] (the raw
+    # loss-weighted ratio explodes when the loss sum crosses zero); the
+    # merged params must stay inside the convex hull of the two peers.
+    n = 2
+    t, _ = make_transport(n, schedule="ring", interpolation="loss")
+    params = {"w": jnp.stack([jnp.zeros(4), jnp.ones(4)])}
+    meta = stacked_meta(n, losses=[-5.0, 1.0])
+    merged, info = t.exchange(params, meta, step=0)
+    alpha = np.asarray(info.alpha)
+    assert np.all(alpha >= 0.0) and np.all(alpha <= 1.0)
+    w = np.asarray(merged["w"])
+    assert np.all(w >= -1e-6) and np.all(w <= 1.0 + 1e-6)
+
+
 def test_participation_masking_zeroes_alpha():
     n = 8
     t, _ = make_transport(n, schedule="ring", fetch_probability=0.4, seed=7)

@@ -62,3 +62,49 @@ def test_factory(kind, expected):
     f = make_interpolation(InterpolationConfig(type=kind, factor=0.3))
     a = float(f(meta(5, 1.0), meta(5, 1.0)))
     assert a == pytest.approx(expected, rel=1e-5)
+
+
+@pytest.mark.parametrize(
+    "local_loss,remote_loss",
+    [
+        (-2.0, 1.0),    # negative local (density NLL / reward objective)
+        (2.0, -1.0),    # negative remote: raw ratio = 2/1 = 2
+        (-1.0, -1.0),   # both negative: denominator clamps to eps
+        (1e9, 1e-9),    # local >> remote
+        (-1e9, 1e-9),   # raw ratio hugely negative
+    ],
+)
+def test_factory_clamps_loss_weighted_alpha(local_loss, remote_loss):
+    # Raw loss_weighted is unbounded on these metas; the factory-level
+    # clamp must keep every merge a true interpolation (α ∈ [0, 1]).
+    f = make_interpolation(InterpolationConfig(type="loss", factor=1.0))
+    a = float(f(meta(3, local_loss), meta(7, remote_loss)))
+    assert 0.0 <= a <= 1.0
+    # The unwrapped strategy really would have escaped [0, 1] for the
+    # non-symmetric cases — i.e. the clamp is load-bearing, not vacuous.
+    raw = float(loss_weighted(1.0)(meta(3, local_loss), meta(7, remote_loss)))
+    if not 0.0 <= raw <= 1.0:
+        assert a in (0.0, 1.0)
+
+
+@pytest.mark.parametrize(
+    "local_loss,remote_loss,expected",
+    [
+        # Local diverged, peer healthy: adopt the peer — gossip's rescue.
+        (float("nan"), 1.0, 1.0),
+        (float("inf"), 1.0, 1.0),
+        (float("-inf"), 1.0, 1.0),
+        # Peer diverged (or both): keep the local replica untouched.
+        (1.0, float("nan"), 0.0),
+        (float("inf"), float("inf"), 0.0),  # inf/inf ratio is NaN
+    ],
+)
+def test_factory_resolves_nonfinite_alpha_by_sick_side(
+    local_loss, remote_loss, expected
+):
+    # NaN/inf loss metadata must never poison the merge (jnp.clip
+    # propagates NaN into (1-α)x+αy): non-finite α resolves to adopting
+    # the healthy peer iff the LOCAL side is the diverged one.
+    f = make_interpolation(InterpolationConfig(type="loss", factor=1.0))
+    a = float(f(meta(3, local_loss), meta(7, remote_loss)))
+    assert np.isfinite(a) and a == expected

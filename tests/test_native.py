@@ -13,6 +13,42 @@ def test_library_builds_and_loads():
     assert lib is not None
 
 
+def _reset_load_state():
+    native._lib = None
+    native._tried = False
+
+
+def test_foreign_so_fingerprint_triggers_revalidation():
+    """A cached .so with no/mismatched build-host record (the tar/rsync
+    scenario: preserved mtimes defeat the staleness check, and symbol
+    presence says nothing about -march=native ISA) must be rebuilt or
+    smoke-proven before being trusted in-process."""
+    import os
+
+    assert native.load() is not None  # ensure a .so + sidecar exist
+    for path in native._hostinfo_paths():
+        if os.path.exists(path):
+            os.unlink(path)  # incl. any tempdir fallback record
+    with open(native._HOSTINFO, "w") as f:
+        f.write("fingerprint-of-some-other-machine")
+    _reset_load_state()
+    try:
+        lib = native.load()
+        assert lib is not None  # rebuilt (g++ present) or smoke-passed
+        with open(native._HOSTINFO) as f:
+            assert f.read().strip() == native._sidecar_content()
+    finally:
+        _reset_load_state()
+        native.load()
+
+
+def test_smoke_subprocess_accepts_native_build():
+    # The sacrificial-subprocess prober must pass on a .so built here —
+    # it is the no-toolchain fallback's only admission gate.
+    assert native.load() is not None
+    assert native._smoke_ok()
+
+
 def test_merge_out_matches_numpy():
     rng = np.random.default_rng(0)
     a = rng.standard_normal(10_001).astype(np.float32)

@@ -60,8 +60,9 @@ def test_tree_size_bytes():
 
 def test_tree_wire_bytes_per_format():
     """exchanged_bytes must reflect the wire format: bf16 halves f32
-    leaves, int8 ships 1 byte/elem + one f32 scale per 256-chunk;
-    non-f32 leaves ship as-is under every format."""
+    leaves, int8 ships its CHUNK-padded code block (what the ICI
+    collective moves) + one f32 scale per 256-chunk; non-f32 leaves
+    ship as-is under every format."""
     import numpy as np
 
     from dpwa_tpu.utils.pytree import tree_size_bytes, tree_wire_bytes
@@ -73,8 +74,9 @@ def test_tree_wire_bytes_per_format():
     f32 = tree_wire_bytes(tree, "f32")
     assert f32 == tree_size_bytes(tree) == 4000 + 40
     assert tree_wire_bytes(tree, "bf16") == 2000 + 40
-    # 1000 elems -> 4 chunks of 256 -> 16 scale bytes
-    assert tree_wire_bytes(tree, "int8") == 1000 + 16 + 40
+    # 1000 elems -> 4 chunks of 256 -> 1024 padded code bytes + 16 scale
+    # bytes (the collective ships the padding; TCP framing not counted).
+    assert tree_wire_bytes(tree, "int8") == 1024 + 16 + 40
     with pytest.raises(ValueError):
         tree_wire_bytes(tree, "fp4")
     # Unknown formats are rejected even when no f32 leaf would reach the

@@ -242,6 +242,124 @@ def test_clock_weighted_over_tcp():
         close_all(ts)
 
 
+def test_fetch_abandons_trickling_peer_within_budget():
+    """Slow-loris guard: a peer dribbling bytes must not pin the fetcher
+    past the cumulative timeout_ms budget.  Per-recv timeouts alone reset
+    on every received byte; fetch_blob enforces a monotonic deadline
+    across the whole header+payload read."""
+    import socket as socket_mod
+    import time
+
+    from dpwa_tpu.parallel.tcp import _frame
+
+    srv = socket_mod.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    port = srv.getsockname()[1]
+    stop = threading.Event()
+
+    def loris():
+        try:
+            conn, _ = srv.accept()
+        except OSError:
+            return
+        try:
+            conn.recv(64)  # the DPWA? request
+            frame = _frame(np.arange(4096, dtype=np.float32), 1.0, 0.5)
+            # One byte every 50 ms: finishing would take ~14 min; the
+            # old per-recv timeout would happily wait it out.
+            for i in range(len(frame)):
+                if stop.is_set():
+                    break
+                conn.sendall(frame[i : i + 1])
+                time.sleep(0.05)
+        except OSError:
+            pass
+        finally:
+            conn.close()
+
+    th = threading.Thread(target=loris, daemon=True)
+    th.start()
+    try:
+        t0 = time.monotonic()
+        got = fetch_blob("127.0.0.1", port, timeout_ms=500)
+        elapsed = time.monotonic() - t0
+        assert got is None
+        # Abandoned inside ~2× timeout_ms (0.5 s slack for scheduling).
+        assert elapsed < 1.5, f"fetch pinned for {elapsed:.2f}s"
+    finally:
+        stop.set()
+        srv.close()
+        th.join(timeout=2.0)
+
+
+def test_fetch_tolerates_large_payload_slower_than_base_budget():
+    """The deadline must SCALE with the advertised payload: a healthy
+    peer streaming a large replica over longer than timeout_ms (but far
+    above the _MIN_WIRE_BANDWIDTH floor) is a working exchange, not a
+    slow peer — a fixed whole-fetch budget would reject every blob
+    larger than bandwidth × timeout_ms forever."""
+    import socket as socket_mod
+    import time
+
+    from dpwa_tpu.parallel.tcp import _frame
+
+    srv = socket_mod.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    port = srv.getsockname()[1]
+    vec = np.arange(4 << 20, dtype=np.float32)  # 16 MB payload
+
+    def server():
+        try:
+            conn, _ = srv.accept()
+        except OSError:
+            return
+        try:
+            conn.recv(64)
+            frame = _frame(vec, 3.0, 0.25)
+            # ~13 MB/s: total ~1.2 s > timeout_ms, rate > the 10 MB/s floor.
+            step = 2 << 20
+            for off in range(0, len(frame), step):
+                conn.sendall(frame[off : off + step])
+                time.sleep(0.15)
+        except OSError:
+            pass
+        finally:
+            conn.close()
+
+    th = threading.Thread(target=server, daemon=True)
+    th.start()
+    try:
+        got = fetch_blob("127.0.0.1", port, timeout_ms=500)
+        assert got is not None
+        fetched, clock, loss = got
+        np.testing.assert_array_equal(fetched, vec)
+        assert (clock, loss) == (3.0, 0.25)
+    finally:
+        srv.close()
+        th.join(timeout=5.0)
+
+
+def test_negative_loss_alpha_clamped_over_tcp():
+    # Same clamp contract as the ICI path: a negative loss riding the
+    # wire metadata must never turn the host merge into extrapolation.
+    ts = make_ring(2, interpolation="loss")
+    try:
+        v0 = np.zeros(8, np.float32)
+        v1 = np.ones(8, np.float32)
+        ts[0].publish(v0, 1, -5.0)
+        ts[1].publish(v1, 1, 1.0)
+        m0, a0, _ = ts[0].exchange(v0, 1, -5.0, step=0)
+        m1, a1, _ = ts[1].exchange(v1, 1, 1.0, step=0)
+        for a in (a0, a1):
+            assert 0.0 <= a <= 1.0
+        for m in (m0, m1):
+            assert np.all(m >= 0.0) and np.all(m <= 1.0)
+    finally:
+        close_all(ts)
+
+
 def test_exchange_on_device_matches_host_exchange():
     """VERDICT r3 #6: the device-resident exchange keeps the replica a JAX
     array, merges on-device, and produces the same numbers as the host

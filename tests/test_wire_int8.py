@@ -110,6 +110,20 @@ def test_quantize_unbiased():
     assert (np.abs(mean - np.asarray(v)) <= tol).all()
 
 
+@pytest.mark.parametrize("impl", ["numpy", "auto"])
+def test_fractional_clocks_get_distinct_dither_streams(impl):
+    # Free-running publishers stamp fractional clocks; the key must fold
+    # the full float bits (int(clock) would alias 1.0 and 1.5 onto one
+    # dither stream).  Determinism per exact (seed, clock, sender) stays.
+    rng = np.random.default_rng(0)
+    v = rng.normal(size=4096).astype(np.float32)
+    q_a, _ = qz.quantize_np(v, 0, 1.0, 0, impl=impl)
+    q_a2, _ = qz.quantize_np(v, 0, 1.0, 0, impl=impl)
+    q_b, _ = qz.quantize_np(v, 0, 1.5, 0, impl=impl)
+    np.testing.assert_array_equal(q_a, q_a2)
+    assert not np.array_equal(q_a, q_b)
+
+
 def test_quantize_edge_cases():
     # All-zero chunks decode to exact zeros; lengths that are not chunk
     # multiples round-trip at the right length; extreme magnitudes hold
