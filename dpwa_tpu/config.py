@@ -20,7 +20,8 @@ Schema::
       fetch_probability: 1.0    # per-step chance a pair actually exchanges
       timeout_ms: 500           # TCP transport only: fetch timeout
       seed: 0                   # schedule / participation RNG seed
-      pool_size: 16             # random schedule: # static pairings compiled
+      pool_size: null           # random schedule: # static pairings compiled
+                                #   (default auto = clamp(2n, 16, 128))
       group_size: 0             # hierarchical: peers per host group (0 = auto)
       inter_period: 4           # hierarchical: cross-group exchange cadence
       drop_probability: 0.0     # fault injection: drop pairs at this rate
@@ -54,7 +55,15 @@ class ProtocolConfig:
     fetch_probability: float = 1.0
     timeout_ms: int = 500
     seed: int = 0
-    pool_size: int = 16
+    # Random schedule: number of static matchings compiled into the
+    # lax.switch pool.  None = auto-scale with the peer count,
+    # clamp(2n, 16, 128): artifacts/pool_truncation.json shows mixing
+    # time reaches the fresh-draw rate by K=16 but pair COVERAGE at
+    # n=64/K=16 is only 23 % (3/4 of pairs could never meet), while the
+    # switch's compile cost stays flat to K=128.  Explicit values are
+    # honored unchanged (the TCP/host path pays no compile cost and can
+    # go higher freely).
+    pool_size: int | None = None
     group_size: int = 0
     inter_period: int = 4
     drop_probability: float = 0.0  # fault injection: drop pairs at this rate
@@ -83,6 +92,17 @@ class ProtocolConfig:
             raise ValueError(f"unknown protocol mode {self.mode!r}")
         if self.wire_dtype not in ("f32", "bf16", "int8"):
             raise ValueError(f"unknown wire_dtype {self.wire_dtype!r}")
+        if self.pool_size is not None and self.pool_size < 1:
+            raise ValueError(
+                f"pool_size must be >= 1 (or null for auto), "
+                f"got {self.pool_size}"
+            )
+
+    def resolved_pool_size(self, n_peers: int) -> int:
+        """The random-schedule pool size in effect for ``n_peers``."""
+        if self.pool_size is not None:
+            return self.pool_size
+        return max(16, min(128, 2 * n_peers))
 
 
 @dataclasses.dataclass(frozen=True)

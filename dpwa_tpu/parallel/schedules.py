@@ -413,7 +413,8 @@ def build_schedule(config: DpwaConfig) -> Schedule:
         elif proto.schedule == "random":
             rng = np.random.default_rng(proto.seed)
             pool = np.stack(
-                [_random_pull(n, rng) for _ in range(max(1, proto.pool_size))]
+                [_random_pull(n, rng)
+                 for _ in range(proto.resolved_pool_size(n))]
             )
         elif proto.schedule == "hierarchical":
             group = proto.group_size or _auto_group_size(n)
@@ -430,7 +431,8 @@ def build_schedule(config: DpwaConfig) -> Schedule:
     elif proto.schedule == "random":
         rng = np.random.default_rng(proto.seed)
         pool = np.stack(
-            [_random_matching(n, rng) for _ in range(max(1, proto.pool_size))]
+            [_random_matching(n, rng)
+             for _ in range(proto.resolved_pool_size(n))]
         )
     elif proto.schedule == "hierarchical":
         group = proto.group_size or _auto_group_size(n)

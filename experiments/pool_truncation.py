@@ -2,8 +2,9 @@
 """Quantify random-schedule pool truncation vs fresh uniform matchings.
 
 `lax.ppermute` needs static permutations, so the `random` schedule
-compiles a POOL of matchings (config `pool_size`, default 16) and draws
-an i.i.d. pool index per step (`pool_branch_draw`).  The reference draws
+compiles a POOL of matchings (config `pool_size`; this study motivated
+changing the default from the historical 16 to auto = clamp(2n, 16,
+128)) and draws an i.i.d. pool index per step (`pool_branch_draw`).  The reference draws
 a FRESH matching every step [R] — statistically wider: at n=8 there are
 105 perfect matchings, at n=64 astronomically many, and a pool carries
 its K forever.  This study measures what that truncation actually costs,

@@ -86,3 +86,32 @@ def test_make_local_config():
     assert cfg.n_peers == 4
     assert cfg.nodes[3].port == 45003
     assert cfg.interpolation.factor == 0.25
+
+
+def test_pool_size_auto_scales_with_peers():
+    # Default (null) = clamp(2n, 16, 128): pool_truncation.json shows
+    # K=16 truncates pair coverage badly at n>=32 while the switch's
+    # compile cost is flat to K=128.  Explicit values are honored.
+    proto = make_local_config(8).protocol
+    assert proto.pool_size is None
+    assert proto.resolved_pool_size(8) == 16
+    assert proto.resolved_pool_size(32) == 64
+    assert proto.resolved_pool_size(64) == 128
+    assert proto.resolved_pool_size(200) == 128  # cap
+    explicit = make_local_config(64, pool_size=4).protocol
+    assert explicit.resolved_pool_size(64) == 4
+    with pytest.raises(ValueError):
+        make_local_config(4, pool_size=0)
+
+
+def test_random_schedule_pool_follows_auto_default():
+    from dpwa_tpu.parallel.schedules import build_schedule
+
+    sched8 = build_schedule(make_local_config(8, schedule="random"))
+    assert sched8.pool_size == 16
+    sched32 = build_schedule(make_local_config(32, schedule="random"))
+    assert sched32.pool_size == 64
+    pull64 = build_schedule(
+        make_local_config(64, schedule="random", mode="pull")
+    )
+    assert pull64.pool_size == 128
