@@ -59,11 +59,27 @@ STEPS = 400
 BATCH = 16
 
 
-def run_layout(name: str) -> dict:
-    import numpy as np
+def train_digits_gossip(
+    n: int,
+    schedule: str,
+    schedule_kwargs: dict,
+    *,
+    steps: int = STEPS,
+    batch: int = BATCH,
+    fetch_probability: float = 0.5,
+    seed: int = 0,
+):
+    """The shared spec-scale training substrate: real n-peer ICI gossip
+    on the emulated CPU mesh, SmallNet on offline digits with per-peer
+    disjoint shards.
 
-    spec = LAYOUTS[name]
-    n = spec["n"]
+    One definition used by BOTH `spec_scale_train.py` (layout/topology
+    witnesses) and `pool_convergence.py` (pool-size sweep), so the two
+    experiments can never silently measure different substrates.
+    ``seed`` keys the schedule/participation RNG, the param init, and
+    the batch stream together.  Returns (per-replica accuracies,
+    consensus-model accuracy)."""
+    import numpy as np
 
     from dpwa_tpu.utils.devices import repoint_to_host_mesh
 
@@ -86,37 +102,48 @@ def run_layout(name: str) -> dict:
     )
 
     cfg = make_local_config(
-        n, schedule=spec["schedule"], fetch_probability=0.5, **spec["kwargs"]
+        n, schedule=schedule, fetch_probability=fetch_probability,
+        seed=seed, **schedule_kwargs,
     )
     transport = IciTransport(cfg, mesh=make_mesh(cfg))
     x_tr, y_tr, x_te, y_te = load_digits_dataset()
     model = SmallNet()
-    params0 = model.init(jax.random.key(0), jnp.zeros((1, 8, 8, 1)))
+    params0 = model.init(jax.random.key(seed), jnp.zeros((1, 8, 8, 1)))
     opt = optax.sgd(0.05, momentum=0.9)
     state = init_gossip_state(stack_params(params0, n), opt, transport)
 
-    def loss_fn(params, batch):
-        x, y = batch
+    def loss_fn(params, batch_):
+        x, y = batch_
         return optax.softmax_cross_entropy_with_integer_labels(
             model.apply(params, x), y
         ).mean()
 
     step_fn = make_gossip_train_step(loss_fn, opt, transport)
     sh = peer_sharding(transport.mesh)
-    batches = peer_batches(x_tr, y_tr, n, BATCH, seed=0)
-    for step in range(STEPS):
+    batches = peer_batches(x_tr, y_tr, n, batch, seed=seed)
+    for _ in range(steps):
         bx, by = next(batches)
-        state, losses, info = step_fn(
+        state, _, _ = step_fn(
             state, (jax.device_put(bx, sh), jax.device_put(by, sh))
         )
     eval_fn = make_gossip_eval_fn(model.apply, transport)
-    accs = np.asarray(eval_fn(state.params, jnp.asarray(x_te), jnp.asarray(y_te)))
+    accs = np.asarray(
+        eval_fn(state.params, jnp.asarray(x_te), jnp.asarray(y_te))
+    )
     cons = consensus_params(state.params)
     cons_logits = model.apply(cons, jnp.asarray(x_te))
     cons_acc = float(np.mean(np.argmax(np.asarray(cons_logits), -1) == y_te))
+    return accs, cons_acc
+
+
+def run_layout(name: str) -> dict:
+    spec = LAYOUTS[name]
+    accs, cons_acc = train_digits_gossip(
+        spec["n"], spec["schedule"], spec["kwargs"]
+    )
     return {
         "layout": name,
-        "n_peers": n,
+        "n_peers": spec["n"],
         "schedule": spec["schedule"],
         **spec["kwargs"],
         "steps": STEPS,

@@ -341,6 +341,59 @@ def test_fetch_tolerates_large_payload_slower_than_base_budget():
         th.join(timeout=5.0)
 
 
+def test_overlapped_join_waits_for_scaled_large_payload():
+    """The overlapped path's join backstop must scale with the published
+    replica size the way fetch_blob's deadline does — a fixed ~2.5 s
+    join would abandon (alpha=0) large-replica fetches the deadline
+    deliberately tolerates, silently disabling gossip."""
+    import socket as socket_mod
+    import time
+
+    from dpwa_tpu.parallel.tcp import _frame
+
+    ts = make_ring(2, timeout_ms=500)
+    srv = socket_mod.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    vec = np.arange(8 << 20, dtype=np.float32)  # 32 MiB replica
+
+    def slow_peer():
+        try:
+            conn, _ = srv.accept()
+        except OSError:
+            return
+        try:
+            conn.recv(64)
+            frame = _frame(vec, 5.0, 0.5)
+            # 16 chunks, last landing at ~2.7 s (> the old fixed 2.5 s
+            # join, so a regression to it WOULD fail this test) at
+            # ~12 MB/s — above the 10 MB/s floor, inside the scaled
+            # budget of 0.5 + 32/10 ≈ 3.7 s.
+            step = 2 << 20
+            for off in range(0, len(frame), step):
+                conn.sendall(frame[off : off + step])
+                if off + step < len(frame):
+                    time.sleep(0.18)
+        except OSError:
+            pass
+        finally:
+            conn.close()
+
+    th = threading.Thread(target=slow_peer, daemon=True)
+    th.start()
+    try:
+        ts[0].set_peer_port(1, srv.getsockname()[1])
+        ex = ts[0].exchange_overlapped_start(vec.copy(), 1.0, 0.5, step=0)
+        merged, alpha, partner = ex.finish(vec.copy())
+        assert partner == 1
+        assert alpha == 0.5  # fetch completed — NOT abandoned at 2.5 s
+        np.testing.assert_allclose(merged, vec, rtol=1e-6)
+    finally:
+        srv.close()
+        close_all(ts)
+        th.join(timeout=5.0)
+
+
 def test_negative_loss_alpha_clamped_over_tcp():
     # Same clamp contract as the ICI path: a negative loss riding the
     # wire metadata must never turn the host merge into extrapolation.
