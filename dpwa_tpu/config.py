@@ -18,7 +18,11 @@ Schema::
       schedule: ring            # ring | random | hierarchical | exponential
       mode: pairwise            # pairwise (mutual merge) | pull (one-sided)
       fetch_probability: 1.0    # per-step chance a pair actually exchanges
-      timeout_ms: 500           # TCP transport only: fetch timeout
+      timeout_ms: 500           # TCP transport only: fetch budget
+                                #   (connect+header; payload earns
+                                #   1s per min_wire_mb_per_s received)
+      min_wire_mb_per_s: 10.0       # TCP only: slowest peer rate treated
+                                #   as alive (deadline floor)
       seed: 0                   # schedule / participation RNG seed
       pool_size: null           # random schedule: # static pairings compiled
                                 #   (default auto = clamp(2n, 16, 128))
@@ -48,12 +52,29 @@ class NodeSpec:
     port: int = 0
 
 
+# One source of truth for the TCP liveness floor (MEGABYTES/s):
+# ProtocolConfig's default and parallel/tcp.py's module default both
+# derive from this, so the "same" default cannot drift between the
+# config path and direct fetch_blob() calls.
+DEFAULT_MIN_WIRE_MB_PER_S = 10.0
+
+
 @dataclasses.dataclass(frozen=True)
 class ProtocolConfig:
     schedule: str = "ring"
     mode: str = "pairwise"  # pairwise (mutual merge) | pull (one-sided)
     fetch_probability: float = 1.0
     timeout_ms: int = 500
+    # TCP transport: the slowest transfer rate still treated as a live
+    # peer, in MEGABYTES per second (the name says mb_per_s, not mbps,
+    # deliberately — a megabit reading would be off by 8×).  The fetch
+    # deadline is timeout_ms (connect + header) plus 1 / this rate per
+    # payload byte RECEIVED, so large replicas are never rejected by a
+    # fixed budget while trickling peers still die promptly.
+    # Deployments on genuinely slow fabrics (WAN links below 10 MB/s)
+    # with large models must lower this, or every large fetch is
+    # abandoned and gossip silently degrades to solo training.
+    min_wire_mb_per_s: float = DEFAULT_MIN_WIRE_MB_PER_S
     seed: int = 0
     # Random schedule: number of static matchings compiled into the
     # lax.switch pool.  None = auto-scale with the peer count,
@@ -92,6 +113,10 @@ class ProtocolConfig:
             raise ValueError(f"unknown protocol mode {self.mode!r}")
         if self.wire_dtype not in ("f32", "bf16", "int8"):
             raise ValueError(f"unknown wire_dtype {self.wire_dtype!r}")
+        if self.min_wire_mb_per_s <= 0:
+            raise ValueError(
+                f"min_wire_mb_per_s must be > 0, got {self.min_wire_mb_per_s}"
+            )
         if self.pool_size is not None and self.pool_size < 1:
             raise ValueError(
                 f"pool_size must be >= 1 (or null for auto), "

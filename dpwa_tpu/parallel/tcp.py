@@ -38,7 +38,7 @@ from typing import Optional, Tuple
 import numpy as np
 
 from dpwa_tpu import native
-from dpwa_tpu.config import DpwaConfig
+from dpwa_tpu.config import DEFAULT_MIN_WIRE_MB_PER_S, DpwaConfig
 from dpwa_tpu.interpolation import PeerMeta, make_interpolation
 from dpwa_tpu.parallel.schedules import Schedule, build_schedule
 
@@ -61,13 +61,16 @@ _DTYPE_CODES = {v: k for k, v in _DTYPES.items()}
 # fetch_blob.  protocol.wire_dtype: int8.
 _INT8_CHUNKED = 4
 _MAX_BLOB = 1 << 34  # 16 GiB sanity bound on advertised payload size
-# Deadline floor for the payload read: once the header advertises nbytes,
-# the fetch budget grows by nbytes at this rate, so a healthy peer
+# Default deadline floor for the payload read (bytes/s): the fetch
+# budget grows at this rate per byte RECEIVED, so a healthy peer
 # streaming a large replica is never killed by a fixed timeout_ms sized
 # for the rendezvous (100 MB at 500 ms would otherwise fail FOREVER,
 # silently disabling gossip), while a trickling peer — orders of
 # magnitude below any real fabric — still gets dropped promptly.
-_MIN_WIRE_BANDWIDTH = 10 * 1024 * 1024  # bytes/s
+# Derived from the config default (one source of truth); configurable
+# per deployment via ``protocol.min_wire_mb_per_s`` (slow-WAN fabrics
+# must lower it).
+_MIN_WIRE_BANDWIDTH = DEFAULT_MIN_WIRE_MB_PER_S * 1e6
 
 
 def _recv_exact(
@@ -248,7 +251,10 @@ def make_peer_server(host: str, port: int):
 
 
 def fetch_blob(
-    host: str, port: int, timeout_ms: int
+    host: str,
+    port: int,
+    timeout_ms: int,
+    min_bandwidth_bps: float = _MIN_WIRE_BANDWIDTH,
 ) -> Optional[Tuple[np.ndarray, float, float]]:
     """Connect to a peer's Rx thread and pull its latest blob.
 
@@ -259,10 +265,12 @@ def fetch_blob(
     monotonic deadline threaded through :func:`_recv_exact` — not a
     per-recv timer a trickling peer could keep resetting.  It covers
     connect + request + header outright; the payload read then earns
-    ``1 / _MIN_WIRE_BANDWIDTH`` extra seconds per byte received, so the
-    budget scales with the replica actually flowing instead of rejecting
-    every blob larger than bandwidth × timeout_ms — and a peer that
-    merely ADVERTISES a huge payload earns nothing."""
+    ``1 / min_bandwidth_bps`` extra seconds per byte received (default:
+    the module floor derived from ``DEFAULT_MIN_WIRE_MB_PER_S``; the
+    transport passes ``protocol.min_wire_mb_per_s``), so the budget
+    scales with the replica actually flowing instead of rejecting every
+    blob larger than bandwidth × timeout_ms — and a peer that merely
+    ADVERTISES a huge payload earns nothing."""
     deadline = time.monotonic() + timeout_ms / 1000.0
     try:
         with socket.create_connection(
@@ -280,7 +288,7 @@ def fetch_blob(
             if nbytes > _MAX_BLOB:
                 return None
             data = _recv_exact(
-                sock, nbytes, deadline, 1.0 / _MIN_WIRE_BANDWIDTH
+                sock, nbytes, deadline, 1.0 / min_bandwidth_bps
             )
             if code == _INT8_CHUNKED:
                 # Receiver-side dequantize: the wire moved 1 byte/elem
@@ -370,7 +378,8 @@ class _OverlappedExchange:
             self._thread.join(
                 timeout=2.0
                 + self._t.config.protocol.timeout_ms / 1000.0
-                + self._expected_nbytes / _MIN_WIRE_BANDWIDTH
+                + self._expected_nbytes
+                / (self._t.config.protocol.min_wire_mb_per_s * 1e6)
             )
         got = self._got if self._thread is not None else None
         if got is None:
@@ -462,7 +471,10 @@ class TcpTransport:
         host, port = self._ports[peer_index]
         if timeout_ms is None:
             timeout_ms = self.config.protocol.timeout_ms
-        return fetch_blob(host, port, timeout_ms)
+        return fetch_blob(
+            host, port, timeout_ms,
+            min_bandwidth_bps=self.config.protocol.min_wire_mb_per_s * 1e6,
+        )
 
     def _weigh_remote(
         self, got: Tuple[np.ndarray, float, float], clock: float, loss: float

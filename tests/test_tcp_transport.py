@@ -394,6 +394,60 @@ def test_overlapped_join_waits_for_scaled_large_payload():
         th.join(timeout=5.0)
 
 
+def test_fetch_bandwidth_floor_is_configurable():
+    """protocol.min_wire_mb_per_s sets the slowest rate treated as a live
+    peer: the same pacing that the default 10 MB/s floor tolerates is
+    abandoned under a 100 MB/s floor.  (Default-floor acceptance is
+    covered by the large-payload test above.)"""
+    import socket as socket_mod
+    import time
+
+    from dpwa_tpu.parallel.tcp import _frame
+
+    srv = socket_mod.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    port = srv.getsockname()[1]
+    stop = threading.Event()
+
+    def server():
+        try:
+            conn, _ = srv.accept()
+        except OSError:
+            return
+        try:
+            conn.recv(64)
+            frame = _frame(np.arange(2 << 20, dtype=np.float32), 1.0, 0.5)
+            step = 1 << 20  # ~10 MB/s pacing: 8 MiB over ~0.8 s
+            for off in range(0, len(frame), step):
+                if stop.is_set():
+                    break
+                conn.sendall(frame[off : off + step])
+                time.sleep(0.1)
+        except OSError:
+            pass
+        finally:
+            conn.close()
+
+    th = threading.Thread(target=server, daemon=True)
+    th.start()
+    try:
+        t0 = time.monotonic()
+        got = fetch_blob("127.0.0.1", port, 500, min_bandwidth_bps=100e6)
+        elapsed = time.monotonic() - t0
+        assert got is None  # 10 MB/s pacing is "dead" under a 100 MB/s floor
+        assert elapsed < 1.5
+        # The transport plumbs the YAML knob through (validation + wiring).
+        cfg = make_local_config(2, min_wire_mb_per_s=0.5)
+        assert cfg.protocol.min_wire_mb_per_s == 0.5
+        with pytest.raises(ValueError):
+            make_local_config(2, min_wire_mb_per_s=0)
+    finally:
+        stop.set()
+        srv.close()
+        th.join(timeout=2.0)
+
+
 def test_negative_loss_alpha_clamped_over_tcp():
     # Same clamp contract as the ICI path: a negative loss riding the
     # wire metadata must never turn the host merge into extrapolation.
