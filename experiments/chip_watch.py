@@ -18,14 +18,16 @@ recovers MID-round went uncaptured.  This daemon closes that hole:
     2. ``python bench.py`` (full size)  ->  ``artifacts/bench_tpu_capture.json``
     3. ``experiments/train_steps_refresh.py`` (example steps/s incl. the
        bf16 BERT row — compiles that all succeeded on-chip in round 2)
-    4. ``experiments/flash_ring_bench.py`` (per-hop ring timing)
-    5. ``experiments/llama_block_bench.py --seq-len 8192`` — LAST: this
+    4. ``experiments/resnet20_trace.py`` (profiler trace of the
+       benchmark step — same round-2-proven compile risk class)
+    5. ``experiments/flash_ring_bench.py`` (per-hop ring timing)
+    6. ``experiments/llama_block_bench.py --seq-len 8192`` — LAST: this
        exact compile has taken the tunnel down in two separate rounds
        (r3 wedge; r4 UNAVAILABLE + dead backend), so it must not be able
        to cost any other artifact.
   Done-state is derived from the artifacts themselves (``job_state``), so
   a watcher restarted mid-round retries exactly the jobs whose artifacts
-  are missing, until all five exist.
+  are missing, until all six exist.
 - ``bench.py`` reads the capture file when its own live run can only reach
   CPU, so the round's recorded headline is the chip number whenever the
   chip was alive at ANY point in the round (with full provenance fields).
@@ -236,6 +238,9 @@ def job_state() -> dict:
         "bench_full": _chip_backend(_read_json(CAPTURE)),
         "train_steps_refresh": expected.issubset(refresh)
         and all(refresh[name].get("ok") for name in expected),
+        "resnet20_trace": _chip_backend(
+            _read_json(os.path.join(ART, "resnet20_trace.json"))
+        ),
         "llama_block_8192": (
             _chip_backend(block_main)
             and block_main.get("block", {}).get("seq_len") == 8192
@@ -296,6 +301,21 @@ def run_chip_jobs(job_timeout: float) -> dict:
         )
         outcomes["train_steps_refresh"] = ok_refresh
 
+    if (
+        outcomes["llama_block_4096"]
+        and outcomes["bench_full"]
+        and not done["resnet20_trace"]
+    ):
+        # Profiler trace of the ResNet-20 benchmark step (the measured
+        # half of the 8.6 %-MFU forensics; the compile succeeded on-chip
+        # in round 2 — same risk class as the refresh).
+        ok_trace, _ = run_job(
+            [sys.executable, "experiments/resnet20_trace.py"],
+            job_timeout,
+            "resnet20-trace",
+        )
+        outcomes["resnet20_trace"] = ok_trace
+
     if outcomes["llama_block_4096"] and outcomes["bench_full"]:
         # Big-compile jobs only once both cheaper artifacts are safely on
         # disk.  Flash-ring hop timing goes FIRST now: the block@8192
@@ -322,7 +342,7 @@ def run_chip_jobs(job_timeout: float) -> dict:
 
 def rotate_round_artifacts() -> None:
     """New-round launch: rotate EVERY artifact job_state() consults (not
-    just capture/history) so a fresh round re-measures all five jobs — a
+    just capture/history) so a fresh round re-measures all six jobs — a
     previous round's block timing or steps/s surviving rotation would
     make job_state() skip those jobs and silently promote stale numbers
     (bench.py also enforces a freshness bound on captured_at_utc as a
@@ -333,6 +353,7 @@ def rotate_round_artifacts() -> None:
         BLOCK_ARTIFACT,
         os.path.join(ART, "llama_block_real_dims_T4096.json"),
         os.path.join(ART, "train_steps_refresh.json"),
+        os.path.join(ART, "resnet20_trace.json"),
     ):
         if os.path.exists(path):
             root, ext = os.path.splitext(path)
@@ -390,7 +411,7 @@ def main() -> None:
     state = job_state()
     jobs_done = all(state.values())
     if jobs_done:
-        log("all five chip artifacts already landed; probing for history only")
+        log("all six chip artifacts already landed; probing for history only")
     else:
         missing = [k for k, v in state.items() if not v]
         log(f"chip jobs still missing artifacts: {missing}")

@@ -62,6 +62,7 @@ def test_big_compiles_gated_on_cheap_artifacts(monkeypatch, tmp_path):
     # Jobs never attempted stay marked "gated" (vs False = ran, failed) —
     # the probe-history record distinguishes the two.
     assert outcomes["train_steps_refresh"] == "gated"
+    assert outcomes["resnet20_trace"] == "gated"
     assert outcomes["llama_block_8192"] == "gated"
     assert outcomes["flash_ring_hop_timing"] == "gated"
 
@@ -90,6 +91,7 @@ def test_all_jobs_run_in_risk_order_on_success(monkeypatch, tmp_path):
         "llama-block-4096",
         "bench-full",
         "train-steps-refresh",
+        "resnet20-trace",
         "flash-ring-hop-timing",
         "llama-block-8192",
     ]
@@ -118,6 +120,7 @@ def test_restart_retries_only_missing_jobs(monkeypatch, tmp_path):
         "llama_block_4096": True,
         "bench_full": True,
         "train_steps_refresh": False,
+        "resnet20_trace": False,
         "llama_block_8192": False,
         "flash_ring_hop_timing": False,
     }
@@ -131,6 +134,7 @@ def test_restart_retries_only_missing_jobs(monkeypatch, tmp_path):
     outcomes = cw.run_chip_jobs(10.0)
     assert calls == [
         "train-steps-refresh",
+        "resnet20-trace",
         "flash-ring-hop-timing",
         "llama-block-8192",
     ]
@@ -138,6 +142,7 @@ def test_restart_retries_only_missing_jobs(monkeypatch, tmp_path):
     assert outcomes["llama_block_4096"] == "already_done"
     assert outcomes["bench_full"] == "already_done"
     assert outcomes["train_steps_refresh"] is True
+    assert outcomes["resnet20_trace"] is True
     assert outcomes["flash_ring_hop_timing"] is True
     assert outcomes["llama_block_8192"] is True
 
@@ -148,6 +153,9 @@ def test_restart_retries_only_missing_jobs(monkeypatch, tmp_path):
     )
     (tmp_path / "attention_memory.json").write_text(
         json.dumps({"flash_ring_hop_timing": {"backend": "tpu"}})
+    )
+    (tmp_path / "resnet20_trace.json").write_text(
+        json.dumps({"backend": "tpu"})
     )
     (tmp_path / "train_steps_refresh.json").write_text(
         json.dumps(
@@ -183,6 +191,9 @@ def test_new_round_rotation_resets_every_job(monkeypatch, tmp_path):
     )
     (tmp_path / "cap.json").write_text(json.dumps({"backend": "tpu"}))
     (tmp_path / "probe_history.jsonl").write_text("{}\n")
+    (tmp_path / "resnet20_trace.json").write_text(
+        json.dumps({"backend": "tpu"})
+    )
     (tmp_path / "train_steps_refresh.json").write_text(
         json.dumps(
             {
@@ -214,6 +225,7 @@ def test_new_round_rotation_resets_every_job(monkeypatch, tmp_path):
     assert (tmp_path / "llama_block_real_dims_T4096_prev.json").exists()
     assert (tmp_path / "train_steps_refresh_prev.json").exists()
     assert (tmp_path / "probe_history_prev.jsonl").exists()
+    assert (tmp_path / "resnet20_trace_prev.json").exists()
     assert (tmp_path / "flash_ring_hop_timing_prev.json").exists()
     mem = json.loads((tmp_path / "attention_memory.json").read_text())
     assert mem == {"memory_ceiling": {"max_T": 131072}}
