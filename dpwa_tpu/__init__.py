@@ -22,6 +22,9 @@ Public API (mirrors the reference's surface):
   gossip pairing schedules.
 - :mod:`dpwa_tpu.interpolation` — constant / clock-weighted / loss-weighted
   merge-coefficient strategies.
+- :mod:`dpwa_tpu.health` — peer-health control plane for the TCP path:
+  failure detection, quarantine/backoff with probe re-admission, and a
+  deterministic chaos harness (``health:`` / ``chaos:`` config blocks).
 """
 
 from dpwa_tpu.config import DpwaConfig, load_config, make_local_config  # noqa: F401
@@ -78,6 +81,12 @@ def __getattr__(name):
         "save_checkpoint": ("dpwa_tpu.checkpoint", "save_checkpoint"),
         "restore_checkpoint": ("dpwa_tpu.checkpoint", "restore_checkpoint"),
         "ring_attention": ("dpwa_tpu.ops.ring_attention", "ring_attention"),
+        # Peer-health control plane (TCP path).
+        "FailureDetector": ("dpwa_tpu.health.detector", "FailureDetector"),
+        "Scoreboard": ("dpwa_tpu.health.scoreboard", "Scoreboard"),
+        "ChaosEngine": ("dpwa_tpu.health.chaos", "ChaosEngine"),
+        "ChaosPeerServer": ("dpwa_tpu.health.chaos", "ChaosPeerServer"),
+        "HealthzServer": ("dpwa_tpu.health.endpoint", "HealthzServer"),
     }
     if name in lazy:
         import importlib

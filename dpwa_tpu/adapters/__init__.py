@@ -1,6 +1,26 @@
-from dpwa_tpu.adapters.jax_adapter import DpwaJaxAdapter  # noqa: F401
-from dpwa_tpu.adapters.tcp_adapter import (  # noqa: F401
-    DpwaPyTorchAdapter,
-    DpwaTcpAdapter,
-    DpwaTorchAdapter,
-)
+"""Training adapters.  Loaded lazily: the TCP/torch adapters must stay
+importable on hosts whose jax lacks the SPMD machinery the jax adapter
+needs (and vice versa, importing the jax adapter shouldn't pay the TCP
+module's socket imports)."""
+
+__all__ = [
+    "DpwaJaxAdapter",
+    "DpwaPyTorchAdapter",
+    "DpwaTcpAdapter",
+    "DpwaTorchAdapter",
+]
+
+_LAZY = {
+    "DpwaJaxAdapter": "dpwa_tpu.adapters.jax_adapter",
+    "DpwaPyTorchAdapter": "dpwa_tpu.adapters.tcp_adapter",
+    "DpwaTcpAdapter": "dpwa_tpu.adapters.tcp_adapter",
+    "DpwaTorchAdapter": "dpwa_tpu.adapters.tcp_adapter",
+}
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+
+        return getattr(importlib.import_module(_LAZY[name]), name)
+    raise AttributeError(f"module 'dpwa_tpu.adapters' has no attribute {name!r}")

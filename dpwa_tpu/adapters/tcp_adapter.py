@@ -12,11 +12,12 @@ Two flavors:
 
 from __future__ import annotations
 
-from typing import Any, Union
+from typing import Any, Optional, Union
 
 import numpy as np
 
 from dpwa_tpu.config import DpwaConfig, load_config
+from dpwa_tpu.metrics import MetricsLogger
 from dpwa_tpu.parallel.tcp import TcpTransport
 from dpwa_tpu.utils.pytree import ravel
 
@@ -28,9 +29,23 @@ def _resolve(config: Union[DpwaConfig, str]) -> DpwaConfig:
 
 
 class DpwaTcpAdapter:
-    """Reference-style per-process adapter for a JAX/numpy pytree."""
+    """Reference-style per-process adapter for a JAX/numpy pytree.
 
-    def __init__(self, params: PyTree, name: str, config: Union[DpwaConfig, str]):
+    ``metrics`` (a :class:`~dpwa_tpu.metrics.MetricsLogger`, or a path
+    string to open one) turns on per-update JSONL records — step, α,
+    scheduled vs. actual partner, fetch outcome — plus a periodic
+    ``health`` record from the transport's scoreboard every
+    ``health_every`` updates.  These records are what
+    ``tools/health_report.py`` summarizes."""
+
+    def __init__(
+        self,
+        params: PyTree,
+        name: str,
+        config: Union[DpwaConfig, str],
+        metrics: Union[MetricsLogger, str, None] = None,
+        health_every: int = 10,
+    ):
         self.config = _resolve(config)
         self.transport = TcpTransport(self.config, name)
         flat, self._unravel = ravel(params)
@@ -39,6 +54,11 @@ class DpwaTcpAdapter:
         self._step = 0
         self.last_alpha = 0.0
         self.last_partner = -1
+        self._own_metrics = isinstance(metrics, str)
+        self.metrics: Optional[MetricsLogger] = (
+            MetricsLogger(path=metrics) if self._own_metrics else metrics
+        )
+        self._health_every = max(1, health_every)
         # Serve initial weights immediately (reference init publishes too).
         self.transport.publish(self._vec, self._clock, 0.0)
 
@@ -50,6 +70,10 @@ class DpwaTcpAdapter:
     def step(self) -> int:
         return self._step
 
+    def health_snapshot(self) -> dict:
+        """Per-peer health state (see ``TcpTransport.health_snapshot``)."""
+        return self.transport.health_snapshot()
+
     def update(self, loss: float, params: PyTree = None) -> PyTree:
         if params is not None:
             self._vec = np.asarray(ravel(params)[0], dtype=np.float32)
@@ -57,10 +81,27 @@ class DpwaTcpAdapter:
         self._vec, self.last_alpha, self.last_partner = self.transport.exchange(
             self._vec, self._clock, float(loss), self._step
         )
+        if self.metrics is not None:
+            info = self.transport.last_round
+            self.metrics.log(
+                self._step,
+                loss=float(loss),
+                alpha=self.last_alpha,
+                sched_partner=info.get("sched_partner"),
+                partner=info.get("partner"),
+                remapped=info.get("remapped"),
+                outcome=info.get("outcome"),
+            )
+            if self._step % self._health_every == 0:
+                self.metrics.log_health(
+                    self._step, self.transport.health_snapshot()
+                )
         self._step += 1
         return self.params
 
     def close(self) -> None:
+        if self.metrics is not None and self._own_metrics:
+            self.metrics.close()
         self.transport.close()
 
 

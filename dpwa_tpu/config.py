@@ -33,6 +33,29 @@ Schema::
     interpolation:
       type: constant            # constant | clock | loss
       factor: 0.5               # constant alpha (0.5 == (local+remote)/2)
+    health:                     # peer-health control plane (TCP transport)
+      enabled: true             # failure detection + quarantine/remap
+      suspicion_threshold: 2.0  # quarantine when suspicion crosses this
+      ewma_alpha: 0.2           # latency/throughput EWMA smoothing
+      success_decay: 0.25       # suspicion multiplier per good fetch
+      quarantine_base_rounds: 4 # first quarantine length (doubles per
+                                #   consecutive failed probe, clamped)
+      quarantine_max_rounds: 64
+      jitter_rounds: 2          # deterministic backoff jitter in [0, j]
+      probe_timeout_ms: 100     # header-only re-admission probe budget
+      healthz_port: null        # JSON /healthz endpoint (null = off,
+                                #   0 = OS-assigned port)
+    chaos:                      # deterministic fault injection harness
+      enabled: false            # forces the Python Rx server when on
+      seed: 0
+      drop_probability: 0.0     # close the connection before serving
+      delay_probability: 0.0    # sleep delay_ms before serving
+      delay_ms: 50.0
+      throttle_probability: 0.0 # serve at throttle_bytes_per_s
+      throttle_bytes_per_s: 1e6
+      truncate_probability: 0.0 # cut the frame mid-payload
+      corrupt_probability: 0.0  # flip the frame's magic bytes
+      down_windows: []          # [{peer, start, stop}]: hard-down rounds
 """
 
 from __future__ import annotations
@@ -131,6 +154,123 @@ class ProtocolConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class HealthConfig:
+    """``health:`` block — the peer-health control plane's knobs.
+
+    Applies to the TCP transport (the path with per-peer fetches to
+    fail); the SPMD transports emulate failures in-graph via
+    ``protocol.drop_probability`` and need no detector.  Quarantine
+    timing is counted in gossip ROUNDS, never wall time, so health state
+    is deterministic for a fixed outcome sequence (see
+    :mod:`dpwa_tpu.health.scoreboard`)."""
+
+    enabled: bool = True
+    # Quarantine when a peer's suspicion crosses this.  Failure weights
+    # (detector.DEFAULT_FAILURE_WEIGHTS) are ~1 per hard failure, so the
+    # default 2.0 means two consecutive hard failures.
+    suspicion_threshold: float = 2.0
+    ewma_alpha: float = 0.2
+    success_decay: float = 0.25
+    quarantine_base_rounds: int = 4
+    quarantine_max_rounds: int = 64
+    jitter_rounds: int = 2
+    probe_timeout_ms: int = 100
+    # None = no endpoint; 0 = OS-assigned port; >0 = fixed port.  The
+    # endpoint serves the scoreboard snapshot as JSON over plain HTTP
+    # (stdlib-only, dpwa_tpu/health/endpoint.py).
+    healthz_port: int | None = None
+
+    def __post_init__(self) -> None:
+        if self.suspicion_threshold <= 0:
+            raise ValueError(
+                f"suspicion_threshold must be > 0, got {self.suspicion_threshold}"
+            )
+        if not 0.0 < self.ewma_alpha <= 1.0:
+            raise ValueError(
+                f"ewma_alpha must be in (0, 1], got {self.ewma_alpha}"
+            )
+        if not 0.0 <= self.success_decay < 1.0:
+            raise ValueError(
+                f"success_decay must be in [0, 1), got {self.success_decay}"
+            )
+        if self.quarantine_base_rounds < 1:
+            raise ValueError(
+                f"quarantine_base_rounds must be >= 1, "
+                f"got {self.quarantine_base_rounds}"
+            )
+        if self.quarantine_max_rounds < self.quarantine_base_rounds:
+            raise ValueError(
+                "quarantine_max_rounds must be >= quarantine_base_rounds"
+            )
+        if self.jitter_rounds < 0:
+            raise ValueError(
+                f"jitter_rounds must be >= 0, got {self.jitter_rounds}"
+            )
+        if self.probe_timeout_ms < 1:
+            raise ValueError(
+                f"probe_timeout_ms must be >= 1, got {self.probe_timeout_ms}"
+            )
+        if self.healthz_port is not None and not 0 <= self.healthz_port < 65536:
+            raise ValueError(
+                f"healthz_port must be in [0, 65535] or null, "
+                f"got {self.healthz_port}"
+            )
+
+
+@dataclasses.dataclass(frozen=True)
+class ChaosConfig:
+    """``chaos:`` block — deterministic fault injection for the TCP path.
+
+    Faults are drawn per (seed, round, peer) on independent threefry
+    streams (:func:`dpwa_tpu.parallel.schedules.chaos_draw`), so a given
+    seed replays the identical fault schedule — the harness doubles as a
+    soak tool (``chaos:`` in YAML) and a test fixture
+    (:mod:`dpwa_tpu.health.chaos`).  ``down_windows`` hard-kills a peer's
+    Rx serving for a round interval ``[start, stop)`` — the
+    'process died, later came back' scenario quarantine/re-admission is
+    proven against."""
+
+    enabled: bool = False
+    seed: int = 0
+    drop_probability: float = 0.0
+    delay_probability: float = 0.0
+    delay_ms: float = 50.0
+    throttle_probability: float = 0.0
+    throttle_bytes_per_s: float = 1e6
+    truncate_probability: float = 0.0
+    corrupt_probability: float = 0.0
+    down_windows: tuple[tuple[int, int, int], ...] = ()
+
+    def __post_init__(self) -> None:
+        for name in (
+            "drop_probability",
+            "delay_probability",
+            "throttle_probability",
+            "truncate_probability",
+            "corrupt_probability",
+        ):
+            v = getattr(self, name)
+            if not 0.0 <= v <= 1.0:
+                raise ValueError(f"{name} must be in [0, 1], got {v}")
+        if self.delay_ms < 0:
+            raise ValueError(f"delay_ms must be >= 0, got {self.delay_ms}")
+        if self.throttle_bytes_per_s <= 0:
+            raise ValueError(
+                f"throttle_bytes_per_s must be > 0, "
+                f"got {self.throttle_bytes_per_s}"
+            )
+        windows = []
+        for w in self.down_windows:
+            if isinstance(w, Mapping):
+                w = (w["peer"], w["start"], w["stop"])
+            w = tuple(int(x) for x in w)
+            if len(w) != 3 or w[0] < 0 or w[1] < 0 or w[2] < w[1]:
+                raise ValueError(f"bad down_windows entry {w!r}")
+            windows.append(w)
+        object.__setattr__(self, "down_windows", tuple(windows))
+
+
+@dataclasses.dataclass(frozen=True)
 class InterpolationConfig:
     type: str = "constant"
     factor: float = 0.5
@@ -147,6 +287,8 @@ class DpwaConfig:
     nodes: tuple[NodeSpec, ...]
     protocol: ProtocolConfig = ProtocolConfig()
     interpolation: InterpolationConfig = InterpolationConfig()
+    health: HealthConfig = HealthConfig()
+    chaos: ChaosConfig = ChaosConfig()
 
     @property
     def n_peers(self) -> int:
@@ -200,10 +342,16 @@ def config_from_dict(raw: Mapping[str, Any]) -> DpwaConfig:
         raise ValueError("config is missing the required 'nodes:' list")
     proto = dict(raw.get("protocol") or {})
     interp = dict(raw.get("interpolation") or {})
+    health = dict(raw.get("health") or {})
+    chaos = dict(raw.get("chaos") or {})
+    if "down_windows" in chaos and chaos["down_windows"] is not None:
+        chaos["down_windows"] = tuple(chaos["down_windows"])
     return DpwaConfig(
         nodes=_build_nodes(raw["nodes"]),
         protocol=ProtocolConfig(**proto),
         interpolation=InterpolationConfig(**interp),
+        health=HealthConfig(**health),
+        chaos=ChaosConfig(**chaos),
     )
 
 
@@ -225,9 +373,18 @@ def make_local_config(
     factor: float = 0.5,
     seed: int = 0,
     base_port: int = 45000,
+    health: "HealthConfig | Mapping[str, Any] | None" = None,
+    chaos: "ChaosConfig | Mapping[str, Any] | None" = None,
     **protocol_kwargs: Any,
 ) -> DpwaConfig:
-    """Programmatic config for tests/benchmarks: n local peers on 127.0.0.1."""
+    """Programmatic config for tests/benchmarks: n local peers on 127.0.0.1.
+
+    ``health`` / ``chaos`` accept a config object or a plain dict (the
+    YAML-block shorthand)."""
+    if isinstance(health, Mapping):
+        health = HealthConfig(**health)
+    if isinstance(chaos, Mapping):
+        chaos = ChaosConfig(**chaos)
     return DpwaConfig(
         nodes=tuple(
             NodeSpec(name=f"node{i}", host="127.0.0.1", port=base_port + i)
@@ -240,4 +397,6 @@ def make_local_config(
             **protocol_kwargs,
         ),
         interpolation=InterpolationConfig(type=interpolation, factor=factor),
+        health=health if health is not None else HealthConfig(),
+        chaos=chaos if chaos is not None else ChaosConfig(),
     )

@@ -1,0 +1,68 @@
+"""Peer-health control plane: failure detection, quarantine, chaos.
+
+The reference dpwa's only elasticity is implicit — a timed-out fetch is
+skipped and training continues (SURVEY.md §5).  This package makes peer
+health a first-class, observable, *deterministic* subsystem:
+
+- :mod:`~dpwa_tpu.health.detector` — per-peer EWMA latency/throughput and
+  a phi-accrual-style suspicion score fed by every fetch outcome;
+- :mod:`~dpwa_tpu.health.scoreboard` — quarantine with exponential
+  backoff + deterministic jitter, header-probe re-admission, and the
+  healthy-peer mask the schedule's fallback remap consumes;
+- :mod:`~dpwa_tpu.health.chaos` — seeded wire-level fault injection
+  (drop/delay/throttle/truncate/corrupt, hard down-windows) for tests
+  and ``chaos:``-config soaks;
+- :mod:`~dpwa_tpu.health.endpoint` — a stdlib-only ``/healthz`` JSON
+  endpoint over the scoreboard snapshot.
+
+``chaos`` and ``endpoint`` are intentionally NOT imported here:
+``chaos`` imports :mod:`dpwa_tpu.parallel.tcp`, which itself imports
+``detector`` — loading it from this package ``__init__`` would recurse
+into the partially-initialized ``tcp`` module.  Access them lazily
+(``from dpwa_tpu.health.chaos import ...``) or via attribute access on
+this package, which defers the import until ``tcp`` is fully loaded.
+"""
+
+from dpwa_tpu.health.detector import (  # noqa: F401
+    DEFAULT_FAILURE_WEIGHTS,
+    FailureDetector,
+    Outcome,
+    PeerRecord,
+)
+from dpwa_tpu.health.scoreboard import (  # noqa: F401
+    PeerState,
+    Scoreboard,
+    run_probe,
+)
+
+__all__ = [
+    "DEFAULT_FAILURE_WEIGHTS",
+    "FailureDetector",
+    "Outcome",
+    "PeerRecord",
+    "PeerState",
+    "Scoreboard",
+    "run_probe",
+    # lazy (see __getattr__):
+    "ChaosEngine",
+    "ChaosPeerServer",
+    "FaultPlan",
+    "HealthzServer",
+    "mutate_frame",
+]
+
+
+def __getattr__(name):
+    lazy = {
+        "ChaosEngine": ("dpwa_tpu.health.chaos", "ChaosEngine"),
+        "ChaosPeerServer": ("dpwa_tpu.health.chaos", "ChaosPeerServer"),
+        "FaultPlan": ("dpwa_tpu.health.chaos", "FaultPlan"),
+        "mutate_frame": ("dpwa_tpu.health.chaos", "mutate_frame"),
+        "HealthzServer": ("dpwa_tpu.health.endpoint", "HealthzServer"),
+    }
+    if name in lazy:
+        import importlib
+
+        module, attr = lazy[name]
+        return getattr(importlib.import_module(module), attr)
+    raise AttributeError(f"module 'dpwa_tpu.health' has no attribute {name!r}")

@@ -1,0 +1,197 @@
+"""Deterministic chaos harness: seeded fault injection for the TCP path.
+
+Wraps the Rx serving side of the transport (:class:`ChaosPeerServer`, a
+drop-in :class:`~dpwa_tpu.parallel.tcp.PeerServer`) and injects wire-level
+faults — the faults are REAL (bytes actually truncated, connections
+actually dropped, headers actually corrupted on the socket), so the
+fetching side exercises its genuine parsing/timeout/skip robustness, not
+a simulation of it.
+
+Fault kinds, drawn per (chaos seed, gossip round, peer) on independent
+threefry streams (:func:`dpwa_tpu.parallel.schedules.chaos_draw` — the
+same counter-based design as the existing ``fault_draw``, so a fixed
+seed replays the identical fault schedule run after run):
+
+- **drop** — close the connection before serving anything;
+- **delay** — sleep ``delay_ms`` before serving (drives fetch timeouts);
+- **throttle** — serve at ``throttle_bytes_per_s`` (drives the
+  bandwidth-floor abandon path);
+- **truncate** — cut the frame mid-payload (short read on the fetcher);
+- **corrupt** — flip the frame's magic bytes (malformed-header path).
+
+Plus **down windows**: hard intervals ``[start, stop)`` of gossip rounds
+during which a peer serves nothing at all — the 'process died, later
+came back' scenario that the quarantine → backoff → probe → re-admission
+cycle is proven against (tests/test_health.py).
+
+The round key is the integer part of the publish ``clock`` — the
+training loops publish ``clock = step`` — so injected faults are
+schedule-locked to rounds, not to wall time.  Usable from tests
+(construct directly) and from YAML via the ``chaos:`` config block
+(``TcpTransport`` builds the wrapper itself when ``chaos.enabled``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Optional
+
+from dpwa_tpu.config import ChaosConfig
+from dpwa_tpu.parallel.schedules import chaos_draw
+
+# Fault-kind indices onto the chaos_draw tag space (CHAOS_TAG_BASE + k).
+_KIND_DROP = 0
+_KIND_DELAY = 1
+_KIND_THROTTLE = 2
+_KIND_TRUNCATE = 3
+_KIND_CORRUPT = 4
+# Priority order when several draws fire in one round: exactly one fault
+# kind applies per (round, peer) so injected behavior stays analyzable.
+_PRIORITY = (
+    ("drop", _KIND_DROP, "drop_probability"),
+    ("truncate", _KIND_TRUNCATE, "truncate_probability"),
+    ("corrupt", _KIND_CORRUPT, "corrupt_probability"),
+    ("throttle", _KIND_THROTTLE, "throttle_probability"),
+    ("delay", _KIND_DELAY, "delay_probability"),
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultPlan:
+    """The fault (if any) in effect for one (round, peer)."""
+
+    kind: str = "none"  # none | down | drop | delay | throttle | truncate | corrupt
+    delay_s: float = 0.0
+    throttle_bps: float = 0.0
+
+    @property
+    def faulty(self) -> bool:
+        return self.kind != "none"
+
+
+class ChaosEngine:
+    """Draws the deterministic fault plan for one peer's Rx server.
+
+    One engine per peer; plans are cached per round (several fetchers may
+    hit the same round's served payload)."""
+
+    def __init__(self, config: ChaosConfig, peer: int):
+        self.config = config
+        self.peer = peer
+        self._lock = threading.Lock()
+        self._cache: dict[int, FaultPlan] = {}
+
+    def down(self, round: int) -> bool:
+        """True while ``round`` falls inside one of this peer's
+        configured hard-down windows."""
+        return any(
+            p == self.peer and start <= round < stop
+            for p, start, stop in self.config.down_windows
+        )
+
+    def plan(self, round: int) -> FaultPlan:
+        if self.down(round):
+            return FaultPlan(kind="down")
+        with self._lock:
+            cached = self._cache.get(round)
+            if cached is not None:
+                return cached
+        cfg = self.config
+        plan = FaultPlan()
+        for kind, tag, prob_field in _PRIORITY:
+            prob = getattr(cfg, prob_field)
+            if prob <= 0.0:
+                continue
+            if chaos_draw(cfg.seed, round, self.peer, tag) < prob:
+                plan = FaultPlan(
+                    kind=kind,
+                    delay_s=cfg.delay_ms / 1000.0,
+                    throttle_bps=cfg.throttle_bytes_per_s,
+                )
+                break
+        with self._lock:
+            if len(self._cache) > 64:  # bound memory on long soaks
+                self._cache.clear()
+            self._cache[round] = plan
+        return plan
+
+
+def mutate_frame(payload: bytes, kind: str) -> Optional[bytes]:
+    """Apply a frame-level fault to a wire frame; None means 'serve
+    nothing' (drop/down).  Split out of the server so tests can assert
+    the exact bytes each fault puts on the wire."""
+    from dpwa_tpu.parallel.tcp import _HDR
+
+    if kind in ("drop", "down"):
+        return None
+    if kind == "corrupt":
+        # Flip the magic: the fetcher's header validation must reject it.
+        return b"XXXX" + payload[4:]
+    if kind == "truncate":
+        # Cut mid-payload (past the header, so the fetcher commits to a
+        # payload read and then hits the peer-closed short-read path).
+        cut = _HDR.size + max(1, (len(payload) - _HDR.size) // 2)
+        return payload[: min(cut, len(payload) - 1)]
+    return payload
+
+
+class ChaosPeerServer:
+    """A :class:`~dpwa_tpu.parallel.tcp.PeerServer` that injects the
+    engine's fault plan into every served connection.
+
+    Deliberately wraps the *Python* Rx server (never the native one):
+    fault injection needs per-connection control of the serve loop.
+    ``TcpTransport`` selects this wrapper when ``chaos.enabled``."""
+
+    def __init__(self, host: str, port: int, engine: ChaosEngine):
+        from dpwa_tpu.parallel import tcp as _tcp
+
+        self.engine = engine
+        self._round = 0
+        outer = self
+
+        class _Server(_tcp.PeerServer):
+            def _handle(self, conn):
+                outer._serve_with_faults(self, conn)
+
+        self._srv = _Server(host, port)
+        self.port = self._srv.port
+
+    def publish(self, vec, clock, loss, code=None) -> None:
+        # The integer publish clock IS the round key: training loops
+        # publish clock = step, pinning faults to gossip rounds.
+        self._round = int(clock)
+        self._srv.publish(vec, clock, loss, code)
+
+    def _serve_with_faults(self, srv, conn) -> None:
+        from dpwa_tpu.parallel.tcp import _REQ, _recv_exact
+
+        plan = self.engine.plan(self._round)
+        if plan.kind in ("down", "drop"):
+            return  # caller closes: the fetcher sees a reset/short read
+        req = _recv_exact(conn, len(_REQ))
+        if req != _REQ:
+            return
+        with srv._lock:
+            payload = srv._payload
+        if payload is None:
+            return
+        if plan.kind == "delay":
+            time.sleep(plan.delay_s)
+            conn.sendall(payload)
+            return
+        if plan.kind == "throttle":
+            step = 4096
+            pause = step / plan.throttle_bps
+            for off in range(0, len(payload), step):
+                conn.sendall(payload[off : off + step])
+                time.sleep(pause)
+            return
+        mutated = mutate_frame(payload, plan.kind)
+        if mutated is not None:
+            conn.sendall(mutated)
+
+    def close(self) -> None:
+        self._srv.close()

@@ -1,0 +1,87 @@
+"""Stdlib-only ``/healthz`` endpoint serving the scoreboard snapshot.
+
+A tiny HTTP/1.0 responder on its own daemon thread — deliberately NOT
+``http.server`` (its per-request handler machinery is overkill for a
+single read-only JSON route) and deliberately a separate port from the
+Rx server (the Rx protocol is a binary length-framed format; mixing a
+text route into it would complicate the one parser that faces untrusted
+peers).  Enabled via ``health.healthz_port`` in the YAML config
+(``null`` = off, ``0`` = OS-assigned); curl-able::
+
+    $ curl http://127.0.0.1:<port>/healthz
+    {"me": 0, "round": 41, "peers": {"1": {"state": "healthy", ...}}}
+
+Any request path gets the same snapshot — the endpoint is a liveness/
+introspection hook, not a router."""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+from typing import Callable
+
+
+class HealthzServer:
+    """Serves ``snapshot_fn()`` as JSON to any HTTP client."""
+
+    def __init__(
+        self,
+        snapshot_fn: Callable[[], dict],
+        host: str = "127.0.0.1",
+        port: int = 0,
+    ):
+        self._snapshot_fn = snapshot_fn
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self.port = self._sock.getsockname()[1]
+        self._sock.listen(8)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._serve, name=f"dpwa-healthz:{self.port}", daemon=True
+        )
+        self._thread.start()
+
+    def _serve(self) -> None:
+        try:
+            self._sock.settimeout(0.2)
+        except OSError:
+            return
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            try:
+                conn.settimeout(2.0)
+                # Drain the request line + headers (best effort; we serve
+                # the same document whatever was asked).
+                try:
+                    conn.recv(4096)
+                except OSError:
+                    pass
+                try:
+                    body = json.dumps(self._snapshot_fn()).encode()
+                except Exception:  # snapshot must never kill the endpoint
+                    body = b'{"error": "snapshot failed"}'
+                conn.sendall(
+                    b"HTTP/1.0 200 OK\r\n"
+                    b"Content-Type: application/json\r\n"
+                    b"Content-Length: " + str(len(body)).encode() + b"\r\n"
+                    b"Connection: close\r\n\r\n" + body
+                )
+            except OSError:
+                pass
+            finally:
+                conn.close()
+
+    def close(self) -> None:
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        self._thread.join(timeout=2.0)

@@ -1,0 +1,246 @@
+"""Peer-health scoreboard: quarantine, exponential backoff, re-admission.
+
+The *acting* half of the peer-health control plane (sensing lives in
+:mod:`dpwa_tpu.health.detector`).  Per remote peer, a small state machine:
+
+``healthy`` ──suspicion ≥ threshold──▶ ``quarantined`` ──backoff elapses──▶
+probe due ──header probe ok──▶ ``healthy`` (or probe fails ▶ re-quarantined
+with doubled backoff).
+
+While a peer is quarantined the transport spends **zero fetch budget** on
+it: the schedule remaps the round to a healthy fallback
+(:meth:`dpwa_tpu.parallel.schedules.Schedule.remap_partner`).  Backoff is
+exponential in the number of consecutive quarantines (``base · 2^(k-1)``
+rounds, clamped) plus a deterministic threefry jitter keyed on
+``(seed, peer, k)`` — jitter de-synchronizes probe storms across many
+fetchers without breaking run-to-run reproducibility.
+
+All clocks here are **round counters** (schedule steps), never wall time:
+identical outcome sequences produce identical quarantine windows on every
+replica and on every rerun — the determinism the chaos-harness acceptance
+test (tests/test_health.py) pins down.
+
+Thread safety: the overlapped TCP exchange records outcomes from its
+fetch thread while the training thread reads health state, so every
+public method takes the internal lock.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional
+
+from dpwa_tpu.config import HealthConfig
+from dpwa_tpu.health.detector import FailureDetector, Outcome
+from dpwa_tpu.parallel.schedules import backoff_jitter_draw
+
+
+class PeerState:
+    """Peer health states (plain strings: they ride into JSONL metrics)."""
+
+    HEALTHY = "healthy"
+    SUSPECT = "suspect"  # nonzero suspicion, below the quarantine threshold
+    QUARANTINED = "quarantined"
+
+
+class Scoreboard:
+    """Tracks health state for every remote peer of one local node."""
+
+    def __init__(
+        self,
+        n_peers: int,
+        me: int,
+        config: Optional[HealthConfig] = None,
+        seed: int = 0,
+    ):
+        self.config = config if config is not None else HealthConfig()
+        self.n_peers = n_peers
+        self.me = me
+        self.seed = seed
+        self.detector = FailureDetector(
+            ewma_alpha=self.config.ewma_alpha,
+            success_decay=self.config.success_decay,
+        )
+        self._lock = threading.Lock()
+        self._state: Dict[int, str] = {}
+        # Round the current quarantine ends (probe becomes due).
+        self._release_round: Dict[int, int] = {}
+        # Consecutive quarantines without an intervening successful probe.
+        self._quarantine_streak: Dict[int, int] = {}
+        self._quarantines: Dict[int, int] = {}  # lifetime count
+        self._quarantined_rounds: Dict[int, int] = {}  # lifetime total
+        self._quarantined_at: Dict[int, int] = {}
+        self._probe_attempts: Dict[int, int] = {}
+        self._probe_successes: Dict[int, int] = {}
+        self._round = 0  # highest round observed (fallback clock)
+
+    # ------------------------------------------------------------------
+    # Outcome ingestion
+    # ------------------------------------------------------------------
+
+    def record(
+        self,
+        peer: int,
+        outcome: str,
+        latency_s: Optional[float] = None,
+        nbytes: int = 0,
+        round: Optional[int] = None,
+    ) -> str:
+        """Feed one fetch outcome; returns the peer's resulting state."""
+        with self._lock:
+            r = self._clock(round)
+            suspicion = self.detector.observe(peer, outcome, latency_s, nbytes)
+            state = self._state.get(peer, PeerState.HEALTHY)
+            if state != PeerState.QUARANTINED:
+                if suspicion >= self.config.suspicion_threshold:
+                    self._enter_quarantine(peer, r)
+                elif suspicion > 0.0:
+                    self._state[peer] = PeerState.SUSPECT
+                else:
+                    self._state[peer] = PeerState.HEALTHY
+            return self._state.get(peer, PeerState.HEALTHY)
+
+    def record_probe(self, peer: int, ok: bool, round: Optional[int] = None):
+        """Result of a re-admission probe for a quarantined peer."""
+        with self._lock:
+            r = self._clock(round)
+            self._probe_attempts[peer] = self._probe_attempts.get(peer, 0) + 1
+            self._settle_quarantined_rounds(peer, r)
+            if ok:
+                self._probe_successes[peer] = (
+                    self._probe_successes.get(peer, 0) + 1
+                )
+                self._state[peer] = PeerState.HEALTHY
+                self._quarantine_streak[peer] = 0
+                rec = self.detector.record(peer)
+                rec.suspicion = 0.0
+                rec.failure_streak = 0
+            else:
+                # Still dead: back off again, twice as long.
+                self._enter_quarantine(peer, r)
+
+    # ------------------------------------------------------------------
+    # Queries (the transport's decision points)
+    # ------------------------------------------------------------------
+
+    def is_quarantined(self, peer: int, round: Optional[int] = None) -> bool:
+        """True while the peer must receive zero fetch attempts."""
+        with self._lock:
+            self._clock(round)
+            return self._state.get(peer) == PeerState.QUARANTINED
+
+    def probe_due(self, peer: int, round: Optional[int] = None) -> bool:
+        """True when the backoff has elapsed and a cheap header-only
+        probe should decide re-admission."""
+        with self._lock:
+            r = self._clock(round)
+            return (
+                self._state.get(peer) == PeerState.QUARANTINED
+                and r >= self._release_round.get(peer, 0)
+            )
+
+    def healthy_mask(self, round: Optional[int] = None) -> List[bool]:
+        """Per-peer eligibility as a fallback fetch target.
+
+        Quarantined peers are excluded until a probe re-admits them; the
+        local node itself is trivially 'healthy' but the remap never
+        selects it anyway."""
+        with self._lock:
+            self._clock(round)
+            return [
+                self._state.get(p) != PeerState.QUARANTINED
+                for p in range(self.n_peers)
+            ]
+
+    # ------------------------------------------------------------------
+    # Internals
+    # ------------------------------------------------------------------
+
+    def _clock(self, round: Optional[int]) -> int:
+        if round is not None and round > self._round:
+            self._round = int(round)
+        return self._round
+
+    def _enter_quarantine(self, peer: int, r: int) -> None:
+        streak = self._quarantine_streak.get(peer, 0) + 1
+        self._quarantine_streak[peer] = streak
+        self._quarantines[peer] = self._quarantines.get(peer, 0) + 1
+        backoff = min(
+            self.config.quarantine_base_rounds * (1 << (streak - 1)),
+            self.config.quarantine_max_rounds,
+        )
+        backoff += backoff_jitter_draw(
+            self.seed, peer, streak, self.config.jitter_rounds
+        )
+        self._state[peer] = PeerState.QUARANTINED
+        self._quarantined_at[peer] = r
+        self._release_round[peer] = r + backoff
+        self.detector.record(peer)  # materialize stats for the snapshot
+
+    def _settle_quarantined_rounds(self, peer: int, r: int) -> None:
+        """Fold the just-finished quarantine window into the lifetime
+        total (called with the lock held, when a probe resolves it)."""
+        if self._state.get(peer) == PeerState.QUARANTINED:
+            start = self._quarantined_at.get(peer, r)
+            self._quarantined_rounds[peer] = self._quarantined_rounds.get(
+                peer, 0
+            ) + max(0, r - start)
+            self._quarantined_at[peer] = r
+
+    # ------------------------------------------------------------------
+    # Observability
+    # ------------------------------------------------------------------
+
+    def state(self, peer: int) -> str:
+        with self._lock:
+            return self._state.get(peer, PeerState.HEALTHY)
+
+    def snapshot(self, round: Optional[int] = None) -> dict:
+        """JSON-ready health snapshot for metrics / the /healthz endpoint.
+
+        Per remote peer: state, suspicion, quarantine accounting, and the
+        detector's EWMA statistics."""
+        with self._lock:
+            r = self._clock(round)
+            peers = {}
+            for p in range(self.n_peers):
+                if p == self.me:
+                    continue
+                state = self._state.get(p, PeerState.HEALTHY)
+                quarantined_rounds = self._quarantined_rounds.get(p, 0)
+                if state == PeerState.QUARANTINED:
+                    quarantined_rounds += max(
+                        0, r - self._quarantined_at.get(p, r)
+                    )
+                info = self.detector.snapshot(p)
+                info.update(
+                    state=state,
+                    quarantined_rounds=quarantined_rounds,
+                    quarantines=self._quarantines.get(p, 0),
+                    release_round=(
+                        self._release_round.get(p)
+                        if state == PeerState.QUARANTINED
+                        else None
+                    ),
+                    probe_attempts=self._probe_attempts.get(p, 0),
+                    probe_successes=self._probe_successes.get(p, 0),
+                )
+                peers[p] = info
+            return {"me": self.me, "round": r, "peers": peers}
+
+
+def run_probe(
+    probe_fn: Callable[[], bool], scoreboard: Scoreboard, peer: int,
+    round: Optional[int] = None,
+) -> bool:
+    """Execute a re-admission probe and feed the result back in one step.
+
+    ``probe_fn`` is the transport's cheap header-only probe (for TCP,
+    :func:`dpwa_tpu.parallel.tcp.probe_header` bound to the peer's
+    address); any exception counts as a failed probe."""
+    try:
+        ok = bool(probe_fn())
+    except Exception:
+        ok = False
+    scoreboard.record_probe(peer, ok, round)
+    return ok

@@ -96,7 +96,15 @@ def _clamped(strategy: Interpolation) -> Interpolation:
     LOCAL metadata is non-finite and the peer's is healthy, α = 1 —
     adopting the healthy peer is exactly the rescue gossip offers a
     diverged replica.  In every other non-finite case α = 0 (keep the
-    local replica, the same keep-training posture as a failed fetch)."""
+    local replica, the same keep-training posture as a failed fetch).
+
+    Note the rescue keys on NON-FINITE metadata only (NaN/inf clock or
+    loss).  A replica whose loss is finite but enormous — diverging, not
+    yet diverged — takes the ordinary path: the strategy's raw α (e.g.
+    ``loss_weighted``'s ratio → ``factor`` as local loss dominates) is
+    clipped into [0, 1], so it pulls strongly toward the healthier peer,
+    capped at ``min(factor, 1)``, but never snaps to wholesale adoption.
+    Only an actually-poisoned replica gets the α = 1 rescue."""
 
     def alpha(local: PeerMeta, remote: PeerMeta) -> jnp.ndarray:
         a = strategy(local, remote)

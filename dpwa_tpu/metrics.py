@@ -130,6 +130,45 @@ class MetricsLogger:
             extra,
         )
 
+    def log_health(
+        self, step: int, snapshot: Mapping[str, Any], **extra: Any
+    ) -> None:
+        """One health record from a scoreboard snapshot
+        (:meth:`dpwa_tpu.parallel.tcp.TcpTransport.health_snapshot`).
+
+        Flattens the per-peer dict into parallel lists keyed by ``peer``
+        so downstream tooling (tools/health_report.py, jq one-liners)
+        can read columns without walking nested objects:
+
+        - ``peer_state`` — scoreboard state per remote peer;
+        - ``suspicion`` — detector suspicion score per remote peer;
+        - ``quarantined_rounds`` — lifetime rounds spent quarantined;
+
+        plus attempt/success/quarantine counters.  Obeys ``every`` like
+        every other record; written immediately (health snapshots are
+        plain host dicts — nothing to defer)."""
+        if step % self.every != 0:
+            return
+        peers = snapshot.get("peers", {})
+        order = sorted(peers)
+        cols = lambda key: [peers[p].get(key) for p in order]  # noqa: E731
+        self.log(
+            step,
+            record="health",
+            me=snapshot.get("me"),
+            round=snapshot.get("round"),
+            peer=[int(p) for p in order],
+            peer_state=cols("state"),
+            suspicion=cols("suspicion"),
+            quarantined_rounds=cols("quarantined_rounds"),
+            quarantines=cols("quarantines"),
+            attempts=cols("attempts"),
+            failures=cols("failures"),
+            probe_attempts=cols("probe_attempts"),
+            last_outcome=cols("last_outcome"),
+            **extra,
+        )
+
     def flush(self) -> None:
         """Write the deferred record, if any (blocks only on its arrays)."""
         if self._pending is None:

@@ -68,15 +68,49 @@ def _sidecar_content() -> str:
 
 
 def _hostinfo_paths() -> list:
-    """Candidate record locations: beside the .so, else the tempdir
-    (read-only installs can't write the package dir; without a fallback
-    every process would re-pay the failed-build + subprocess-smoke
-    sequence at startup, forever)."""
+    """Candidate record locations: beside the .so, else a PER-USER
+    subdirectory of the tempdir (read-only installs can't write the
+    package dir; without a fallback every process would re-pay the
+    failed-build + subprocess-smoke sequence at startup, forever).
+
+    The fallback must not live in the world-writable tempdir root: any
+    local user could pre-create the record file there and vouch for a
+    .so this host never validated (the record is what SKIPS the SIGILL
+    smoke test).  ``dpwa_<uid>`` at mode 0700 scopes trust to the user;
+    a directory with the wrong owner or group/other access is rejected
+    outright rather than trusted."""
     key = hashlib.sha256(_LIB.encode()).hexdigest()[:16]
-    return [
-        _HOSTINFO,
-        os.path.join(tempfile.gettempdir(), f"dpwa_native_{key}.host"),
-    ]
+    paths = [_HOSTINFO]
+    user_dir = os.path.join(
+        tempfile.gettempdir(), f"dpwa_{os.getuid()}"
+    ) if hasattr(os, "getuid") else None
+    if user_dir is not None and _own_private_dir(user_dir):
+        paths.append(os.path.join(user_dir, f"dpwa_native_{key}.host"))
+    return paths
+
+
+def _own_private_dir(path: str) -> bool:
+    """Ensure ``path`` is a directory owned by this uid with no group/
+    other permissions, creating it 0700 if absent.  False means the
+    location can't be trusted (symlinked, squatted, or loosened by
+    another user) and the caller must skip it."""
+    try:
+        os.makedirs(path, mode=0o700, exist_ok=True)
+        # makedirs applies the umask on creation and does nothing on an
+        # existing dir — stat, then tighten only if we own it.
+        st = os.lstat(path)
+        import stat as _stat
+
+        if not _stat.S_ISDIR(st.st_mode) or st.st_uid != os.getuid():
+            return False
+        if st.st_mode & 0o077:
+            os.chmod(path, 0o700)
+            st = os.lstat(path)
+            if st.st_mode & 0o077:
+                return False
+        return True
+    except OSError:
+        return False
 
 
 def _write_hostinfo() -> None:

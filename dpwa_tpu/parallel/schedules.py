@@ -69,6 +69,57 @@ def fault_draw(seed, step, pair_id, drop_probability):
     return jax.random.uniform(_pair_key(seed, step, pair_id, 1)) < drop_probability
 
 
+def fallback_draw(seed, step, me, n_candidates: int):
+    """Index of the fallback partner a peer reroutes to when its scheduled
+    partner is quarantined (tag 3 — independent of the participation,
+    fault, and pool streams).
+
+    Keyed on ``(seed, step, me)`` only: every lock-step replica holding
+    the same healthy-peer view draws the same fallback, so the
+    health-aware remap (:meth:`Schedule.remap_partner`) preserves
+    bit-identical behavior across replicas — the same property the
+    participation draw guarantees."""
+    return jax.random.randint(
+        _pair_key(seed, step, me, 3), (), 0, n_candidates
+    )
+
+
+def backoff_jitter_draw(seed, peer, streak, jitter_rounds: int) -> int:
+    """Deterministic quarantine-backoff jitter in ``[0, jitter_rounds]``
+    (tag 4), keyed on ``(seed, peer, consecutive-quarantine count)``.
+
+    Jitter de-synchronizes probe storms (many fetchers re-probing a
+    recovered peer on the same round) without sacrificing run-to-run
+    reproducibility — the chaos acceptance test replays byte-identical
+    quarantine windows under a fixed seed."""
+    if jitter_rounds <= 0:
+        return 0
+    return int(
+        jax.random.randint(
+            _pair_key(seed, peer, streak, 4), (), 0, jitter_rounds + 1
+        )
+    )
+
+
+# Chaos fault-kind tags start at 16: far clear of the control-plane tags
+# (0 participation, 1 fault, 2 pool, 3 fallback, 4 backoff jitter), so
+# new control draws can claim 5..15 without colliding with fault kinds.
+CHAOS_TAG_BASE = 16
+
+
+def chaos_draw(seed, step, peer, kind: int):
+    """Uniform [0, 1) draw on the chaos-harness fault stream.
+
+    One independent threefry stream per ``(peer, fault kind)`` — kinds
+    index from :data:`CHAOS_TAG_BASE` — keyed on the gossip round, so
+    injected faults are schedule-locked: a given (seed, round, peer)
+    always injects the same fault, in tests and in a ``chaos:``-config
+    soak alike (the same design as :func:`fault_draw`)."""
+    return float(
+        jax.random.uniform(_pair_key(seed, step, peer, CHAOS_TAG_BASE + kind))
+    )
+
+
 def pool_branch_draw(seed, step, pool_size: int, periodic: bool):
     """Pool index in effect at ``step`` — traced or host, same stream.
 
@@ -377,6 +428,33 @@ class Schedule:
 
     def partner(self, step: int, i: int) -> int:
         return int(self.pairing(step)[i])
+
+    def remap_partner(
+        self, step: int, i: int, partner: int, healthy_mask
+    ) -> int:
+        """Health-aware fallback: the peer ``i`` fetches at ``step`` when
+        its scheduled ``partner`` is quarantined.
+
+        Candidates are every peer that is healthy per ``healthy_mask``
+        (indexable by peer id), excluding ``i`` itself and the sick
+        ``partner``; the pick is a :func:`fallback_draw` over the
+        candidate list in index order.  Deterministic: replicas that
+        agree on the healthy set agree on the remap — and a remapped
+        round is a one-sided pull (the fallback peer's Rx server serves
+        any fetcher; it does not reciprocate), which pairwise averaging
+        tolerates the same way the reference's random pulls do.
+
+        No healthy candidate ⇒ returns ``i`` (self-pair, i.e. the round
+        is skipped — the all-peers-dead posture is solo training)."""
+        candidates = [
+            p
+            for p in range(self.n_peers)
+            if p != i and p != partner and healthy_mask[p]
+        ]
+        if not candidates:
+            return i
+        idx = int(fallback_draw(self.seed, step, i, len(candidates)))
+        return candidates[idx]
 
     def participates(self, step: int, i: int) -> bool:
         """Host-side participation draw — the same threefry stream the jit

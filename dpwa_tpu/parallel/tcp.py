@@ -39,6 +39,10 @@ import numpy as np
 
 from dpwa_tpu import native
 from dpwa_tpu.config import DEFAULT_MIN_WIRE_MB_PER_S, DpwaConfig
+# detector/scoreboard import config + schedules only — no cycle; chaos
+# (which imports THIS module) is loaded lazily inside TcpTransport.
+from dpwa_tpu.health.detector import Outcome
+from dpwa_tpu.health.scoreboard import Scoreboard
 from dpwa_tpu.interpolation import PeerMeta, make_interpolation
 from dpwa_tpu.parallel.schedules import Schedule, build_schedule
 
@@ -187,17 +191,23 @@ class PeerServer:
                 break
             try:
                 conn.settimeout(5.0)
-                req = _recv_exact(conn, len(_REQ))
-                if req != _REQ:
-                    continue
-                with self._lock:
-                    payload = self._payload
-                if payload is not None:
-                    conn.sendall(payload)
+                self._handle(conn)
             except OSError:
                 pass
             finally:
                 conn.close()
+
+    def _handle(self, conn: socket.socket) -> None:
+        """Serve one accepted connection.  Split out of the accept loop
+        so the chaos harness (health/chaos.py) can wrap per-connection
+        behavior without duplicating the listener."""
+        req = _recv_exact(conn, len(_REQ))
+        if req != _REQ:
+            return
+        with self._lock:
+            payload = self._payload
+        if payload is not None:
+            conn.sendall(payload)
 
     def close(self) -> None:
         self._stop.set()
@@ -250,16 +260,28 @@ def make_peer_server(host: str, port: int):
     return PeerServer(host, port)
 
 
-def fetch_blob(
+def fetch_blob_ex(
     host: str,
     port: int,
     timeout_ms: int,
     min_bandwidth_bps: float = _MIN_WIRE_BANDWIDTH,
-) -> Optional[Tuple[np.ndarray, float, float]]:
-    """Connect to a peer's Rx thread and pull its latest blob.
+) -> Tuple[
+    Optional[Tuple[np.ndarray, float, float]], str, float, int
+]:
+    """:func:`fetch_blob` plus the classified outcome the health
+    subsystem feeds on.
 
-    Returns None on timeout / refused connection / malformed reply — the
-    caller skips the merge and keeps training, like the reference.
+    Returns ``(result, outcome, latency_s, payload_bytes_received)``
+    where ``result`` is ``(vec, clock, loss)`` or None and ``outcome``
+    is one of :class:`dpwa_tpu.health.detector.Outcome`:
+
+    - ``refused`` — the connect itself failed (peer process gone);
+    - ``timeout`` — the cumulative deadline expired (connect, request,
+      header, or a payload stream below the bandwidth floor);
+    - ``short_read`` — the peer closed or reset mid-frame;
+    - ``corrupt`` — bad magic/version/dtype, oversize advertisement, or
+      an int8 payload that failed to decode;
+    - ``success`` — a full, valid frame.
 
     ``timeout_ms`` is a CUMULATIVE wall-clock budget enforced via a
     monotonic deadline threaded through :func:`_recv_exact` — not a
@@ -271,25 +293,45 @@ def fetch_blob(
     scales with the replica actually flowing instead of rejecting every
     blob larger than bandwidth × timeout_ms — and a peer that merely
     ADVERTISES a huge payload earns nothing."""
-    deadline = time.monotonic() + timeout_ms / 1000.0
+    t0 = time.monotonic()
+    deadline = t0 + timeout_ms / 1000.0
+    nbytes_rx = 0
     try:
-        with socket.create_connection(
+        sock = socket.create_connection(
             (host, port), timeout=timeout_ms / 1000.0
-        ) as sock:
-            # create_connection leaves the connect timeout on the socket,
-            # bounding sendall; both recv loops run against the deadline.
+        )
+    except socket.timeout:
+        return None, Outcome.TIMEOUT, time.monotonic() - t0, 0
+    except (ConnectionError, OSError):
+        # Refused, unreachable, reset during handshake: no peer process
+        # is answering on that port.
+        return None, Outcome.REFUSED, time.monotonic() - t0, 0
+    try:
+        with sock:
+            # The request send draws from the SAME cumulative budget as
+            # the reads: create_connection leaves only the connect
+            # timeout on the socket, which restarts the clock — a peer
+            # that accepts but never reads (full Rx backlog) would get a
+            # fresh window for sendall on top of a spent deadline.
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise socket.timeout(
+                    "cumulative fetch deadline exceeded before request"
+                )
+            sock.settimeout(remaining)
             sock.sendall(_REQ)
             raw = _recv_exact(sock, _HDR.size, deadline)
             magic, version, code, clock, loss, nbytes = _HDR.unpack(raw)
             if magic != _MAGIC or version != 1 or (
                 code not in _DTYPES and code != _INT8_CHUNKED
             ):
-                return None
+                return None, Outcome.CORRUPT, time.monotonic() - t0, 0
             if nbytes > _MAX_BLOB:
-                return None
+                return None, Outcome.CORRUPT, time.monotonic() - t0, 0
             data = _recv_exact(
                 sock, nbytes, deadline, 1.0 / min_bandwidth_bps
             )
+            nbytes_rx = len(data)
             if code == _INT8_CHUNKED:
                 # Receiver-side dequantize: the wire moved 1 byte/elem
                 # (+ scales); the merge math runs on the f32 decode.
@@ -300,12 +342,70 @@ def fetch_blob(
                         np.frombuffer(data, dtype=np.uint8)
                     )
                 except ValueError:
-                    return None  # malformed payload == skipped fetch
+                    # malformed payload == skipped fetch
+                    return (
+                        None, Outcome.CORRUPT,
+                        time.monotonic() - t0, nbytes_rx,
+                    )
             else:
                 vec = np.frombuffer(data, dtype=_DTYPES[code]).copy()
-            return vec, clock, loss
-    except (OSError, ConnectionError):
-        return None
+            return (
+                (vec, clock, loss), Outcome.SUCCESS,
+                time.monotonic() - t0, nbytes_rx,
+            )
+    except socket.timeout:
+        return None, Outcome.TIMEOUT, time.monotonic() - t0, nbytes_rx
+    except (ConnectionError, OSError):
+        # Accepted, then closed/reset mid-frame: the peer process is
+        # alive enough to accept but served a broken stream.
+        return None, Outcome.SHORT_READ, time.monotonic() - t0, nbytes_rx
+
+
+def fetch_blob(
+    host: str,
+    port: int,
+    timeout_ms: int,
+    min_bandwidth_bps: float = _MIN_WIRE_BANDWIDTH,
+) -> Optional[Tuple[np.ndarray, float, float]]:
+    """Connect to a peer's Rx thread and pull its latest blob.
+
+    Returns None on timeout / refused connection / malformed reply — the
+    caller skips the merge and keeps training, like the reference.  Thin
+    wrapper over :func:`fetch_blob_ex`, which additionally classifies
+    the failure for the health subsystem; see it for deadline
+    semantics."""
+    return fetch_blob_ex(host, port, timeout_ms, min_bandwidth_bps)[0]
+
+
+def probe_header(host: str, port: int, timeout_ms: int = 100) -> bool:
+    """Cheap liveness probe: connect, request, validate the HEADER only.
+
+    The re-admission check for a quarantined peer — it answers "is a
+    live dpwa Rx serving a well-formed frame there?" without pulling the
+    payload (a full replica would cost the quarantined-peer path the
+    very bandwidth quarantine exists to save).  The connection is
+    abandoned after the header; the Rx side's sendall into a closed
+    socket is its normal ``OSError -> close`` path."""
+    deadline = time.monotonic() + timeout_ms / 1000.0
+    try:
+        with socket.create_connection(
+            (host, port), timeout=timeout_ms / 1000.0
+        ) as sock:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return False
+            sock.settimeout(remaining)
+            sock.sendall(_REQ)
+            raw = _recv_exact(sock, _HDR.size, deadline)
+            magic, version, code, _clock, _loss, nbytes = _HDR.unpack(raw)
+            return (
+                magic == _MAGIC
+                and version == 1
+                and (code in _DTYPES or code == _INT8_CHUNKED)
+                and nbytes <= _MAX_BLOB
+            )
+    except (OSError, ConnectionError, struct.error):
+        return False
 
 
 def _host_merge(
@@ -343,11 +443,17 @@ class _OverlappedExchange:
     ):
         self._t = transport
         self._clock, self._loss = clock, loss
+        self._step = step
         # Gossip replicas are symmetric: the partner's payload is the
-        # same size as what we just published.  Sizes the join backstop
-        # the same way fetch_blob's deadline scales.
+        # same size (in WIRE bytes) as what we just published.  Sizes
+        # the join backstop the same way fetch_blob's deadline scales.
         self._expected_nbytes = expected_nbytes
-        self.partner = transport.schedule.partner(step, transport.me)
+        self.sched_partner, self.partner, self.remapped = (
+            transport._resolve_partner(step)
+        )
+        # Participation is gated on the ORIGINAL schedule pairing (same
+        # threefry draw as the ICI path); a health remap changes only
+        # WHO gets fetched, never WHETHER this round merges.
         self._participates = (
             self.partner != transport.me
             and transport.schedule.participates(step, transport.me)
@@ -360,7 +466,7 @@ class _OverlappedExchange:
             return
 
         def _fetch():
-            self._got = self._t.fetch(self.partner)
+            self._got = self._t.fetch(self.partner, step=self._step)
 
         self._thread = threading.Thread(target=_fetch, daemon=True)
         self._thread.start()
@@ -373,10 +479,17 @@ class _OverlappedExchange:
             # per-byte-received extension (fetch_blob's scaled deadline);
             # the join backstop must allow the same worst case — a fixed
             # 2.5 s join would abandon large-replica fetches the deadline
-            # deliberately tolerates, silently skipping every merge.  A
-            # timed-out join skips the round like any other failed fetch.
+            # deliberately tolerates, silently skipping every merge.
+            # ``_expected_nbytes`` is the WIRE size of the partner frame
+            # (int8/bf16-aware: the deadline earns budget only for bytes
+            # actually on the wire, so sizing from the f32 replica would
+            # inflate the backstop 4x under int8), and timeout_ms appears
+            # exactly once: the deadline already folds it in, so the
+            # slack term is a fixed 1 s for thread scheduling, not a
+            # second copy of the timeout.  A timed-out join skips the
+            # round like any other failed fetch.
             self._thread.join(
-                timeout=2.0
+                timeout=1.0
                 + self._t.config.protocol.timeout_ms / 1000.0
                 + self._expected_nbytes
                 / (self._t.config.protocol.min_wire_mb_per_s * 1e6)
@@ -432,10 +545,43 @@ class TcpTransport:
         if self._wire_bf16 and ml_dtypes is None:  # pragma: no cover
             raise RuntimeError("wire_dtype bf16 requires ml_dtypes")
         spec = config.nodes[self.me]
-        self.server = make_peer_server(spec.host, spec.port)
+        if config.chaos.enabled:
+            # Chaos wraps the PYTHON Rx server (fault injection needs
+            # per-connection control of the serve loop); the import is
+            # deferred because health.chaos imports this module.
+            from dpwa_tpu.health.chaos import ChaosEngine, ChaosPeerServer
+
+            self.server = ChaosPeerServer(
+                spec.host, spec.port, ChaosEngine(config.chaos, self.me)
+            )
+        else:
+            self.server = make_peer_server(spec.host, spec.port)
         self._ports = {
             i: (n.host, n.port) for i, n in enumerate(config.nodes)
         }
+        # Peer-health control plane: every fetch outcome feeds the
+        # scoreboard; quarantined partners are remapped in
+        # _resolve_partner.  health.enabled=False restores the seed's
+        # raw skip-on-timeout behavior exactly.
+        self.scoreboard: Optional[Scoreboard] = (
+            Scoreboard(
+                len(config.nodes), self.me, config.health,
+                seed=self.schedule.seed,
+            )
+            if config.health.enabled
+            else None
+        )
+        self.healthz = None
+        if config.health.enabled and config.health.healthz_port is not None:
+            from dpwa_tpu.health.endpoint import HealthzServer
+
+            self.healthz = HealthzServer(
+                self.health_snapshot, spec.host, config.health.healthz_port
+            )
+        # Bookkeeping for metrics/adapters: last fetch outcome and the
+        # last round's partner resolution (schedule vs. health remap).
+        self.last_fetch: dict = {}
+        self.last_round: dict = {}
 
     @property
     def port(self) -> int:
@@ -466,15 +612,79 @@ class TcpTransport:
         self.server.publish(vec, clock, loss)
 
     def fetch(
-        self, peer_index: int, timeout_ms: Optional[int] = None
+        self,
+        peer_index: int,
+        timeout_ms: Optional[int] = None,
+        step: Optional[int] = None,
     ) -> Optional[Tuple[np.ndarray, float, float]]:
         host, port = self._ports[peer_index]
         if timeout_ms is None:
             timeout_ms = self.config.protocol.timeout_ms
-        return fetch_blob(
+        got, outcome, latency_s, nbytes = fetch_blob_ex(
             host, port, timeout_ms,
             min_bandwidth_bps=self.config.protocol.min_wire_mb_per_s * 1e6,
         )
+        self.last_fetch = {
+            "peer": peer_index, "outcome": outcome,
+            "latency_s": latency_s, "nbytes": nbytes,
+        }
+        if self.scoreboard is not None:
+            self.scoreboard.record(
+                peer_index, outcome,
+                latency_s=latency_s, nbytes=nbytes, round=step,
+            )
+        return got
+
+    def _resolve_partner(self, step: int) -> Tuple[int, int, bool]:
+        """Health-aware partner resolution: ``(scheduled, actual,
+        remapped)`` for this round.
+
+        If the scheduled partner is quarantined and its backoff has
+        elapsed, spend a cheap header-only probe first (probes ride the
+        pairing rounds that would have fetched from it anyway, so the
+        probe budget is self-rationing).  If it is (still) quarantined
+        after that, remap to a threefry-drawn healthy fallback
+        (:meth:`Schedule.remap_partner`) — replicas sharing the same
+        scoreboard view make the identical draw, and with health
+        disabled this degrades to the plain schedule partner."""
+        sched = self.schedule.partner(step, self.me)
+        partner, remapped = sched, False
+        sb = self.scoreboard
+        if sb is not None and sched != self.me:
+            if sb.probe_due(sched, step):
+                host, port = self._ports[sched]
+                ok = probe_header(
+                    host, port, self.config.health.probe_timeout_ms
+                )
+                sb.record_probe(sched, ok, round=step)
+            if sb.is_quarantined(sched, step):
+                partner = self.schedule.remap_partner(
+                    step, self.me, sched, sb.healthy_mask(step)
+                )
+                remapped = True
+        return sched, partner, remapped
+
+    def health_snapshot(self) -> dict:
+        """JSON-ready per-peer health state (scoreboard + detector
+        EWMAs); the payload behind metrics' ``health`` records and the
+        optional /healthz endpoint."""
+        if self.scoreboard is None:
+            return {"me": self.me, "round": 0, "peers": {}}
+        return self.scoreboard.snapshot()
+
+    def _wire_nbytes(self, vec: np.ndarray) -> int:
+        """Bytes the published frame's PAYLOAD occupies on the wire —
+        what a symmetric partner fetch will actually stream, used to
+        size the overlapped-join backstop.  Mirrors :meth:`publish`'s
+        encoding choice exactly."""
+        n = int(vec.size)
+        if self._wire_int8 and vec.dtype == np.float32:
+            from dpwa_tpu.ops.quantize import _n_chunks
+
+            return 8 + 4 * _n_chunks(n) + n  # u64 n | f32 scales | int8 q
+        if self._wire_bf16 and vec.dtype == np.float32:
+            return 2 * n
+        return int(vec.nbytes)
 
     def _weigh_remote(
         self, got: Tuple[np.ndarray, float, float], clock: float, loss: float
@@ -501,10 +711,18 @@ class TcpTransport:
         partner); None means the round was skipped (self-pair, masked, or
         fetch timeout) and the caller keeps its vector untouched."""
         self.publish(vec, clock, loss)
-        partner = self.schedule.partner(step, self.me)
+        sched, partner, remapped = self._resolve_partner(step)
+        self.last_round = {
+            "step": step, "sched_partner": sched, "partner": partner,
+            "remapped": remapped, "outcome": None,
+        }
+        # Participation stays keyed on the ORIGINAL pairing (identical
+        # threefry draw to the ICI path); remap changes only the fetch
+        # target.  A remap to self (no healthy candidate) skips.
         if partner == self.me or not self.schedule.participates(step, self.me):
             return None, 0.0, partner
-        got = self.fetch(partner)
+        got = self.fetch(partner, step=step)
+        self.last_round["outcome"] = self.last_fetch.get("outcome")
         if got is None:
             return None, 0.0, partner  # dead/slow peer: skip, keep training
         remote_vec, alpha = self._weigh_remote(got, clock, loss)
@@ -539,7 +757,7 @@ class TcpTransport:
         :func:`dpwa_tpu.train.make_gossip_train_step`."""
         self.publish(vec, clock, loss)
         ex = _OverlappedExchange(
-            self, clock, loss, step, expected_nbytes=int(vec.nbytes)
+            self, clock, loss, step, expected_nbytes=self._wire_nbytes(vec)
         )
         ex.start()
         return ex
@@ -571,4 +789,6 @@ class TcpTransport:
         return _device_lerp(vec_dev, remote_vec, alpha), alpha, partner
 
     def close(self) -> None:
+        if self.healthz is not None:
+            self.healthz.close()
         self.server.close()

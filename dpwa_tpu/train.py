@@ -13,7 +13,16 @@ of the per-peer replicas, which is both a performance bug (the whole point
 of gossip is that nothing is globally gathered) and a deadlock on
 thread-starved CPU test meshes.  Inside ``shard_map`` every peer's
 forward/backward/optimizer math is provably local; the **only** collective
-in the compiled program is the pairing ``ppermute`` of the exchange."""
+in the compiled program is the pairing ``ppermute`` of the exchange.
+
+Elasticity note: inside one SPMD program there are no independently
+failing peers — a fault injected via ``fault_probability`` (or the chaos
+harness on the TCP path) surfaces to this loop as an α = 0 round: the
+replica keeps training on its own.  The peer-health control plane
+(:mod:`dpwa_tpu.health` — suspicion, quarantine/backoff, probe
+re-admission, fallback remap) lives on the multi-process TCP path, where
+peers genuinely die and come back; its scoreboard state is observable via
+metrics ``health`` records and the optional ``/healthz`` endpoint."""
 
 from __future__ import annotations
 

@@ -115,24 +115,35 @@ def tree_size_bytes(tree: PyTree) -> int:
     )
 
 
-def tree_wire_bytes(tree: PyTree, wire_dtype: str = "f32") -> int:
+def tree_wire_bytes(
+    tree: PyTree, wire_dtype: str = "f32", padded: bool = True
+) -> int:
     """Per-exchange bytes actually SHIPPED at a wire format.
 
     ``protocol.wire_dtype`` compresses only f32 leaves (bf16: 2 bytes/
-    element; int8: 1 byte per element PADDED to whole
-    :data:`dpwa_tpu.ops.quantize.CHUNK`-element chunks — the ICI
-    collective ships the padded code block — plus one f32 scale per
-    chunk); other dtypes ship as-is.  This is the number
-    ``exchanged_bytes`` metrics should report under a compressed wire —
-    ``tree_size_bytes`` is the uncompressed replica size.
+    element; int8: 1 byte per element plus one f32 scale per
+    :data:`dpwa_tpu.ops.quantize.CHUNK`-element chunk); other dtypes
+    ship as-is.  This is the number ``exchanged_bytes`` metrics should
+    report under a compressed wire — ``tree_size_bytes`` is the
+    uncompressed replica size.
 
-    The int8 figure is exact for the ICI collective, which quantizes and
-    ships each leaf's padded code block.  The TCP transport instead
-    quantizes the FLATTENED replica — one stream of 8-byte length +
-    4 bytes/chunk scales + UNPADDED codes, inside a 30-byte frame — so
-    for trees with many small f32 leaves this per-leaf figure overstates
-    TCP traffic (up to CHUNK−1 padding bytes per leaf, and one whole
-    chunk for a zero-size leaf) and omits the fixed framing."""
+    ``padded`` selects WHICH transport's int8 accounting you get (the
+    two ship genuinely different byte counts; bf16/f32 are identical
+    either way):
+
+    - ``padded=True`` (default) — the ICI collective's figure: each f32
+      leaf quantized and shipped as its own code block, padded to whole
+      chunks (``(CHUNK + 4) · n_chunks(leaf.size)``).  Exact for the
+      SPMD path; for trees with many small f32 leaves it overstates TCP
+      traffic (up to CHUNK−1 padding bytes per leaf, one whole chunk
+      for a zero-size leaf) and omits framing.
+    - ``padded=False`` — the TCP transport's figure: the FLATTENED
+      concatenation of all f32 leaves quantized as ONE stream
+      (``ops/quantize.encode_int8_payload``: 8-byte length + 4 bytes
+      per chunk of the total + UNPADDED codes), exact to the byte for
+      the payload ``TcpTransport.publish`` frames under
+      ``wire_dtype: int8`` (the fixed 30-byte frame header is not
+      included).  Non-f32 leaves still ship as-is."""
     if wire_dtype not in ("f32", "bf16", "int8"):
         raise ValueError(f"unknown wire_dtype {wire_dtype!r}")
     if wire_dtype == "f32":
@@ -140,12 +151,18 @@ def tree_wire_bytes(tree: PyTree, wire_dtype: str = "f32") -> int:
     from dpwa_tpu.ops.quantize import CHUNK, _n_chunks
 
     total = 0
+    f32_elems = 0
     for leaf in jax.tree_util.tree_leaves(tree):
         if leaf.dtype == jnp.float32:
             if wire_dtype == "bf16":
                 total += leaf.size * 2
-            else:  # int8: padded codes + scales, as the collective ships
+            elif padded:  # int8: per-leaf padded blocks, as ICI ships
                 total += (CHUNK + 4) * _n_chunks(leaf.size)
+            else:  # int8 unpadded: f32 leaves pool into one TCP stream
+                f32_elems += leaf.size
         else:
             total += leaf.size * leaf.dtype.itemsize
+    if wire_dtype == "int8" and not padded and f32_elems:
+        # u64 length | f32 scale per chunk of the TOTAL | unpadded codes
+        total += 8 + 4 * _n_chunks(f32_elems) + f32_elems
     return total

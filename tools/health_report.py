@@ -1,0 +1,147 @@
+#!/usr/bin/env python
+"""Summarize per-peer health from a dpwa metrics JSONL file.
+
+Stdlib-only companion to the ``health`` records that
+:meth:`dpwa_tpu.metrics.MetricsLogger.log_health` writes (and the
+per-update exchange records ``DpwaTcpAdapter`` emits when given a
+metrics logger).  Reads one or more JSONL files and prints, per remote
+peer:
+
+- final scoreboard state and suspicion;
+- lifetime rounds spent quarantined, quarantine count, probe stats;
+- fetch outcome tallies from the exchange records (including how many
+  rounds were remapped away from the peer while it was quarantined).
+
+Usage::
+
+    python tools/health_report.py metrics.jsonl [more.jsonl ...]
+    python tools/health_report.py --json metrics.jsonl   # machine-readable
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, Iterable
+
+
+def _iter_records(paths: Iterable[str]):
+    for path in paths:
+        stream = sys.stdin if path == "-" else open(path, encoding="utf-8")
+        try:
+            for line in stream:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    yield json.loads(line)
+                except json.JSONDecodeError:
+                    continue  # half-written tail line of a live run
+        finally:
+            if stream is not sys.stdin:
+                stream.close()
+
+
+def summarize(paths: Iterable[str]) -> Dict[str, Any]:
+    """Fold every record into one per-peer summary dict."""
+    peers: Dict[int, Dict[str, Any]] = {}
+    last_health: Dict[int, Dict[str, Any]] = {}
+    n_exchange = n_health = 0
+    last_step = None
+
+    def slot(p: int) -> Dict[str, Any]:
+        return peers.setdefault(
+            int(p),
+            {
+                "fetches": 0,
+                "outcomes": {},
+                "remapped_to": 0,  # rounds rerouted TO this peer
+                "remapped_away": 0,  # scheduled here but rerouted away
+            },
+        )
+
+    for rec in _iter_records(paths):
+        last_step = rec.get("step", last_step)
+        if rec.get("record") == "health":
+            n_health += 1
+            for i, p in enumerate(rec.get("peer", [])):
+                last_health[int(p)] = {
+                    "state": rec["peer_state"][i],
+                    "suspicion": rec["suspicion"][i],
+                    "quarantined_rounds": rec["quarantined_rounds"][i],
+                    "quarantines": rec.get("quarantines", [None] * (i + 1))[i],
+                    "probe_attempts": rec.get(
+                        "probe_attempts", [None] * (i + 1)
+                    )[i],
+                    "at_step": rec.get("step"),
+                }
+            continue
+        if "outcome" not in rec and "sched_partner" not in rec:
+            continue  # not an exchange record (loss-only, etc.)
+        n_exchange += 1
+        sched, actual = rec.get("sched_partner"), rec.get("partner")
+        if actual is not None and rec.get("outcome") is not None:
+            s = slot(actual)
+            s["fetches"] += 1
+            out = rec["outcome"]
+            s["outcomes"][out] = s["outcomes"].get(out, 0) + 1
+        if rec.get("remapped") and sched is not None:
+            slot(sched)["remapped_away"] += 1
+            if actual is not None and actual != sched:
+                slot(actual)["remapped_to"] += 1
+
+    for p, h in last_health.items():
+        slot(p)["health"] = h
+    return {
+        "records": {"exchange": n_exchange, "health": n_health},
+        "last_step": last_step,
+        "peers": {p: peers[p] for p in sorted(peers)},
+    }
+
+
+def _print_table(summary: Dict[str, Any]) -> None:
+    recs = summary["records"]
+    print(
+        f"# {recs['exchange']} exchange records, {recs['health']} health "
+        f"records, last step {summary['last_step']}"
+    )
+    hdr = (
+        f"{'peer':>4}  {'state':<12} {'suspicion':>9}  {'q_rounds':>8} "
+        f"{'fetches':>7}  {'remap->':>7} {'remap<-':>7}  outcomes"
+    )
+    print(hdr)
+    print("-" * len(hdr))
+    for p, s in summary["peers"].items():
+        h = s.get("health", {})
+        susp = h.get("suspicion")
+        print(
+            f"{p:>4}  {h.get('state', '-'):<12} "
+            f"{susp if susp is None else round(susp, 3)!s:>9}  "
+            f"{h.get('quarantined_rounds', '-')!s:>8} "
+            f"{s['fetches']:>7}  {s['remapped_to']:>7} "
+            f"{s['remapped_away']:>7}  "
+            + ", ".join(
+                f"{k}={v}" for k, v in sorted(s["outcomes"].items())
+            )
+        )
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="+", help="metrics JSONL file(s), or -")
+    ap.add_argument(
+        "--json", action="store_true", help="emit the summary as JSON"
+    )
+    args = ap.parse_args(argv)
+    summary = summarize(args.paths)
+    if args.json:
+        json.dump(summary, sys.stdout, indent=2)
+        print()
+    else:
+        _print_table(summary)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
