@@ -12,13 +12,16 @@ Two flavors:
 
 from __future__ import annotations
 
-from typing import Any, Optional, Union
+import os
+from typing import Any, Callable, Optional, Union
 
 import numpy as np
 
 from dpwa_tpu.config import DpwaConfig, load_config
 from dpwa_tpu.metrics import MetricsLogger
 from dpwa_tpu.parallel.tcp import TcpTransport
+from dpwa_tpu.recovery.guard import RollbackRing, validate_payload
+from dpwa_tpu.recovery.state_transfer import pack_state
 from dpwa_tpu.utils.pytree import ravel
 
 PyTree = Any
@@ -36,7 +39,22 @@ class DpwaTcpAdapter:
     scheduled vs. actual partner, fetch outcome — plus a periodic
     ``health`` record from the transport's scoreboard every
     ``health_every`` updates.  These records are what
-    ``tools/health_report.py`` summarizes."""
+    ``tools/health_report.py`` summarizes.
+
+    With ``recovery.enabled`` (the default) the adapter additionally:
+
+    - serves its serialized state (replica + clock/step/loss + the
+      optional ``state_extra()`` dict, e.g. a data-stream position) for
+      peers to bootstrap from;
+    - keeps a :class:`~dpwa_tpu.recovery.guard.RollbackRing` of
+      last-good snapshots and rolls the LOCAL replica back when a step's
+      (vec, loss) trips the divergence guard — emitting a ``rollback``
+      event into the metrics JSONL;
+    - on construction with ``bootstrap=True`` (or ``DPWA_BOOTSTRAP=1``
+      in the environment, which the restart supervisor sets), fetches a
+      healthy donor's full state over the TCP wire and lands on the
+      donor's clock/step — the crash→restart→rejoin path, zero shared
+      disk."""
 
     def __init__(
         self,
@@ -45,6 +63,8 @@ class DpwaTcpAdapter:
         config: Union[DpwaConfig, str],
         metrics: Union[MetricsLogger, str, None] = None,
         health_every: int = 10,
+        bootstrap: Optional[bool] = None,
+        state_extra: Optional[Callable[[], Any]] = None,
     ):
         self.config = _resolve(config)
         self.transport = TcpTransport(self.config, name)
@@ -52,6 +72,7 @@ class DpwaTcpAdapter:
         self._vec = np.asarray(flat, dtype=np.float32)
         self._clock = 0.0
         self._step = 0
+        self._last_loss = 0.0
         self.last_alpha = 0.0
         self.last_partner = -1
         self._own_metrics = isinstance(metrics, str)
@@ -59,8 +80,22 @@ class DpwaTcpAdapter:
             MetricsLogger(path=metrics) if self._own_metrics else metrics
         )
         self._health_every = max(1, health_every)
+        rec = self.config.recovery
+        self._recovery = rec if rec.enabled else None
+        self._state_extra = state_extra
+        self.ring: Optional[RollbackRing] = (
+            RollbackRing(rec.snapshot_ring) if rec.enabled else None
+        )
+        self.last_bootstrap: Optional[dict] = None
+        self.last_rollback: Optional[dict] = None
+        if bootstrap is None:
+            bootstrap = os.environ.get("DPWA_BOOTSTRAP", "0") == "1"
+        if bootstrap and rec.enabled:
+            self._bootstrap_from_peer()
         # Serve initial weights immediately (reference init publishes too).
-        self.transport.publish(self._vec, self._clock, 0.0)
+        self.transport.publish(self._vec, self._clock, self._last_loss)
+        if self._recovery is not None:
+            self.transport.publish_state(self._packed_state())
 
     @property
     def params(self) -> PyTree:
@@ -74,30 +109,153 @@ class DpwaTcpAdapter:
         """Per-peer health state (see ``TcpTransport.health_snapshot``)."""
         return self.transport.health_snapshot()
 
+    # ------------------------------------------------------------------
+    # Recovery plumbing
+    # ------------------------------------------------------------------
+
+    def _packed_state(self) -> bytes:
+        """This worker's full serialized state for peer bootstrap."""
+        meta = {
+            "kind": "tcp_adapter",
+            "clock": self._clock,
+            "step": self._step,
+            "loss": self._last_loss,
+        }
+        if self._state_extra is not None:
+            meta["extra"] = self._state_extra()
+        return pack_state([self._vec], meta=meta)
+
+    def _event(self, event: str, **fields: Any) -> None:
+        if self.metrics is not None:
+            self.metrics.log_event(self._step, event, **fields)
+
+    def _bootstrap_from_peer(self) -> bool:
+        """Fetch a healthy donor's state and land on its schedule step."""
+        from dpwa_tpu.recovery.bootstrap import bootstrap_from_peer
+
+        res = bootstrap_from_peer(self.transport, like=None, step=self._step)
+        if res is None or not res.state:
+            self._event("bootstrap_failed")
+            return False
+        vec = np.asarray(res.state[0], dtype=np.float32)
+        if vec.shape != self._vec.shape:
+            self._event("bootstrap_failed", donor=res.donor,
+                        reason="shape_mismatch")
+            return False
+        self._vec = vec
+        self._clock = float(res.meta.get("clock", 0.0))
+        self._step = int(res.meta.get("step", 0))
+        self._last_loss = float(res.meta.get("loss", 0.0))
+        self.last_bootstrap = {
+            "donor": res.donor,
+            "step": self._step,
+            "clock": self._clock,
+            "nbytes": res.nbytes,
+            "attempts": res.attempts,
+            "meta": res.meta,
+        }
+        self._event(
+            "bootstrap", donor=res.donor, landed_step=self._step,
+            landed_clock=self._clock, nbytes=res.nbytes,
+            attempts=res.attempts,
+        )
+        return True
+
+    def _guard_local(self, loss: float) -> None:
+        """Roll the LOCAL replica back to the newest good snapshot when
+        this step's (vec, loss) trips the sanity bounds."""
+        reason = validate_payload(self._vec, loss, self._recovery)
+        if reason is None:
+            return
+        snap = self.ring.rollback() if self.ring is not None else None
+        if snap is not None:
+            # Restore the VECTOR only: clock/step stay monotonic so the
+            # deterministic pairing sequence is untouched (rewinding the
+            # schedule would desync every survivor's participation draw).
+            self._vec = snap.vec
+            self._last_loss = snap.loss
+        self.last_rollback = {
+            "step": self._step,
+            "reason": reason,
+            "restored": snap is not None,
+            "snapshot_step": snap.step if snap is not None else None,
+        }
+        self._event(
+            "rollback", reason=reason, restored=snap is not None,
+            snapshot_step=snap.step if snap is not None else None,
+        )
+
     def update(self, loss: float, params: PyTree = None) -> PyTree:
         if params is not None:
             self._vec = np.asarray(ravel(params)[0], dtype=np.float32)
+        loss = float(loss)
+        rolled_back = False
+        if self._recovery is not None:
+            before = self.last_rollback
+            self._guard_local(loss)
+            rolled_back = self.last_rollback is not before
+            if rolled_back:
+                # The pre-divergence loss travels with the snapshot; the
+                # caller's sick loss must not ride the published frame
+                # (peers' guards would classify us as poisoned).
+                loss = self._last_loss
         self._clock += 1.0
+        step = self._step
         self._vec, self.last_alpha, self.last_partner = self.transport.exchange(
-            self._vec, self._clock, float(loss), self._step
+            self._vec, self._clock, loss, step
         )
+        # Advance BEFORE publishing state: the packed meta's ``step`` is
+        # the next step to execute, so a rejoiner bootstrapping from us
+        # lands exactly one round behind nobody — its next draw is the
+        # same one we are about to make.
+        self._step = step + 1
+        if self._recovery is not None:
+            self._last_loss = loss
+            if not rolled_back and step % self._recovery.snapshot_every == 0:
+                self.ring.push(self._vec, step, self._clock, loss)
+            self.transport.publish_state(self._packed_state())
+            advice = self.transport.pop_resync_advice()
+            if advice is not None:
+                self._event("resync_advised", **advice)
+                if self._recovery.auto_resync:
+                    self._resync()
         if self.metrics is not None:
             info = self.transport.last_round
             self.metrics.log(
-                self._step,
-                loss=float(loss),
+                step,
+                loss=loss,
                 alpha=self.last_alpha,
                 sched_partner=info.get("sched_partner"),
                 partner=info.get("partner"),
                 remapped=info.get("remapped"),
                 outcome=info.get("outcome"),
             )
-            if self._step % self._health_every == 0:
+            if step % self._health_every == 0:
                 self.metrics.log_health(
-                    self._step, self.transport.health_snapshot()
+                    step, self.transport.health_snapshot()
                 )
-        self._step += 1
         return self.params
+
+    def _resync(self) -> bool:
+        """Mid-run re-sync: adopt a healthy donor's replica + clock but
+        KEEP the local step counter — this worker never left the ring,
+        so its schedule position is already correct; only its replica is
+        stale."""
+        from dpwa_tpu.recovery.bootstrap import bootstrap_from_peer
+
+        res = bootstrap_from_peer(self.transport, like=None, step=self._step)
+        if res is None or not res.state:
+            return False
+        vec = np.asarray(res.state[0], dtype=np.float32)
+        if vec.shape != self._vec.shape:
+            return False
+        self._vec = vec
+        self._clock = float(res.meta.get("clock", self._clock))
+        self._event(
+            "resync", donor=res.donor, adopted_clock=self._clock,
+            nbytes=res.nbytes,
+        )
+        return True
 
     def close(self) -> None:
         if self.metrics is not None and self._own_metrics:

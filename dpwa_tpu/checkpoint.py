@@ -16,7 +16,8 @@ from __future__ import annotations
 
 import json
 import os
-from typing import Any, Optional
+import warnings
+from typing import Any, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -119,9 +120,16 @@ def _saved_keys(ckptr, path) -> Optional[set]:
     """Top-level keys recorded in the checkpoint's metadata, or None if
     the metadata cannot be read (older Orbax layouts)."""
     try:
-        return set(ckptr.metadata(path).item_metadata.tree.keys())
+        return set(_metadata_tree(ckptr, path).keys())
     except Exception:
         return None
+
+
+def _metadata_tree(ckptr, path) -> dict:
+    """The checkpoint's top-level metadata tree across Orbax versions
+    (0.7 returns the dict directly; newer wraps it in item_metadata)."""
+    meta = ckptr.metadata(path)
+    return meta if isinstance(meta, dict) else meta.item_metadata.tree
 
 
 def restore_checkpoint(path: str, like: Optional[Any] = None, data_stream=None):
@@ -218,3 +226,120 @@ def restore_checkpoint(path: str, like: Optional[Any] = None, data_stream=None):
     # Old checkpoints simply lack optional fields here; the state classes
     # default them (loss=None is accepted by both train steps).
     return cls(**restored)
+
+
+def validate_checkpoint(path: str, data_stream: bool = False) -> Optional[str]:
+    """Cheap structural health check; ``None`` when sound, else a reason.
+
+    A checkpoint written while the writer was being killed (the crash
+    scenarios the recovery subsystem exists for, docs/recovery.md) can
+    be missing its Orbax commit marker, hold an unreadable metadata
+    tree, or carry a sidecar that disagrees with the saved ``step``.
+    This inspects exactly those seams WITHOUT restoring any array data,
+    so callers can vet a whole directory of checkpoints in milliseconds:
+
+    - the path is an Orbax directory whose metadata tree is readable and
+      non-empty (an interrupted save is detected by Orbax's own
+      atomic-commit protocol and surfaces here as unreadable metadata);
+    - the layout sidecar (``<path>-meta.json``), when present, is valid
+      JSON;
+    - with ``data_stream=True``, the data sidecar exists, parses, and —
+      when step-stamped — matches the checkpoint's saved ``step``.
+    """
+    path = os.path.abspath(path)
+    if not os.path.isdir(path):
+        return "not a directory"
+    try:
+        with ocp.StandardCheckpointer() as ckptr:
+            tree = _metadata_tree(ckptr, path)
+            if not tree:
+                return "empty metadata tree"
+            saved_step: Optional[int] = None
+            step_meta = tree.get("step")
+            # Array metadata has no value; only the sidecar needs the
+            # step, and then a 0-d scalar is cheap to restore alone.
+            if data_stream and step_meta is not None:
+                restored = ckptr.restore(
+                    path,
+                    {
+                        "step": ocp.utils.to_shape_dtype_struct(
+                            jnp.zeros(
+                                step_meta.shape, dtype=step_meta.dtype
+                            )
+                        )
+                    },
+                )
+                saved_step = int(np.asarray(restored["step"]))
+    except Exception as e:  # Orbax raises a zoo of types on corruption
+        return f"unreadable Orbax metadata: {type(e).__name__}: {e}"
+    layout = _layout_path(path)
+    if os.path.exists(layout):
+        try:
+            with open(layout) as f:
+                json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            return f"corrupt layout sidecar: {e}"
+    if data_stream:
+        sidecar = _data_state_path(path)
+        if not os.path.exists(sidecar):
+            return "missing data-stream sidecar"
+        try:
+            with open(sidecar) as f:
+                payload = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            return f"corrupt data-stream sidecar: {e}"
+        if (
+            isinstance(payload, dict)
+            and "ckpt_step" in payload
+            and saved_step is not None
+            and int(payload["ckpt_step"]) != saved_step
+        ):
+            return (
+                f"data-stream sidecar stamped step {payload['ckpt_step']} "
+                f"!= checkpoint step {saved_step}"
+            )
+    return None
+
+
+def restore_latest_valid(
+    paths: Sequence[str],
+    like: Optional[Any] = None,
+    data_stream=None,
+):
+    """Restore the newest structurally-valid checkpoint from ``paths``.
+
+    ``paths`` is ordered oldest → newest (the natural order of a save
+    cadence); candidates are tried newest-first, each vetted with
+    :func:`validate_checkpoint` (including the data sidecar when
+    ``data_stream`` is given) and then actually restored — a candidate
+    that passes the cheap check but still fails restore is skipped too.
+    Every skip emits a :class:`UserWarning` naming the casualty and why,
+    because silently resuming from an older state than the operator
+    expects is worth a visible trace.  Raises ``FileNotFoundError`` when
+    nothing survives — the caller decides between cold start and
+    peer-assisted bootstrap (:mod:`dpwa_tpu.recovery`).
+
+    This is deliberately a SEPARATE entry point: :func:`restore_checkpoint`
+    keeps its strict raise-on-anything-wrong contract for callers that
+    name one specific checkpoint and need to know it was unusable."""
+    reasons: List[str] = []
+    for path in reversed(list(paths)):
+        reason = validate_checkpoint(path, data_stream=data_stream is not None)
+        if reason is None:
+            try:
+                return restore_checkpoint(
+                    path, like=like, data_stream=data_stream
+                )
+            except Exception as e:
+                reason = f"restore failed: {type(e).__name__}: {e}"
+        reasons.append(f"{path}: {reason}")
+        warnings.warn(
+            f"skipping checkpoint {path} ({reason}); "
+            "falling back to an earlier one",
+            stacklevel=2,
+        )
+    raise FileNotFoundError(
+        "no valid checkpoint among candidates: " + "; ".join(reasons)
+        if reasons
+        else "no checkpoint candidates given"
+    )

@@ -56,6 +56,21 @@ Schema::
       truncate_probability: 0.0 # cut the frame mid-payload
       corrupt_probability: 0.0  # flip the frame's magic bytes
       down_windows: []          # [{peer, start, stop}]: hard-down rounds
+    recovery:                   # crash recovery & divergence guard
+      enabled: true             # peer bootstrap serving + payload guard
+      max_param_norm: 1.0e12    # reject/roll back when ||vec||_2 exceeds
+      max_loss: 1.0e9           # reject/roll back when |loss| exceeds
+      snapshot_every: 1         # push a last-good ring snapshot every k
+                                #   healthy steps
+      snapshot_ring: 4          # in-memory last-good snapshots kept
+      state_chunk_bytes: 1048576  # STATE transfer chunk size (CRC per chunk)
+      bootstrap_timeout_ms: 10000 # per-chunk fetch budget during bootstrap
+      max_resume_retries: 8     # short-read resume attempts per bootstrap
+      max_clock_lag: 64.0       # re-admission freshness: advise re-sync
+                                #   when a readmitted peer's clock leads
+                                #   ours by more than this
+      auto_resync: false        # adapter re-bootstraps itself when a
+                                #   re-admission freshness check trips
 """
 
 from __future__ import annotations
@@ -271,6 +286,73 @@ class ChaosConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class RecoveryConfig:
+    """``recovery:`` block — crash recovery & divergence-guard knobs.
+
+    Three concerns share these bounds deliberately (one definition of
+    "sane replica" for the whole system):
+
+    * the **remote guard** rejects a fetched payload whose vector is
+      non-finite, whose L2 norm exceeds ``max_param_norm``, or whose
+      advertised loss exceeds ``max_loss`` (classified as the
+      ``poisoned`` detector outcome, never merged);
+    * the **local rollback ring** restores the newest last-good snapshot
+      when the local replica itself trips the same bounds;
+    * the **interpolation rescue** (`interpolation._clamped`) treats a
+      finite-but-huge local loss beyond ``max_loss`` as sick metadata,
+      granting the full alpha=1 rescue.
+
+    ``enabled`` also turns on STATE serving in the Rx server so a
+    restarted peer can bootstrap over the blob wire (this forces the
+    Python Rx server, like ``chaos.enabled`` — the native C++ loop only
+    speaks the blob protocol)."""
+
+    enabled: bool = True
+    max_param_norm: float = 1e12
+    max_loss: float = 1e9
+    snapshot_every: int = 1
+    snapshot_ring: int = 4
+    state_chunk_bytes: int = 1 << 20
+    bootstrap_timeout_ms: int = 10000
+    max_resume_retries: int = 8
+    max_clock_lag: float = 64.0
+    auto_resync: bool = False
+
+    def __post_init__(self) -> None:
+        if self.max_param_norm <= 0:
+            raise ValueError(
+                f"max_param_norm must be > 0, got {self.max_param_norm}"
+            )
+        if self.max_loss <= 0:
+            raise ValueError(f"max_loss must be > 0, got {self.max_loss}")
+        if self.snapshot_every < 1:
+            raise ValueError(
+                f"snapshot_every must be >= 1, got {self.snapshot_every}"
+            )
+        if self.snapshot_ring < 1:
+            raise ValueError(
+                f"snapshot_ring must be >= 1, got {self.snapshot_ring}"
+            )
+        if self.state_chunk_bytes < 64:
+            raise ValueError(
+                f"state_chunk_bytes must be >= 64, got {self.state_chunk_bytes}"
+            )
+        if self.bootstrap_timeout_ms < 1:
+            raise ValueError(
+                f"bootstrap_timeout_ms must be >= 1, "
+                f"got {self.bootstrap_timeout_ms}"
+            )
+        if self.max_resume_retries < 0:
+            raise ValueError(
+                f"max_resume_retries must be >= 0, got {self.max_resume_retries}"
+            )
+        if self.max_clock_lag <= 0:
+            raise ValueError(
+                f"max_clock_lag must be > 0, got {self.max_clock_lag}"
+            )
+
+
+@dataclasses.dataclass(frozen=True)
 class InterpolationConfig:
     type: str = "constant"
     factor: float = 0.5
@@ -289,6 +371,7 @@ class DpwaConfig:
     interpolation: InterpolationConfig = InterpolationConfig()
     health: HealthConfig = HealthConfig()
     chaos: ChaosConfig = ChaosConfig()
+    recovery: RecoveryConfig = RecoveryConfig()
 
     @property
     def n_peers(self) -> int:
@@ -344,6 +427,7 @@ def config_from_dict(raw: Mapping[str, Any]) -> DpwaConfig:
     interp = dict(raw.get("interpolation") or {})
     health = dict(raw.get("health") or {})
     chaos = dict(raw.get("chaos") or {})
+    recovery = dict(raw.get("recovery") or {})
     if "down_windows" in chaos and chaos["down_windows"] is not None:
         chaos["down_windows"] = tuple(chaos["down_windows"])
     return DpwaConfig(
@@ -352,6 +436,7 @@ def config_from_dict(raw: Mapping[str, Any]) -> DpwaConfig:
         interpolation=InterpolationConfig(**interp),
         health=HealthConfig(**health),
         chaos=ChaosConfig(**chaos),
+        recovery=RecoveryConfig(**recovery),
     )
 
 
@@ -375,16 +460,19 @@ def make_local_config(
     base_port: int = 45000,
     health: "HealthConfig | Mapping[str, Any] | None" = None,
     chaos: "ChaosConfig | Mapping[str, Any] | None" = None,
+    recovery: "RecoveryConfig | Mapping[str, Any] | None" = None,
     **protocol_kwargs: Any,
 ) -> DpwaConfig:
     """Programmatic config for tests/benchmarks: n local peers on 127.0.0.1.
 
-    ``health`` / ``chaos`` accept a config object or a plain dict (the
-    YAML-block shorthand)."""
+    ``health`` / ``chaos`` / ``recovery`` accept a config object or a
+    plain dict (the YAML-block shorthand)."""
     if isinstance(health, Mapping):
         health = HealthConfig(**health)
     if isinstance(chaos, Mapping):
         chaos = ChaosConfig(**chaos)
+    if isinstance(recovery, Mapping):
+        recovery = RecoveryConfig(**recovery)
     return DpwaConfig(
         nodes=tuple(
             NodeSpec(name=f"node{i}", host="127.0.0.1", port=base_port + i)
@@ -399,4 +487,5 @@ def make_local_config(
         interpolation=InterpolationConfig(type=interpolation, factor=factor),
         health=health if health is not None else HealthConfig(),
         chaos=chaos if chaos is not None else ChaosConfig(),
+        recovery=recovery if recovery is not None else RecoveryConfig(),
     )

@@ -165,13 +165,30 @@ class ChaosPeerServer:
         self._round = int(clock)
         self._srv.publish(vec, clock, loss, code)
 
+    def publish_state(self, blob: bytes) -> None:
+        self._srv.publish_state(blob)
+
     def _serve_with_faults(self, srv, conn) -> None:
-        from dpwa_tpu.parallel.tcp import _REQ, _recv_exact
+        from dpwa_tpu.parallel.tcp import (
+            _REQ, _STATE_REQ, _STATE_REQ_BODY, _recv_exact,
+        )
 
         plan = self.engine.plan(self._round)
         if plan.kind in ("down", "drop"):
             return  # caller closes: the fetcher sees a reset/short read
         req = _recv_exact(conn, len(_REQ))
+        if req == _STATE_REQ:
+            # STATE transfers honor down/drop (a dead peer serves no
+            # bootstrap either) and delay; the frame-level mutations
+            # (truncate/corrupt/throttle) target the gossip blob — the
+            # chunked transfer's own CRC + resume path is exercised
+            # directly by tests/test_recovery.py.
+            body = _recv_exact(conn, _STATE_REQ_BODY.size)
+            offset, max_chunk = _STATE_REQ_BODY.unpack(body)
+            if plan.kind == "delay":
+                time.sleep(plan.delay_s)
+            srv._handle_state(conn, offset, max_chunk)
+            return
         if req != _REQ:
             return
         with srv._lock:

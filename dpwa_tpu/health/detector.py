@@ -45,8 +45,11 @@ class Outcome:
     REFUSED = "refused"  # connect refused / unreachable — nothing listening
     SHORT_READ = "short_read"  # peer closed mid-frame (truncated stream)
     CORRUPT = "corrupt"  # bad magic/version/dtype, oversize, decode failure
+    POISONED = "poisoned"  # frame decoded fine but failed the recovery
+    #   guard: non-finite values, exploded norm, or an insane loss
+    #   (dpwa_tpu.recovery.guard) — the peer is up but its replica is sick
 
-    FAILURES = (TIMEOUT, REFUSED, SHORT_READ, CORRUPT)
+    FAILURES = (TIMEOUT, REFUSED, SHORT_READ, CORRUPT, POISONED)
     ALL = (SUCCESS,) + FAILURES
 
 
@@ -55,12 +58,15 @@ class Outcome:
 # (weight 1.0: two in a row cross the default threshold of 2.0); a
 # corrupt frame is a protocol violation — something is seriously wrong
 # on the other side — and weighs slightly more; a timeout is the
-# weakest signal (the network, not the peer, may be at fault).
+# weakest signal (the network, not the peer, may be at fault).  A
+# poisoned payload (clean frame, sick contents) is as damning as a
+# corrupt one: merging it would actively damage the local replica.
 DEFAULT_FAILURE_WEIGHTS: Mapping[str, float] = {
     Outcome.TIMEOUT: 1.0,
     Outcome.REFUSED: 1.0,
     Outcome.SHORT_READ: 1.0,
     Outcome.CORRUPT: 1.5,
+    Outcome.POISONED: 1.5,
 }
 
 

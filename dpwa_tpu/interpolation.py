@@ -80,7 +80,9 @@ def loss_weighted(factor: float = 1.0) -> Interpolation:
     return alpha
 
 
-def _clamped(strategy: Interpolation) -> Interpolation:
+def _clamped(
+    strategy: Interpolation, max_abs_loss: float | None = None
+) -> Interpolation:
     """Restrict α to [0, 1] so the merge is always an interpolation.
 
     ``loss_weighted`` is unbounded on raw metadata: a negative local loss
@@ -93,38 +95,50 @@ def _clamped(strategy: Interpolation) -> Interpolation:
 
     A non-finite α (NaN/inf metadata makes the ratio NaN, and
     ``jnp.clip`` propagates NaN) resolves by which side is sick: if the
-    LOCAL metadata is non-finite and the peer's is healthy, α = 1 —
-    adopting the healthy peer is exactly the rescue gossip offers a
-    diverged replica.  In every other non-finite case α = 0 (keep the
-    local replica, the same keep-training posture as a failed fetch).
+    LOCAL metadata is sick and the peer's is healthy, α = 1 — adopting
+    the healthy peer is exactly the rescue gossip offers a diverged
+    replica.  In every other sick case α = 0 (keep the local replica,
+    the same keep-training posture as a failed fetch).
 
-    Note the rescue keys on NON-FINITE metadata only (NaN/inf clock or
-    loss).  A replica whose loss is finite but enormous — diverging, not
-    yet diverged — takes the ordinary path: the strategy's raw α (e.g.
-    ``loss_weighted``'s ratio → ``factor`` as local loss dominates) is
-    clipped into [0, 1], so it pulls strongly toward the healthier peer,
-    capped at ``min(factor, 1)``, but never snaps to wholesale adoption.
-    Only an actually-poisoned replica gets the α = 1 rescue."""
+    "Sick" means non-finite metadata (NaN/inf clock or loss), and — when
+    ``max_abs_loss`` is given (the ``recovery:`` block's ``max_loss``
+    sanity bound, threaded through :func:`make_interpolation`) — also a
+    finite loss beyond that bound.  A replica at loss 1e30 has diverged
+    in every sense that matters; without the bound it took the ordinary
+    clipped path (e.g. ``loss_weighted``'s ratio capped at
+    ``min(factor, 1)``) and never got the full α = 1 rescue its state
+    needs.  With no bound configured, finite-but-huge keeps the ordinary
+    path — only actually-poisoned metadata rescues."""
 
     def alpha(local: PeerMeta, remote: PeerMeta) -> jnp.ndarray:
         a = strategy(local, remote)
         local_ok = jnp.isfinite(local.clock) & jnp.isfinite(local.loss)
         remote_ok = jnp.isfinite(remote.clock) & jnp.isfinite(remote.loss)
+        if max_abs_loss is not None:
+            bound = jnp.float32(max_abs_loss)
+            local_ok = local_ok & (jnp.abs(local.loss) <= bound)
+            remote_ok = remote_ok & (jnp.abs(remote.loss) <= bound)
         rescue = jnp.where(~local_ok & remote_ok, 1.0, 0.0)
-        a = jnp.where(jnp.isfinite(a), a, rescue)
+        a = jnp.where(jnp.isfinite(a) & local_ok, a, rescue)
         return jnp.clip(a, 0.0, 1.0)
 
     return alpha
 
 
-def make_interpolation(config: InterpolationConfig) -> Interpolation:
+def make_interpolation(
+    config: InterpolationConfig, max_abs_loss: float | None = None
+) -> Interpolation:
     """Factory from the YAML ``interpolation:`` section.
 
-    Every returned strategy is clamped to α ∈ [0, 1] (see ``_clamped``)."""
+    Every returned strategy is clamped to α ∈ [0, 1] (see ``_clamped``).
+    ``max_abs_loss`` — normally ``recovery.max_loss``, passed by the
+    transports when recovery is enabled — additionally treats a
+    finite-but-huge local loss as sick metadata deserving the full α = 1
+    rescue."""
     if config.type == "constant":
-        return _clamped(constant(config.factor))
+        return _clamped(constant(config.factor), max_abs_loss)
     if config.type == "clock":
-        return _clamped(clock_weighted(config.factor))
+        return _clamped(clock_weighted(config.factor), max_abs_loss)
     if config.type == "loss":
-        return _clamped(loss_weighted(config.factor))
+        return _clamped(loss_weighted(config.factor), max_abs_loss)
     raise ValueError(f"unknown interpolation type {config.type!r}")

@@ -169,6 +169,32 @@ class MetricsLogger:
             **extra,
         )
 
+    def log_event(self, step: int, event: str, **fields: Any) -> None:
+        """One recovery/control-plane event record, written immediately.
+
+        Events are rare and load-bearing (rollback, bootstrap, resync,
+        poisoned rejection) so they bypass ``every`` — dropping one to a
+        sampling interval would hide the exact evidence
+        ``tools/health_report.py`` summarizes.  The record carries
+        ``record: "event"`` and ``event: <kind>`` so downstream tooling
+        can fold all kinds with one filter."""
+        if self._pending is not None:
+            self.flush()
+        rec: dict[str, Any] = {
+            "step": int(step),
+            "t": round(time.perf_counter() - self._t0, 4),
+            "record": "event",
+            "event": str(event),
+        }
+        for k, v in fields.items():
+            rec[k] = _jsonable(v)
+        line = json.dumps(rec)
+        if self._file is not None:
+            self._file.write(line + "\n")
+            self._file.flush()
+        if self._stream is not None:
+            print(line, file=self._stream, flush=True)
+
     def flush(self) -> None:
         """Write the deferred record, if any (blocks only on its arrays)."""
         if self._pending is None:

@@ -24,6 +24,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from dpwa_tpu.utils.compat import axis_size
+
 
 def _block_attn(q, k, v, scale, qpos, kpos, causal):
     """One Q-block × K-block partial attention. Returns (scores_max, exp
@@ -124,7 +126,7 @@ def ring_attention_local(
             # Kernel choice (pallas vs jnp twin) auto-resolves by backend
             # inside flash_ring.
             return ring_flash_attention_local(q, k, v, axis_name, causal)
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     me = lax.axis_index(axis_name)
     B, T, H, D = q.shape
     if q_chunk is None:
@@ -202,7 +204,7 @@ def ring_attention_local(
     jax.jit, static_argnames=("axis_name", "causal", "mesh", "q_chunk", "impl")
 )
 def _jit_ring(q, k, v, mesh, axis_name, causal, q_chunk, impl):
-    from jax import shard_map
+    from dpwa_tpu.utils.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     body = functools.partial(

@@ -29,7 +29,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from dpwa_tpu.utils.compat import shard_map
 
 from dpwa_tpu.config import DpwaConfig
 from dpwa_tpu.interpolation import Interpolation, PeerMeta, make_interpolation
@@ -196,7 +196,12 @@ class IciTransport:
     ):
         self.config = config
         self.schedule = schedules.build_schedule(config)
-        self.interp = make_interpolation(config.interpolation)
+        self.interp = make_interpolation(
+            config.interpolation,
+            max_abs_loss=(
+                config.recovery.max_loss if config.recovery.enabled else None
+            ),
+        )
         self.axis_name = axis_name
         self.mesh = mesh if mesh is not None else make_mesh(config, axis_name=axis_name)
         (axis_size,) = (self.mesh.shape[axis_name],)

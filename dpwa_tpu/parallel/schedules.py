@@ -101,9 +101,25 @@ def backoff_jitter_draw(seed, peer, streak, jitter_rounds: int) -> int:
     )
 
 
+def donor_draw(seed, step, me, n_candidates: int):
+    """Index of the bootstrap donor a restarted peer fetches state from
+    when several healthy candidates exist (tag 5 — independent of every
+    other control stream).
+
+    Keyed on ``(seed, step, me)`` like :func:`fallback_draw`: a rejoiner
+    restarted at the same (seed, step) always elects the same donor, so
+    the crash→restart→bootstrap acceptance path replays bit-identically
+    and load spreads across donors instead of always hammering the
+    lowest-indexed healthy peer."""
+    return jax.random.randint(
+        _pair_key(seed, step, me, 5), (), 0, n_candidates
+    )
+
+
 # Chaos fault-kind tags start at 16: far clear of the control-plane tags
-# (0 participation, 1 fault, 2 pool, 3 fallback, 4 backoff jitter), so
-# new control draws can claim 5..15 without colliding with fault kinds.
+# (0 participation, 1 fault, 2 pool, 3 fallback, 4 backoff jitter,
+# 5 bootstrap donor), so new control draws can claim 6..15 without
+# colliding with fault kinds.
 CHAOS_TAG_BASE = 16
 
 

@@ -131,7 +131,12 @@ class StackedTransport:
     def __init__(self, config: DpwaConfig):
         self.config = config
         self.schedule = schedules.build_schedule(config)
-        self.interp = make_interpolation(config.interpolation)
+        self.interp = make_interpolation(
+            config.interpolation,
+            max_abs_loss=(
+                config.recovery.max_loss if config.recovery.enabled else None
+            ),
+        )
         schedule, interp = self.schedule, self.interp
 
         @jax.jit

@@ -33,6 +33,7 @@ import socket
 import struct
 import threading
 import time
+import zlib
 from typing import Optional, Tuple
 
 import numpy as np
@@ -65,6 +66,25 @@ _DTYPE_CODES = {v: k for k, v in _DTYPES.items()}
 # fetch_blob.  protocol.wire_dtype: int8.
 _INT8_CHUNKED = 4
 _MAX_BLOB = 1 << 34  # 16 GiB sanity bound on advertised payload size
+
+# STATE transfer wire (crash recovery, dpwa_tpu/recovery/): a restarted
+# worker bootstraps a donor's full serialized train state over the same
+# one-shot socket discipline as the gossip fetch — request, one framed
+# response, close.  The request is a distinct 5-byte magic (same length
+# as _REQ, so the Rx server reads 5 bytes and dispatches) followed by
+# <Q offset><I max_chunk>; the response is ONE chunk:
+#   header: magic(4s) version(B) generation(I) total(Q) offset(Q)
+#           chunk_len(I) crc32(I)
+# then chunk_len bytes.  One chunk per connection keeps the transfer
+# resumable: a short read just reconnects at the next unacknowledged
+# offset.  ``generation`` increments per publish_state, so a client
+# detects a donor re-publishing mid-transfer (splicing two states would
+# corrupt the bootstrap) and restarts cleanly.
+_STATE_REQ = b"DPWA@"
+_STATE_REQ_BODY = struct.Struct("<QI")
+_STATE_MAGIC = b"DPWS"
+_STATE_HDR = struct.Struct("<4sBIQQII")
+_MAX_STATE_CHUNK = 1 << 26  # 64 MiB server-side clamp on one chunk
 # Default deadline floor for the payload read (bytes/s): the fetch
 # budget grows at this rate per byte RECEIVED, so a healthy peer
 # streaming a large replica is never killed by a fixed timeout_ms sized
@@ -152,6 +172,8 @@ class PeerServer:
     def __init__(self, host: str, port: int):
         self._lock = threading.Lock()
         self._payload: Optional[bytes] = None  # pre-framed header+data
+        self._state: Optional[bytes] = None  # serialized bootstrap state
+        self._state_gen = 0
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
@@ -173,6 +195,17 @@ class PeerServer:
         payload = _frame(vec, clock, loss, code)
         with self._lock:
             self._payload = payload
+
+    def publish_state(self, blob: bytes) -> None:
+        """Expose a serialized train state for peer-assisted bootstrap.
+
+        ``blob`` is whatever :mod:`dpwa_tpu.recovery.state_transfer`
+        packed; the server is agnostic — it chunks bytes.  Each publish
+        bumps the generation, so an in-flight transfer against the old
+        blob restarts instead of splicing."""
+        with self._lock:
+            self._state = bytes(blob)
+            self._state_gen = (self._state_gen + 1) & 0xFFFFFFFF
 
     def _serve(self) -> None:
         try:
@@ -202,12 +235,37 @@ class PeerServer:
         so the chaos harness (health/chaos.py) can wrap per-connection
         behavior without duplicating the listener."""
         req = _recv_exact(conn, len(_REQ))
+        if req == _STATE_REQ:
+            body = _recv_exact(conn, _STATE_REQ_BODY.size)
+            offset, max_chunk = _STATE_REQ_BODY.unpack(body)
+            self._handle_state(conn, offset, max_chunk)
+            return
         if req != _REQ:
             return
         with self._lock:
             payload = self._payload
         if payload is not None:
             conn.sendall(payload)
+
+    def _handle_state(
+        self, conn: socket.socket, offset: int, max_chunk: int
+    ) -> None:
+        """Serve one STATE chunk at ``offset``.  No published state is a
+        well-formed empty transfer (total = 0): the client reads it as
+        'this donor has nothing for you' and tries the next candidate —
+        distinct from a protocol failure, which would accrue suspicion
+        against an innocent peer."""
+        with self._lock:
+            blob = self._state if self._state is not None else b""
+            gen = self._state_gen
+        total = len(blob)
+        off = min(max(offset, 0), total)
+        n = min(max(max_chunk, 0), total - off, _MAX_STATE_CHUNK)
+        chunk = blob[off : off + n]
+        header = _STATE_HDR.pack(
+            _STATE_MAGIC, 1, gen, total, off, len(chunk), zlib.crc32(chunk)
+        )
+        conn.sendall(header + chunk)
 
     def close(self) -> None:
         self._stop.set()
@@ -240,6 +298,13 @@ class NativePeerServer:
         code: Optional[int] = None,
     ) -> None:
         self._srv.publish_framed(_frame(vec, clock, loss, code))
+
+    def publish_state(self, blob: bytes) -> None:
+        raise RuntimeError(
+            "the native Rx server only speaks the blob protocol; STATE "
+            "serving needs the Python server (TcpTransport selects it "
+            "automatically when recovery.enabled)"
+        )
 
     def close(self) -> None:
         self._srv.close()
@@ -377,6 +442,171 @@ def fetch_blob(
     return fetch_blob_ex(host, port, timeout_ms, min_bandwidth_bps)[0]
 
 
+def fetch_state_chunk(
+    host: str,
+    port: int,
+    offset: int,
+    max_chunk: int,
+    timeout_ms: int,
+    min_bandwidth_bps: float = _MIN_WIRE_BANDWIDTH,
+) -> Tuple[Optional[Tuple[bytes, int, int]], str, float, int]:
+    """Fetch one STATE chunk: ``(result, outcome, latency_s, nbytes_rx)``
+    where ``result`` is ``(chunk_bytes, total_len, generation)`` or None.
+
+    Same cumulative-deadline discipline as :func:`fetch_blob_ex`: the
+    budget covers connect + request + header outright and the chunk read
+    earns per-byte extension.  A CRC mismatch or malformed header is
+    ``corrupt``; the caller (:func:`fetch_state`) decides whether to
+    resume, restart, or give up."""
+    t0 = time.monotonic()
+    deadline = t0 + timeout_ms / 1000.0
+    nbytes_rx = 0
+    try:
+        sock = socket.create_connection(
+            (host, port), timeout=timeout_ms / 1000.0
+        )
+    except socket.timeout:
+        return None, Outcome.TIMEOUT, time.monotonic() - t0, 0
+    except (ConnectionError, OSError):
+        return None, Outcome.REFUSED, time.monotonic() - t0, 0
+    try:
+        with sock:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise socket.timeout(
+                    "cumulative state-fetch deadline exceeded before request"
+                )
+            sock.settimeout(remaining)
+            sock.sendall(
+                _STATE_REQ + _STATE_REQ_BODY.pack(offset, max_chunk)
+            )
+            raw = _recv_exact(sock, _STATE_HDR.size, deadline)
+            magic, version, gen, total, off, chunk_len, crc = (
+                _STATE_HDR.unpack(raw)
+            )
+            if (
+                magic != _STATE_MAGIC
+                or version != 1
+                or total > _MAX_BLOB
+                or chunk_len > max(total - off, 0)
+            ):
+                return None, Outcome.CORRUPT, time.monotonic() - t0, 0
+            data = _recv_exact(
+                sock, chunk_len, deadline, 1.0 / min_bandwidth_bps
+            )
+            nbytes_rx = len(data)
+            if zlib.crc32(data) != crc or off != min(max(offset, 0), total):
+                # A clamped offset means the blob shrank under us (the
+                # donor re-published): same remedy as a bad chunk —
+                # the transfer-level loop restarts.
+                return None, Outcome.CORRUPT, time.monotonic() - t0, nbytes_rx
+            return (
+                (data, total, gen), Outcome.SUCCESS,
+                time.monotonic() - t0, nbytes_rx,
+            )
+    except socket.timeout:
+        return None, Outcome.TIMEOUT, time.monotonic() - t0, nbytes_rx
+    except (ConnectionError, OSError):
+        return None, Outcome.SHORT_READ, time.monotonic() - t0, nbytes_rx
+
+
+def fetch_state(
+    host: str,
+    port: int,
+    timeout_ms: int,
+    chunk_bytes: int = 1 << 20,
+    max_retries: int = 8,
+    min_bandwidth_bps: float = _MIN_WIRE_BANDWIDTH,
+) -> Tuple[Optional[bytes], str, float, int]:
+    """Full resumable STATE transfer from a donor peer.
+
+    Loops :func:`fetch_state_chunk` from offset 0, each chunk on a fresh
+    one-shot connection (a short read or timeout resumes at the last
+    acknowledged offset — bytes already banked are never refetched);
+    ``max_retries`` bounds the total number of failed chunk attempts
+    across the transfer.  A generation change or corrupt chunk restarts
+    the transfer from zero (also charged as a retry).  Returns
+    ``(blob | None, outcome, latency_s, nbytes_received)`` — an empty
+    blob (donor has no published state) comes back as ``(b"", success)``
+    for the caller to interpret; ``outcome`` on failure is the LAST
+    chunk's classification."""
+    t0 = time.monotonic()
+    buf = bytearray()
+    total: Optional[int] = None
+    gen: Optional[int] = None
+    retries = 0
+    nbytes_rx = 0
+    while True:
+        got, outcome, _lat, nrx = fetch_state_chunk(
+            host, port, len(buf), chunk_bytes, timeout_ms, min_bandwidth_bps
+        )
+        nbytes_rx += nrx
+        if got is None:
+            # A refused connect means the donor process is gone — no
+            # point burning the remaining retries against it.
+            if outcome == Outcome.REFUSED or retries >= max_retries:
+                return None, outcome, time.monotonic() - t0, nbytes_rx
+            retries += 1
+            if outcome == Outcome.CORRUPT:
+                buf.clear()
+                total = gen = None
+            continue
+        data, tot, g = got
+        if gen is not None and (g != gen or tot != total):
+            # Donor re-published mid-transfer: splicing chunks from two
+            # different blobs would hand the bootstrap a frankenstate.
+            if retries >= max_retries:
+                return None, Outcome.CORRUPT, time.monotonic() - t0, nbytes_rx
+            retries += 1
+            buf.clear()
+            total = gen = None
+            continue
+        gen, total = g, tot
+        buf += data
+        if len(buf) >= total:
+            return (
+                bytes(buf[:total]), Outcome.SUCCESS,
+                time.monotonic() - t0, nbytes_rx,
+            )
+        if not data:
+            # Zero-byte chunk while bytes remain: malformed server.
+            if retries >= max_retries:
+                return None, Outcome.CORRUPT, time.monotonic() - t0, nbytes_rx
+            retries += 1
+
+
+def probe_header_ex(
+    host: str, port: int, timeout_ms: int = 100
+) -> Tuple[bool, Optional[float]]:
+    """:func:`probe_header` plus the probed frame's publish clock.
+
+    The clock rides the header for free, and re-admission wants it: a
+    readmitted peer whose clock is far AHEAD of ours means we are the
+    stale replica (we were partitioned while it kept training) — the
+    freshness check behind ``recovery.max_clock_lag``."""
+    deadline = time.monotonic() + timeout_ms / 1000.0
+    try:
+        with socket.create_connection(
+            (host, port), timeout=timeout_ms / 1000.0
+        ) as sock:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return False, None
+            sock.settimeout(remaining)
+            sock.sendall(_REQ)
+            raw = _recv_exact(sock, _HDR.size, deadline)
+            magic, version, code, clock, _loss, nbytes = _HDR.unpack(raw)
+            ok = (
+                magic == _MAGIC
+                and version == 1
+                and (code in _DTYPES or code == _INT8_CHUNKED)
+                and nbytes <= _MAX_BLOB
+            )
+            return ok, (float(clock) if ok else None)
+    except (OSError, ConnectionError, struct.error):
+        return False, None
+
+
 def probe_header(host: str, port: int, timeout_ms: int = 100) -> bool:
     """Cheap liveness probe: connect, request, validate the HEADER only.
 
@@ -386,26 +616,7 @@ def probe_header(host: str, port: int, timeout_ms: int = 100) -> bool:
     very bandwidth quarantine exists to save).  The connection is
     abandoned after the header; the Rx side's sendall into a closed
     socket is its normal ``OSError -> close`` path."""
-    deadline = time.monotonic() + timeout_ms / 1000.0
-    try:
-        with socket.create_connection(
-            (host, port), timeout=timeout_ms / 1000.0
-        ) as sock:
-            remaining = deadline - time.monotonic()
-            if remaining <= 0:
-                return False
-            sock.settimeout(remaining)
-            sock.sendall(_REQ)
-            raw = _recv_exact(sock, _HDR.size, deadline)
-            magic, version, code, _clock, _loss, nbytes = _HDR.unpack(raw)
-            return (
-                magic == _MAGIC
-                and version == 1
-                and (code in _DTYPES or code == _INT8_CHUNKED)
-                and nbytes <= _MAX_BLOB
-            )
-    except (OSError, ConnectionError, struct.error):
-        return False
+    return probe_header_ex(host, port, timeout_ms)[0]
 
 
 def _host_merge(
@@ -539,7 +750,12 @@ class TcpTransport:
         self.config = config
         self.me = config.node_index(name)
         self.schedule: Schedule = build_schedule(config)
-        self.interp = make_interpolation(config.interpolation)
+        self.interp = make_interpolation(
+            config.interpolation,
+            max_abs_loss=(
+                config.recovery.max_loss if config.recovery.enabled else None
+            ),
+        )
         self._wire_bf16 = config.protocol.wire_dtype == "bf16"
         self._wire_int8 = config.protocol.wire_dtype == "int8"
         if self._wire_bf16 and ml_dtypes is None:  # pragma: no cover
@@ -554,6 +770,11 @@ class TcpTransport:
             self.server = ChaosPeerServer(
                 spec.host, spec.port, ChaosEngine(config.chaos, self.me)
             )
+        elif config.recovery.enabled:
+            # STATE serving (peer-assisted bootstrap) lives in the
+            # Python Rx server only — the native C++ loop speaks just
+            # the blob protocol.  Same forcing rationale as chaos.
+            self.server = PeerServer(spec.host, spec.port)
         else:
             self.server = make_peer_server(spec.host, spec.port)
         self._ports = {
@@ -582,6 +803,12 @@ class TcpTransport:
         # last round's partner resolution (schedule vs. health remap).
         self.last_fetch: dict = {}
         self.last_round: dict = {}
+        # Recovery bookkeeping: the clock we last published (for the
+        # re-admission freshness check) and a pending re-sync advice
+        # record the adapter pops when a readmitted peer's clock shows
+        # WE are the stale replica.
+        self._last_clock = 0.0
+        self.resync_advice: Optional[dict] = None
 
     @property
     def port(self) -> int:
@@ -599,6 +826,7 @@ class TcpTransport:
         # the shipped copy before the collective).  int8 is quantized
         # with stochastic rounding keyed on (seed, clock, me) and
         # dequantized by the FETCHING side (ops/quantize.py).
+        self._last_clock = float(clock)
         if self._wire_int8 and vec.dtype == np.float32:
             from dpwa_tpu.ops.quantize import encode_int8_payload
 
@@ -624,10 +852,27 @@ class TcpTransport:
             host, port, timeout_ms,
             min_bandwidth_bps=self.config.protocol.min_wire_mb_per_s * 1e6,
         )
+        reason = None
+        if got is not None and self.config.recovery.enabled:
+            # Divergence/poison guard: a frame can be perfectly formed
+            # and still carry a sick replica (NaNs, exploded norm, an
+            # insane advertised loss).  Reject BEFORE the merge and feed
+            # the detector — a diverged peer is as unfit a partner as a
+            # dead one.
+            from dpwa_tpu.recovery.guard import validate_payload
+
+            reason = validate_payload(
+                got[0], got[2], self.config.recovery
+            )
+            if reason is not None:
+                got = None
+                outcome = Outcome.POISONED
         self.last_fetch = {
             "peer": peer_index, "outcome": outcome,
             "latency_s": latency_s, "nbytes": nbytes,
         }
+        if reason is not None:
+            self.last_fetch["poison_reason"] = reason
         if self.scoreboard is not None:
             self.scoreboard.record(
                 peer_index, outcome,
@@ -653,16 +898,61 @@ class TcpTransport:
         if sb is not None and sched != self.me:
             if sb.probe_due(sched, step):
                 host, port = self._ports[sched]
-                ok = probe_header(
+                ok, remote_clock = probe_header_ex(
                     host, port, self.config.health.probe_timeout_ms
                 )
                 sb.record_probe(sched, ok, round=step)
+                if (
+                    ok
+                    and remote_clock is not None
+                    and self.config.recovery.enabled
+                    and remote_clock - self._last_clock
+                    > self.config.recovery.max_clock_lag
+                ):
+                    # Re-admission freshness check: the peer came back
+                    # with a clock far AHEAD of ours — we are the stale
+                    # one (partitioned while the ring kept training).
+                    # Interpolation alone digs out slowly; advise the
+                    # adapter to re-sync (it bootstraps if auto_resync).
+                    self.resync_advice = {
+                        "peer": sched,
+                        "remote_clock": float(remote_clock),
+                        "local_clock": float(self._last_clock),
+                        "step": int(step),
+                    }
             if sb.is_quarantined(sched, step):
                 partner = self.schedule.remap_partner(
                     step, self.me, sched, sb.healthy_mask(step)
                 )
                 remapped = True
         return sched, partner, remapped
+
+    def publish_state(self, blob: bytes) -> None:
+        """Expose this worker's serialized train state for peers to
+        bootstrap from (zero shared-disk recovery)."""
+        self.server.publish_state(blob)
+
+    def fetch_state(
+        self, peer_index: int, timeout_ms: Optional[int] = None
+    ) -> Tuple[Optional[bytes], str, float, int]:
+        """Pull a donor's full serialized state (chunked, CRC-checked,
+        resumable — :func:`fetch_state`), sized by the ``recovery:``
+        config block."""
+        host, port = self._ports[peer_index]
+        rec = self.config.recovery
+        if timeout_ms is None:
+            timeout_ms = rec.bootstrap_timeout_ms
+        return fetch_state(
+            host, port, timeout_ms,
+            chunk_bytes=rec.state_chunk_bytes,
+            max_retries=rec.max_resume_retries,
+            min_bandwidth_bps=self.config.protocol.min_wire_mb_per_s * 1e6,
+        )
+
+    def pop_resync_advice(self) -> Optional[dict]:
+        """Consume the pending re-admission freshness advice, if any."""
+        advice, self.resync_advice = self.resync_advice, None
+        return advice
 
     def health_snapshot(self) -> dict:
         """JSON-ready per-peer health state (scoreboard + detector

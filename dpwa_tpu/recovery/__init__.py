@@ -1,0 +1,64 @@
+"""Crash recovery & elastic rejoin: the detect→quarantine→RECOVER loop.
+
+PR 1's health control plane (dpwa_tpu/health/) detects failed peers and
+routes around them; this package closes the loop — a crashed worker
+re-enters the ring without any shared disk, and a diverged replica
+(local or remote) is contained before it damages healthy peers:
+
+- :mod:`~dpwa_tpu.recovery.state_transfer` — pickle-free serialization
+  of an arbitrary array pytree (train state + metadata) to one blob the
+  STATE wire (``parallel/tcp.py``) ships chunked/CRC-checked/resumable;
+- :mod:`~dpwa_tpu.recovery.guard` — the one definition of a "sane
+  replica" (finite, bounded norm, bounded loss) shared by the remote
+  poisoned-payload rejection, the local rollback trigger, and the
+  interpolation rescue; plus the in-memory :class:`RollbackRing` of
+  last-good snapshots;
+- :mod:`~dpwa_tpu.recovery.bootstrap` — donor election over the healthy
+  peers (probe + deterministic ``donor_draw``) and the fetch→unpack→
+  validate bootstrap a restarted worker runs before rejoining.
+
+``state_transfer``/``guard`` are dependency-light and imported eagerly;
+``bootstrap`` imports :mod:`dpwa_tpu.parallel.tcp` (which lazily imports
+``guard`` from here), so it is deferred to attribute access — the same
+cycle-avoidance pattern as :mod:`dpwa_tpu.health`.
+"""
+
+from dpwa_tpu.recovery.guard import (  # noqa: F401
+    RollbackRing,
+    Snapshot,
+    validate_payload,
+)
+from dpwa_tpu.recovery.state_transfer import (  # noqa: F401
+    pack_state,
+    unpack_state,
+)
+
+__all__ = [
+    "RollbackRing",
+    "Snapshot",
+    "validate_payload",
+    "pack_state",
+    "unpack_state",
+    # lazy (see __getattr__):
+    "BootstrapResult",
+    "bootstrap_from_peer",
+    "choose_donor",
+]
+
+
+def __getattr__(name):
+    lazy = {
+        "BootstrapResult": ("dpwa_tpu.recovery.bootstrap", "BootstrapResult"),
+        "bootstrap_from_peer": (
+            "dpwa_tpu.recovery.bootstrap", "bootstrap_from_peer",
+        ),
+        "choose_donor": ("dpwa_tpu.recovery.bootstrap", "choose_donor"),
+    }
+    if name in lazy:
+        import importlib
+
+        module, attr = lazy[name]
+        return getattr(importlib.import_module(module), attr)
+    raise AttributeError(
+        f"module 'dpwa_tpu.recovery' has no attribute {name!r}"
+    )

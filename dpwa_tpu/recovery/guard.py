@@ -1,0 +1,115 @@
+"""Divergence guard: one definition of a sane replica + rollback ring.
+
+Three callers share :func:`validate_payload` deliberately, so "sane"
+cannot drift between them:
+
+- the TCP transport rejects a fetched remote payload that fails it
+  (classified as the ``poisoned`` detector outcome, never merged);
+- the adapter rolls its LOCAL replica back to the newest
+  :class:`RollbackRing` snapshot when the local step fails it;
+- the interpolation rescue (``interpolation._clamped``) treats a
+  finite-but-huge local loss beyond the same bound as sick metadata
+  (ADVICE round 5).
+
+Everything here is numpy + stdlib: the guard sits on the per-fetch hot
+path and must be importable without a JAX backend.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from collections import deque
+from typing import Deque, Optional
+
+import numpy as np
+
+from dpwa_tpu.config import RecoveryConfig
+
+
+def validate_payload(
+    vec: np.ndarray,
+    loss: float,
+    config: RecoveryConfig,
+) -> Optional[str]:
+    """None if ``(vec, loss)`` is a sane replica, else the violation.
+
+    Violation strings (stable — they ride into metrics JSONL):
+    ``nonfinite_params`` | ``param_norm`` | ``nonfinite_loss`` |
+    ``loss_bound``.  The int8 wire path decodes to f32 before this runs;
+    bf16 payloads are checked in f32 (the merge upcasts anyway)."""
+    v = np.asarray(vec)
+    if v.dtype != np.float32 and v.dtype != np.float64:
+        v = v.astype(np.float32)
+    if not np.all(np.isfinite(v)):
+        return "nonfinite_params"
+    norm = float(np.linalg.norm(v.astype(np.float64, copy=False)))
+    if norm > config.max_param_norm:
+        return "param_norm"
+    l = float(loss)
+    if math.isnan(l) or math.isinf(l):
+        return "nonfinite_loss"
+    if abs(l) > config.max_loss:
+        return "loss_bound"
+    return None
+
+
+@dataclasses.dataclass
+class Snapshot:
+    """One last-good ring entry: the replica vector plus the schedule
+    coordinates needed to resume from it coherently."""
+
+    vec: np.ndarray
+    step: int
+    clock: float
+    loss: float
+
+    def copy(self) -> "Snapshot":
+        return Snapshot(self.vec.copy(), self.step, self.clock, self.loss)
+
+
+class RollbackRing:
+    """In-memory ring of last-good replica snapshots.
+
+    Pushed on validated-healthy steps (every ``snapshot_every``), popped
+    when the local replica trips the guard.  :meth:`rollback` consumes
+    the newest entry: if training re-diverges right after restoring a
+    snapshot, the next rollback digs one snapshot deeper instead of
+    bouncing on the same state forever.  Purely deterministic — contents
+    are a function of the push/rollback call sequence alone."""
+
+    def __init__(self, capacity: int):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.capacity = capacity
+        self._ring: Deque[Snapshot] = deque(maxlen=capacity)
+        self.pushes = 0
+        self.rollbacks = 0
+
+    def __len__(self) -> int:
+        return len(self._ring)
+
+    def push(
+        self, vec: np.ndarray, step: int, clock: float, loss: float
+    ) -> None:
+        """Bank a healthy snapshot (the vector is copied: the caller
+        mutates its replica in place every step)."""
+        self._ring.append(
+            Snapshot(np.array(vec, copy=True), int(step), float(clock),
+                     float(loss))
+        )
+        self.pushes += 1
+
+    def newest(self) -> Optional[Snapshot]:
+        """Peek the newest snapshot without consuming it."""
+        return self._ring[-1].copy() if self._ring else None
+
+    def rollback(self) -> Optional[Snapshot]:
+        """Consume and return the newest good snapshot (None if empty)."""
+        if not self._ring:
+            return None
+        self.rollbacks += 1
+        return self._ring.pop()
+
+    def clear(self) -> None:
+        self._ring.clear()

@@ -1,0 +1,146 @@
+"""Pickle-free train-state serialization for the STATE wire.
+
+A bootstrap payload is ONE self-describing blob:
+
+    magic(4s="DPST") | u32 header_len | header JSON (utf-8) | raw buffers
+
+The JSON header carries ``{"version", "meta", "arrays", "payload_crc32"}``
+where ``arrays`` lists ``{"dtype", "shape"}`` per leaf in flatten order
+and ``meta`` is caller-supplied JSON metadata (clock, step, data-stream
+position, …).  The buffers are the leaves' little-endian bytes,
+concatenated in the same order.  Deserializing a peer's state with
+pickle would be an RCE (the same reason :mod:`dpwa_tpu.parallel.tcp`
+frames the gossip blob) — this format is parseable with ``struct`` +
+``json`` + ``np.frombuffer`` only.
+
+Unpacking is template-driven: the restarted worker re-runs its normal
+init and passes the resulting pytree as ``like``, so tree STRUCTURE
+never rides the wire — only leaf buffers do, checked leaf-by-leaf
+against the template's shapes.  (``like=None`` returns the flat leaf
+list for callers moving a known-flat payload, e.g. the adapter's single
+replica vector.)
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+import zlib
+from typing import Any, List, Optional, Tuple
+
+import numpy as np
+
+_PACK_MAGIC = b"DPST"
+_PACK_LEN = struct.Struct("<I")
+_MAX_HEADER = 1 << 24  # 16 MiB of JSON metadata is already absurd
+
+
+def _leaves(tree: Any) -> List[Any]:
+    """Flatten ``tree`` into its array leaves (jax order when available).
+
+    jax's tree flattening is the canonical order (both ends of the wire
+    use it, so order agrees by construction); a plain list/tuple of
+    arrays avoids the jax import entirely — the supervisor and tests can
+    pack without touching a backend."""
+    if isinstance(tree, (list, tuple)) and all(
+        isinstance(x, (np.ndarray, np.generic, float, int)) for x in tree
+    ):
+        return list(tree)
+    import jax
+
+    return jax.tree_util.tree_leaves(tree)
+
+
+def pack_state(tree: Any, meta: Optional[dict] = None) -> bytes:
+    """Serialize an array pytree + JSON metadata into one blob."""
+    leaves = _leaves(tree)
+    arrays = []
+    buffers = []
+    for leaf in leaves:
+        arr = np.asarray(leaf)
+        # Normalize to little-endian so the wire format is byte-stable
+        # across hosts (TPU hosts are LE; this keeps the format honest).
+        if arr.dtype.byteorder == ">":
+            arr = arr.astype(arr.dtype.newbyteorder("<"))
+        # Record the shape BEFORE ascontiguousarray: numpy promotes 0-d
+        # arrays to 1-d there, which would corrupt scalar leaves.
+        shape = list(arr.shape)
+        arr = np.ascontiguousarray(arr)
+        arrays.append({"dtype": arr.dtype.str, "shape": shape})
+        buffers.append(arr.tobytes())
+    payload = b"".join(buffers)
+    header = {
+        "version": 1,
+        "meta": meta or {},
+        "arrays": arrays,
+        "payload_crc32": zlib.crc32(payload),
+    }
+    hdr = json.dumps(header, separators=(",", ":")).encode("utf-8")
+    return _PACK_MAGIC + _PACK_LEN.pack(len(hdr)) + hdr + payload
+
+
+def unpack_state(
+    blob: bytes, like: Any = None
+) -> Tuple[Any, dict]:
+    """Parse a :func:`pack_state` blob; returns ``(state, meta)``.
+
+    With ``like`` (a template pytree from the caller's own init), the
+    leaves are validated against the template's shapes and unflattened
+    into its structure; without it, ``state`` is the flat leaf list.
+    Raises :class:`ValueError` on any structural violation — the
+    bootstrap treats that donor as unusable and elects the next one."""
+    if len(blob) < len(_PACK_MAGIC) + _PACK_LEN.size:
+        raise ValueError("state blob too short for header")
+    if blob[: len(_PACK_MAGIC)] != _PACK_MAGIC:
+        raise ValueError("bad state blob magic")
+    off = len(_PACK_MAGIC)
+    (hdr_len,) = _PACK_LEN.unpack_from(blob, off)
+    off += _PACK_LEN.size
+    if hdr_len > _MAX_HEADER or off + hdr_len > len(blob):
+        raise ValueError("state blob header length out of range")
+    try:
+        header = json.loads(blob[off : off + hdr_len].decode("utf-8"))
+    except (UnicodeDecodeError, json.JSONDecodeError) as e:
+        raise ValueError(f"state blob header is not JSON: {e}") from None
+    off += hdr_len
+    if header.get("version") != 1:
+        raise ValueError(f"unknown state blob version {header.get('version')}")
+    payload = blob[off:]
+    if zlib.crc32(payload) != header.get("payload_crc32"):
+        raise ValueError("state blob payload CRC mismatch")
+    leaves = []
+    pos = 0
+    for spec in header.get("arrays", []):
+        dtype = np.dtype(spec["dtype"])
+        shape = tuple(int(s) for s in spec["shape"])
+        nbytes = dtype.itemsize * int(np.prod(shape, dtype=np.int64))
+        if pos + nbytes > len(payload):
+            raise ValueError("state blob payload truncated")
+        count = nbytes // dtype.itemsize
+        leaves.append(
+            np.frombuffer(payload, dtype=dtype, count=count, offset=pos)
+            .reshape(shape)
+            .copy()
+        )
+        pos += nbytes
+    if pos != len(payload):
+        raise ValueError("state blob payload has trailing bytes")
+    meta = header.get("meta", {})
+    if like is None:
+        return leaves, meta
+    import jax
+
+    template, treedef = jax.tree_util.tree_flatten(like)
+    if len(template) != len(leaves):
+        raise ValueError(
+            f"state blob has {len(leaves)} leaves, template has "
+            f"{len(template)}"
+        )
+    for i, (got, want) in enumerate(zip(leaves, template)):
+        want_shape = tuple(np.shape(want))
+        if got.shape != want_shape:
+            raise ValueError(
+                f"state blob leaf {i} shape {got.shape} != template "
+                f"{want_shape}"
+            )
+    return jax.tree_util.tree_unflatten(treedef, leaves), meta

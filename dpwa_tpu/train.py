@@ -32,7 +32,7 @@ from typing import Any, Callable, NamedTuple, Optional, Tuple
 import jax
 import jax.numpy as jnp
 import optax
-from jax import shard_map
+from dpwa_tpu.utils.compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 from dpwa_tpu.interpolation import PeerMeta
@@ -394,3 +394,65 @@ def consensus_params(stacked_params: PyTree) -> PyTree:
     Gossip preserves this mean at every exchange (doubly-stochastic merges),
     so it is the natural final artifact."""
     return jax.tree.map(lambda v: v.mean(axis=0), stacked_params)
+
+
+def slice_peer_state(state: GossipTrainState, peer: int) -> GossipTrainState:
+    """One peer's view of a peer-stacked state, as host numpy.
+
+    The bootstrap donor payload (``dpwa_tpu/recovery/``): every
+    peer-stacked leaf is sliced at ``peer`` on its leading axis; the
+    per-peer ``clock``/``loss`` vectors keep their full length (they are
+    the gossip metadata every replica already shares each round), and
+    the scalar ``step`` rides unchanged.  Pairs with
+    :func:`land_peer_state`."""
+    import numpy as np
+
+    take = lambda t: jax.tree.map(lambda v: np.asarray(v)[peer], t)
+    return GossipTrainState(
+        params=take(state.params),
+        opt_state=take(state.opt_state),
+        clock=np.asarray(state.clock),
+        step=np.asarray(state.step),
+        model_state=(
+            take(state.model_state) if state.model_state is not None else None
+        ),
+        loss=np.asarray(state.loss) if state.loss is not None else None,
+    )
+
+
+def land_peer_state(
+    state: GossipTrainState, peer: int, slice_state: GossipTrainState
+) -> GossipTrainState:
+    """Write a fetched peer slice back into a peer-stacked state.
+
+    The rejoiner's landing step: its own row of every stacked leaf is
+    replaced with the donor slice, and ``clock``/``step`` adopt the
+    donor's values so the next participation/pairing draws line up with
+    the ring's schedule position."""
+    import numpy as np
+
+    def put(stacked, sl):
+        return jax.tree.map(
+            lambda v, s: jnp.asarray(np.asarray(v)).at[peer].set(
+                jnp.asarray(s)
+            ),
+            stacked,
+            sl,
+        )
+
+    return GossipTrainState(
+        params=put(state.params, slice_state.params),
+        opt_state=put(state.opt_state, slice_state.opt_state),
+        clock=jnp.asarray(slice_state.clock),
+        step=jnp.asarray(slice_state.step),
+        model_state=(
+            put(state.model_state, slice_state.model_state)
+            if state.model_state is not None
+            else None
+        ),
+        loss=(
+            jnp.asarray(slice_state.loss)
+            if slice_state.loss is not None
+            else state.loss
+        ),
+    )

@@ -35,7 +35,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
-from jax import lax, shard_map
+from jax import lax
+
+from dpwa_tpu.utils.compat import shard_map_unchecked as shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dpwa_tpu.config import DpwaConfig

@@ -1,0 +1,37 @@
+"""Version compatibility shims for the baked-in toolchain.
+
+The container pins whatever jax the image shipped with; APIs that moved
+between jax releases are resolved here ONCE so the rest of the codebase
+imports one stable name.  Keep each shim tiny and documented with the
+version boundary it bridges.
+"""
+
+from __future__ import annotations
+
+try:  # jax >= 0.4.35 exports shard_map at the top level
+    from jax import shard_map  # type: ignore[attr-defined]  # noqa: F401
+except ImportError:  # older jax: the experimental path is the same object
+    from jax.experimental.shard_map import shard_map  # noqa: F401
+
+try:  # jax >= 0.4.38 has lax.axis_size
+    from jax.lax import axis_size  # type: ignore[attr-defined]  # noqa: F401
+except ImportError:
+    import jax.lax as _lax
+
+    def axis_size(axis_name):
+        """Size of a mapped axis, via the classic psum(1) identity."""
+        return _lax.psum(1, axis_name)
+
+def shard_map_unchecked(f, **kwargs):
+    """``shard_map`` with output-replication checking disabled.
+
+    Older jax's replication checker cannot statically infer replication
+    for some multi-axis out_specs that newer jax accepts; ``check_rep``
+    itself was later removed, so probe for it."""
+    try:
+        return shard_map(f, check_rep=False, **kwargs)
+    except TypeError:
+        return shard_map(f, **kwargs)
+
+
+__all__ = ["shard_map", "shard_map_unchecked", "axis_size"]

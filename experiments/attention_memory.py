@@ -72,7 +72,7 @@ def probe(impl: str, T: int, iters: int) -> float:
     params = None
 
     if impl == "ring":
-        from jax import shard_map
+        from dpwa_tpu.utils.compat import shard_map
         from jax.sharding import Mesh, PartitionSpec as P
 
         mesh = Mesh(np.array(jax.devices()[:1]), ("sp",))

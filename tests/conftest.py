@@ -22,3 +22,58 @@ os.environ.setdefault("JAX_ENABLE_X64", "0")
 import jax
 
 jax.config.update("jax_platforms", "cpu")
+
+import socket
+import time
+
+import pytest
+
+# ---------------------------------------------------------------------------
+# Tier-1 guard for socket-binding tests (ISSUE 2 satellite): recovery /
+# health / transport tests talk over real localhost sockets, and a single
+# forgotten long timeout (or a raw test socket with NO timeout) turns a
+# deterministic failure into a tier-1 hang.  Two enforcement layers:
+#
+# - a default socket timeout while the test runs, so any socket a test
+#   creates without an explicit timeout cannot block forever;
+# - a wall-clock deadline per non-slow test in these modules — a test
+#   that legitimately needs more (soaks, chaos timing runs) belongs
+#   under ``@pytest.mark.slow``, which this guard exempts.
+# ---------------------------------------------------------------------------
+
+_SOCKET_TEST_MODULES = (
+    "test_recovery",
+    "test_health",
+    "test_tcp_transport",
+    "test_native",
+    "test_wire_dtype",
+    "test_wire_int8",
+    "test_async_freerun",
+)
+_SOCKET_DEFAULT_TIMEOUT_S = 30.0
+_SOCKET_TEST_DEADLINE_S = 120.0
+
+
+@pytest.fixture(autouse=True)
+def _socket_test_deadline(request):
+    mod = request.node.module.__name__.rpartition(".")[2]
+    if mod not in _SOCKET_TEST_MODULES or request.node.get_closest_marker(
+        "slow"
+    ):
+        yield
+        return
+    prev = socket.getdefaulttimeout()
+    socket.setdefaulttimeout(_SOCKET_DEFAULT_TIMEOUT_S)
+    t0 = time.monotonic()
+    try:
+        yield
+    finally:
+        socket.setdefaulttimeout(prev)
+        elapsed = time.monotonic() - t0
+        if elapsed > _SOCKET_TEST_DEADLINE_S:
+            pytest.fail(
+                f"{request.node.nodeid} took {elapsed:.1f}s — socket tests "
+                f"in tier-1 must finish within {_SOCKET_TEST_DEADLINE_S:.0f}s"
+                " (use fast test timeouts, or mark the test slow)",
+                pytrace=False,
+            )

@@ -1,0 +1,83 @@
+"""One gossip worker process for the supervisor chaos soak.
+
+Spawned by ``tests/test_recovery.py`` (and usable by hand) under
+``tools/supervisor.py``: fixed ports so a restarted process rebinds its
+own slot and finds its peers without any coordination service.  The
+worker runs a :class:`~dpwa_tpu.adapters.tcp_adapter.DpwaTcpAdapter`
+free-run loop; ``--crash-at-step`` hard-kills the process (``os._exit``)
+mid-run exactly once — the restarted incarnation sees
+``DPWA_BOOTSTRAP=1`` from the supervisor, fetches a healthy donor's
+full state over the TCP STATE wire, lands on the donor's step, and
+finishes the remaining steps.  Zero shared disk: the metrics JSONL is
+write-only evidence, never read back by any worker.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+from dpwa_tpu.adapters.tcp_adapter import DpwaTcpAdapter  # noqa: E402
+from dpwa_tpu.config import make_local_config  # noqa: E402
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--index", type=int, required=True)
+    ap.add_argument("--n", type=int, default=4)
+    ap.add_argument("--base-port", type=int, required=True)
+    ap.add_argument("--steps", type=int, default=40)
+    ap.add_argument("--dim", type=int, default=64)
+    ap.add_argument("--seed", type=int, default=3)
+    ap.add_argument("--metrics", required=True)
+    ap.add_argument(
+        "--crash-at-step", type=int, default=None,
+        help="os._exit(1) when reaching this step (first incarnation "
+        "only: a bootstrapped restart never re-crashes)",
+    )
+    ap.add_argument(
+        "--step-sleep", type=float, default=0.05,
+        help="pacing so peers overlap in wall time",
+    )
+    args = ap.parse_args()
+
+    cfg = make_local_config(
+        args.n,
+        base_port=args.base_port,
+        schedule="ring",
+        seed=args.seed,
+        timeout_ms=500,
+        health=dict(jitter_rounds=2),
+    )
+    bootstrapped = os.environ.get("DPWA_BOOTSTRAP", "0") == "1"
+    params = {"w": np.full(args.dim, float(args.index), np.float32)}
+    ad = DpwaTcpAdapter(
+        params, f"node{args.index}", cfg, metrics=args.metrics,
+        health_every=5,
+    )
+    try:
+        while ad.step < args.steps:
+            if (
+                args.crash_at_step is not None
+                and not bootstrapped
+                and ad.step == args.crash_at_step
+            ):
+                # Simulated crash: no close(), no flush, no cleanup.
+                os._exit(1)
+            # A deterministic, slowly-moving "train step" so replicas
+            # drift apart and a bootstrap visibly lands donor state.
+            ad.update(loss=1.0 / (1.0 + ad.step))
+            time.sleep(args.step_sleep)
+    finally:
+        ad.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

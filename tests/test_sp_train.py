@@ -144,7 +144,7 @@ def test_sp_rope_positions_are_global():
     ref_model = Llama(LlamaConfig(**BASE_CFG))
     params = jax.tree.map(lambda v: v[0], _init_params())
 
-    from jax import shard_map
+    from dpwa_tpu.utils.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     def fwd(x):

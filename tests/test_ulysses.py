@@ -11,7 +11,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax import shard_map
+from dpwa_tpu.utils.compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from dpwa_tpu.ops.ring_attention import full_attention_reference

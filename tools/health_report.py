@@ -10,7 +10,12 @@ peer:
 - final scoreboard state and suspicion;
 - lifetime rounds spent quarantined, quarantine count, probe stats;
 - fetch outcome tallies from the exchange records (including how many
-  rounds were remapped away from the peer while it was quarantined).
+  rounds were remapped away from the peer while it was quarantined);
+
+plus a recovery-event digest folded from the ``record: "event"``
+lines :meth:`~dpwa_tpu.metrics.MetricsLogger.log_event` writes —
+rollbacks (with reasons), peer bootstraps (with donors), resyncs, and
+poisoned-payload rejections (see docs/recovery.md).
 
 Usage::
 
@@ -47,8 +52,19 @@ def summarize(paths: Iterable[str]) -> Dict[str, Any]:
     """Fold every record into one per-peer summary dict."""
     peers: Dict[int, Dict[str, Any]] = {}
     last_health: Dict[int, Dict[str, Any]] = {}
-    n_exchange = n_health = 0
+    n_exchange = n_health = n_event = 0
     last_step = None
+    events: Dict[str, Any] = {
+        "rollbacks": 0,
+        "rollback_reasons": {},
+        "rollback_steps": [],
+        "bootstraps": 0,
+        "bootstrap_donors": {},
+        "bootstrap_failures": 0,
+        "resyncs": 0,
+        "resync_advised": 0,
+        "other": {},
+    }
 
     def slot(p: int) -> Dict[str, Any]:
         return peers.setdefault(
@@ -61,8 +77,36 @@ def summarize(paths: Iterable[str]) -> Dict[str, Any]:
             },
         )
 
+    poisoned = 0
     for rec in _iter_records(paths):
         last_step = rec.get("step", last_step)
+        if rec.get("record") == "event":
+            n_event += 1
+            kind = rec.get("event")
+            if kind == "rollback":
+                events["rollbacks"] += 1
+                reason = rec.get("reason", "?")
+                events["rollback_reasons"][reason] = (
+                    events["rollback_reasons"].get(reason, 0) + 1
+                )
+                events["rollback_steps"].append(rec.get("step"))
+            elif kind == "bootstrap":
+                events["bootstraps"] += 1
+                donor = str(rec.get("donor", "?"))
+                events["bootstrap_donors"][donor] = (
+                    events["bootstrap_donors"].get(donor, 0) + 1
+                )
+            elif kind == "bootstrap_failed":
+                events["bootstrap_failures"] += 1
+            elif kind == "resync":
+                events["resyncs"] += 1
+            elif kind == "resync_advised":
+                events["resync_advised"] += 1
+            else:
+                events["other"][str(kind)] = (
+                    events["other"].get(str(kind), 0) + 1
+                )
+            continue
         if rec.get("record") == "health":
             n_health += 1
             for i, p in enumerate(rec.get("peer", [])):
@@ -86,6 +130,8 @@ def summarize(paths: Iterable[str]) -> Dict[str, Any]:
             s["fetches"] += 1
             out = rec["outcome"]
             s["outcomes"][out] = s["outcomes"].get(out, 0) + 1
+        if rec.get("outcome") == "poisoned":
+            poisoned += 1
         if rec.get("remapped") and sched is not None:
             slot(sched)["remapped_away"] += 1
             if actual is not None and actual != sched:
@@ -93,10 +139,16 @@ def summarize(paths: Iterable[str]) -> Dict[str, Any]:
 
     for p, h in last_health.items():
         slot(p)["health"] = h
+    events["poisoned_fetches"] = poisoned
     return {
-        "records": {"exchange": n_exchange, "health": n_health},
+        "records": {
+            "exchange": n_exchange,
+            "health": n_health,
+            "event": n_event,
+        },
         "last_step": last_step,
         "peers": {p: peers[p] for p in sorted(peers)},
+        "recovery": events,
     }
 
 
@@ -104,7 +156,8 @@ def _print_table(summary: Dict[str, Any]) -> None:
     recs = summary["records"]
     print(
         f"# {recs['exchange']} exchange records, {recs['health']} health "
-        f"records, last step {summary['last_step']}"
+        f"records, {recs['event']} event records, last step "
+        f"{summary['last_step']}"
     )
     hdr = (
         f"{'peer':>4}  {'state':<12} {'suspicion':>9}  {'q_rounds':>8} "
@@ -125,6 +178,46 @@ def _print_table(summary: Dict[str, Any]) -> None:
                 f"{k}={v}" for k, v in sorted(s["outcomes"].items())
             )
         )
+    ev = summary.get("recovery", {})
+    if any(
+        v for k, v in ev.items() if isinstance(v, int)
+    ) or ev.get("other"):
+        print()
+        print("# recovery events")
+        if ev.get("rollbacks"):
+            reasons = ", ".join(
+                f"{k}={v}"
+                for k, v in sorted(ev["rollback_reasons"].items())
+            )
+            steps = ev["rollback_steps"]
+            shown = ", ".join(str(s) for s in steps[:8])
+            if len(steps) > 8:
+                shown += ", ..."
+            print(
+                f"  rollbacks: {ev['rollbacks']} ({reasons}) "
+                f"at steps [{shown}]"
+            )
+        if ev.get("bootstraps") or ev.get("bootstrap_failures"):
+            donors = ", ".join(
+                f"donor {k}: {v}"
+                for k, v in sorted(ev["bootstrap_donors"].items())
+            )
+            print(
+                f"  bootstraps: {ev['bootstraps']} ({donors}); "
+                f"failed: {ev['bootstrap_failures']}"
+            )
+        if ev.get("resyncs") or ev.get("resync_advised"):
+            print(
+                f"  resyncs: {ev['resyncs']} "
+                f"(advised: {ev['resync_advised']})"
+            )
+        if ev.get("poisoned_fetches"):
+            print(
+                f"  poisoned payloads rejected pre-merge: "
+                f"{ev['poisoned_fetches']}"
+            )
+        for k, v in sorted(ev.get("other", {}).items()):
+            print(f"  {k}: {v}")
 
 
 def main(argv=None) -> int:
