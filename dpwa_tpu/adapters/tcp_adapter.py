@@ -219,6 +219,19 @@ class DpwaTcpAdapter:
                 self._event("resync_advised", **advice)
                 if self._recovery.auto_resync:
                     self._resync()
+        # Membership plane: surface this round's epidemic events
+        # (refutations, component changes, partition entered/healed)
+        # into the metrics JSONL, then act on heal advice.
+        for ev in self.transport.pop_membership_events():
+            fields = dict(ev)
+            self._event(fields.pop("event"), **fields)
+        heal = self.transport.pop_heal_advice()
+        if (
+            heal is not None
+            and self.config.membership.heal_reconcile
+            and self._recovery is not None
+        ):
+            self._reconcile_heal(heal)
         if self.metrics is not None:
             info = self.transport.last_round
             self.metrics.log(
@@ -235,6 +248,92 @@ class DpwaTcpAdapter:
                     step, self.transport.health_snapshot()
                 )
         return self.params
+
+    def _reconcile_heal(self, advice: dict) -> None:
+        """Anti-entropy merge with a returning component after a heal.
+
+        Interpolation alone re-converges the halves slowly (one pairwise
+        merge per round); the reconciliation pulls one RETURNING node's
+        full state over the PR 2 state-transfer wire and folds it in
+        with a component-size weight, so both halves land near the
+        population mean in one shot.  Every byte passes the same
+        ``validate_payload`` guard as a gossip frame, and the current
+        replica is banked in the rollback ring first — a poisoned or
+        diverged returning component cannot smuggle its state past the
+        guard rails that protect ordinary merges."""
+        from dpwa_tpu.parallel.schedules import heal_draw
+        from dpwa_tpu.recovery.state_transfer import unpack_state
+
+        me = self.transport.me
+        returning = sorted(
+            p for p in advice.get("returning", []) if p != me
+        )
+        if not returning:
+            return
+        # Deterministic donor election (threefry, wall-clock-free): every
+        # replay of a seed reconciles against the same donor.
+        donor = returning[
+            int(
+                heal_draw(
+                    self.transport.schedule.seed,
+                    int(advice.get("step", self._step)),
+                    me,
+                    len(returning),
+                )
+            )
+        ]
+        blob, outcome, _lat, nbytes = self.transport.fetch_state(donor)
+        if not blob:
+            self._event(
+                "partition_reconcile_failed", donor=donor, outcome=outcome
+            )
+            return
+        try:
+            state, meta = unpack_state(blob, like=None)
+        except ValueError as e:
+            self._event(
+                "partition_reconcile_rejected", donor=donor, reason=str(e)
+            )
+            return
+        if not state:
+            self._event(
+                "partition_reconcile_rejected", donor=donor,
+                reason="empty_state",
+            )
+            return
+        remote_vec = np.asarray(state[0], dtype=np.float32)
+        if remote_vec.shape != self._vec.shape:
+            self._event(
+                "partition_reconcile_rejected", donor=donor,
+                reason="shape_mismatch",
+            )
+            return
+        remote_loss = float(meta.get("loss", 0.0))
+        reason = validate_payload(remote_vec, remote_loss, self._recovery)
+        if reason is not None:
+            self._event(
+                "partition_reconcile_rejected", donor=donor, reason=reason
+            )
+            return
+        if self.ring is not None:
+            # Bank the pre-reconcile replica: if the merged result trips
+            # the guard (or later steps reveal the heal pulled in a sick
+            # component), the ordinary rollback path undoes it.
+            self.ring.push(self._vec, self._step, self._clock, self._last_loss)
+        w = float(advice.get("weight", 0.5))
+        merged = ((1.0 - w) * self._vec + w * remote_vec).astype(np.float32)
+        reason = validate_payload(merged, self._last_loss, self._recovery)
+        if reason is not None:
+            self._event(
+                "partition_reconcile_rejected", donor=donor, reason=reason,
+                stage="merged",
+            )
+            return
+        self._vec = merged
+        self._event(
+            "partition_reconciled", donor=donor, weight=w, nbytes=nbytes,
+            returning=returning,
+        )
 
     def _resync(self) -> bool:
         """Mid-run re-sync: adopt a healthy donor's replica + clock but

@@ -56,6 +56,14 @@ Schema::
       truncate_probability: 0.0 # cut the frame mid-payload
       corrupt_probability: 0.0  # flip the frame's magic bytes
       down_windows: []          # [{peer, start, stop}]: hard-down rounds
+      partition_windows: []     # [{group: [peers], start, stop}]: block all
+                                #   links between group and its complement
+      link_windows: []          # [{src, dst, start, stop}]: block one
+                                #   DIRECTED link (asymmetric faults)
+      partition_probability: 0.0  # drawn partitions: each block of
+                                #   partition_len_rounds splits the ring
+                                #   into two drawn groups at this rate
+      partition_len_rounds: 8
     recovery:                   # crash recovery & divergence guard
       enabled: true             # peer bootstrap serving + payload guard
       max_param_norm: 1.0e12    # reject/roll back when ||vec||_2 exceeds
@@ -71,6 +79,21 @@ Schema::
                                 #   ours by more than this
       auto_resync: false        # adapter re-bootstraps itself when a
                                 #   re-admission freshness check trips
+    membership:                 # epidemic membership & partition tolerance
+      enabled: true             # piggyback a membership digest on every
+                                #   gossip frame (needs health.enabled)
+      indirect_probes: 2        # K relay probes before suspect->quarantine
+      relay_timeout_ms: 250     # budget per relay probe round-trip
+      dead_after_quarantines: 3 # declare a peer dead after this many
+                                #   consecutive failed re-admissions
+      quorum_fraction: 0.5      # degraded mode when the connected
+                                #   component falls below this fraction
+      degraded_alpha_scale: 1.0 # damp interpolation alpha while degraded
+                                #   (1.0 = off)
+      heal_reconcile: true      # anti-entropy state merge on partition heal
+      reconcile_min_fraction: 0.3  # reconcile only when the returning
+                                #   component is at least this fraction
+      max_heal_weight: 0.75     # clamp on the returning side's merge weight
 """
 
 from __future__ import annotations
@@ -255,6 +278,20 @@ class ChaosConfig:
     truncate_probability: float = 0.0
     corrupt_probability: float = 0.0
     down_windows: tuple[tuple[int, int, int], ...] = ()
+    # Partition injection: during [start, stop) every link BETWEEN
+    # ``group`` and its complement is blocked (both directions); links
+    # inside either side stay up.  Entry shape: (group_tuple, start, stop)
+    # or the YAML mapping {group: [...], start, stop}.
+    partition_windows: tuple[tuple[tuple[int, ...], int, int], ...] = ()
+    # Single DIRECTED link block (src cannot reach dst) — the asymmetric
+    # fault that makes one node falsely suspect a live peer.  Entry shape:
+    # (src, dst, start, stop) or {src, dst, start, stop}.
+    link_windows: tuple[tuple[int, int, int, int], ...] = ()
+    # Drawn partitions: time is sliced into blocks of partition_len_rounds
+    # rounds; each block independently splits the ring at this rate, with
+    # per-peer group assignment drawn per block (chaos_draw kinds 5/6).
+    partition_probability: float = 0.0
+    partition_len_rounds: int = 8
 
     def __post_init__(self) -> None:
         for name in (
@@ -263,6 +300,7 @@ class ChaosConfig:
             "throttle_probability",
             "truncate_probability",
             "corrupt_probability",
+            "partition_probability",
         ):
             v = getattr(self, name)
             if not 0.0 <= v <= 1.0:
@@ -274,6 +312,11 @@ class ChaosConfig:
                 f"throttle_bytes_per_s must be > 0, "
                 f"got {self.throttle_bytes_per_s}"
             )
+        if self.partition_len_rounds < 1:
+            raise ValueError(
+                f"partition_len_rounds must be >= 1, "
+                f"got {self.partition_len_rounds}"
+            )
         windows = []
         for w in self.down_windows:
             if isinstance(w, Mapping):
@@ -283,6 +326,25 @@ class ChaosConfig:
                 raise ValueError(f"bad down_windows entry {w!r}")
             windows.append(w)
         object.__setattr__(self, "down_windows", tuple(windows))
+        parts = []
+        for w in self.partition_windows:
+            if isinstance(w, Mapping):
+                w = (w["group"], w["start"], w["stop"])
+            group = tuple(sorted(int(p) for p in w[0]))
+            start, stop = int(w[1]), int(w[2])
+            if not group or min(group) < 0 or start < 0 or stop < start:
+                raise ValueError(f"bad partition_windows entry {w!r}")
+            parts.append((group, start, stop))
+        object.__setattr__(self, "partition_windows", tuple(parts))
+        links = []
+        for w in self.link_windows:
+            if isinstance(w, Mapping):
+                w = (w["src"], w["dst"], w["start"], w["stop"])
+            w = tuple(int(x) for x in w)
+            if len(w) != 4 or min(w) < 0 or w[3] < w[2]:
+                raise ValueError(f"bad link_windows entry {w!r}")
+            links.append(w)
+        object.__setattr__(self, "link_windows", tuple(links))
 
 
 @dataclasses.dataclass(frozen=True)
@@ -353,6 +415,85 @@ class RecoveryConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class MembershipConfig:
+    """``membership:`` block — epidemic membership & partition tolerance.
+
+    SWIM-style dissemination over the existing gossip wire: every frame
+    carries an optional trailing digest (per-peer state, suspicion,
+    incarnation); receivers merge it into their scoreboard so the whole
+    ring converges on a shared membership view instead of each node
+    rediscovering failures alone.  Needs ``health.enabled`` (the digest
+    IS the scoreboard view) and forces the Python Rx server (the relay
+    verb and digest trailer live there).  All decisions are keyed on
+    gossip rounds and threefry draws — no wall clock — so membership
+    event sequences are bit-identical across replays of a seed."""
+
+    enabled: bool = True
+    # Indirect probing: before promoting suspect -> quarantined on own
+    # evidence, ask K deterministically-drawn healthy peers to
+    # header-probe the suspect (0 = promote on own evidence alone).
+    indirect_probes: int = 2
+    relay_timeout_ms: int = 250
+    # A quarantined peer that fails this many consecutive re-admission
+    # probes is disseminated as ``dead`` (still probed locally — dead is
+    # a gossip label, not a tombstone).
+    dead_after_quarantines: int = 3
+    # Degraded mode when |connected component| / n_peers falls BELOW
+    # this fraction (strictly below: a 2-node ring losing one peer sits
+    # exactly at 0.5 and is a peer failure, not a partition).
+    quorum_fraction: float = 0.5
+    # While degraded, scale interpolation alpha by this factor so a
+    # minority island drifts more slowly from the majority (1.0 = off).
+    degraded_alpha_scale: float = 1.0
+    # Heal reconciliation: on seeing a component return, anti-entropy
+    # merge with a drawn donor from the returning side, weighted by its
+    # relative size, guarded by validate_payload + RollbackRing.
+    heal_reconcile: bool = True
+    # Reconcile only when the returning component is at least this
+    # fraction of the ring — a single readmitted peer re-syncs itself
+    # (recovery.max_clock_lag advice) rather than dragging everyone
+    # through a state merge.
+    reconcile_min_fraction: float = 0.3
+    # Clamp on the returning side's merge weight, so even a majority
+    # returning component cannot fully overwrite the local replica.
+    max_heal_weight: float = 0.75
+
+    def __post_init__(self) -> None:
+        if self.indirect_probes < 0:
+            raise ValueError(
+                f"indirect_probes must be >= 0, got {self.indirect_probes}"
+            )
+        if self.relay_timeout_ms < 1:
+            raise ValueError(
+                f"relay_timeout_ms must be >= 1, got {self.relay_timeout_ms}"
+            )
+        if self.dead_after_quarantines < 1:
+            raise ValueError(
+                f"dead_after_quarantines must be >= 1, "
+                f"got {self.dead_after_quarantines}"
+            )
+        if not 0.0 <= self.quorum_fraction <= 1.0:
+            raise ValueError(
+                f"quorum_fraction must be in [0, 1], got {self.quorum_fraction}"
+            )
+        if not 0.0 < self.degraded_alpha_scale <= 1.0:
+            raise ValueError(
+                f"degraded_alpha_scale must be in (0, 1], "
+                f"got {self.degraded_alpha_scale}"
+            )
+        if not 0.0 <= self.reconcile_min_fraction <= 1.0:
+            raise ValueError(
+                f"reconcile_min_fraction must be in [0, 1], "
+                f"got {self.reconcile_min_fraction}"
+            )
+        if not 0.0 < self.max_heal_weight <= 1.0:
+            raise ValueError(
+                f"max_heal_weight must be in (0, 1], "
+                f"got {self.max_heal_weight}"
+            )
+
+
+@dataclasses.dataclass(frozen=True)
 class InterpolationConfig:
     type: str = "constant"
     factor: float = 0.5
@@ -372,6 +513,7 @@ class DpwaConfig:
     health: HealthConfig = HealthConfig()
     chaos: ChaosConfig = ChaosConfig()
     recovery: RecoveryConfig = RecoveryConfig()
+    membership: MembershipConfig = MembershipConfig()
 
     @property
     def n_peers(self) -> int:
@@ -428,8 +570,10 @@ def config_from_dict(raw: Mapping[str, Any]) -> DpwaConfig:
     health = dict(raw.get("health") or {})
     chaos = dict(raw.get("chaos") or {})
     recovery = dict(raw.get("recovery") or {})
-    if "down_windows" in chaos and chaos["down_windows"] is not None:
-        chaos["down_windows"] = tuple(chaos["down_windows"])
+    membership = dict(raw.get("membership") or {})
+    for key in ("down_windows", "partition_windows", "link_windows"):
+        if chaos.get(key) is not None:
+            chaos[key] = tuple(chaos[key])
     return DpwaConfig(
         nodes=_build_nodes(raw["nodes"]),
         protocol=ProtocolConfig(**proto),
@@ -437,6 +581,7 @@ def config_from_dict(raw: Mapping[str, Any]) -> DpwaConfig:
         health=HealthConfig(**health),
         chaos=ChaosConfig(**chaos),
         recovery=RecoveryConfig(**recovery),
+        membership=MembershipConfig(**membership),
     )
 
 
@@ -461,18 +606,21 @@ def make_local_config(
     health: "HealthConfig | Mapping[str, Any] | None" = None,
     chaos: "ChaosConfig | Mapping[str, Any] | None" = None,
     recovery: "RecoveryConfig | Mapping[str, Any] | None" = None,
+    membership: "MembershipConfig | Mapping[str, Any] | None" = None,
     **protocol_kwargs: Any,
 ) -> DpwaConfig:
     """Programmatic config for tests/benchmarks: n local peers on 127.0.0.1.
 
-    ``health`` / ``chaos`` / ``recovery`` accept a config object or a
-    plain dict (the YAML-block shorthand)."""
+    ``health`` / ``chaos`` / ``recovery`` / ``membership`` accept a
+    config object or a plain dict (the YAML-block shorthand)."""
     if isinstance(health, Mapping):
         health = HealthConfig(**health)
     if isinstance(chaos, Mapping):
         chaos = ChaosConfig(**chaos)
     if isinstance(recovery, Mapping):
         recovery = RecoveryConfig(**recovery)
+    if isinstance(membership, Mapping):
+        membership = MembershipConfig(**membership)
     return DpwaConfig(
         nodes=tuple(
             NodeSpec(name=f"node{i}", host="127.0.0.1", port=base_port + i)
@@ -488,4 +636,5 @@ def make_local_config(
         health=health if health is not None else HealthConfig(),
         chaos=chaos if chaos is not None else ChaosConfig(),
         recovery=recovery if recovery is not None else RecoveryConfig(),
+        membership=membership if membership is not None else MembershipConfig(),
     )

@@ -47,6 +47,10 @@ _KIND_DELAY = 1
 _KIND_THROTTLE = 2
 _KIND_TRUNCATE = 3
 _KIND_CORRUPT = 4
+# Drawn partitions: kind 5 decides whether a time block is split (drawn
+# once per block, peer key 0); kind 6 assigns each peer a side.
+_KIND_PARTITION = 5
+_KIND_PARTITION_SIDE = 6
 # Priority order when several draws fire in one round: exactly one fault
 # kind applies per (round, peer) so injected behavior stays analyzable.
 _PRIORITY = (
@@ -91,6 +95,42 @@ class ChaosEngine:
             for p, start, stop in self.config.down_windows
         )
 
+    def _drawn_side(self, round: int, peer: int) -> Optional[bool]:
+        """Drawn-partition side of ``peer`` at ``round``; None when the
+        current time block is not split.  Both endpoints of a link draw
+        from the same (seed, block, peer) streams, so every process
+        agrees on the partition without any coordination."""
+        cfg = self.config
+        if cfg.partition_probability <= 0.0:
+            return None
+        block = round // cfg.partition_len_rounds
+        if (
+            chaos_draw(cfg.seed, block, 0, _KIND_PARTITION)
+            >= cfg.partition_probability
+        ):
+            return None
+        return chaos_draw(cfg.seed, block, peer, _KIND_PARTITION_SIDE) < 0.5
+
+    def link_blocked(self, round: int, src: int, dst: int) -> bool:
+        """True when the DIRECTED link src -> dst is partitioned away at
+        ``round``.  Consulted by the FETCHER before connecting (the
+        serving side cannot know who is fetching), from the same config
+        both processes hold — so the block is symmetric-by-agreement for
+        partition windows, and genuinely one-sided for link_windows."""
+        if src == dst:
+            return False
+        cfg = self.config
+        for group, start, stop in cfg.partition_windows:
+            if start <= round < stop and (src in group) != (dst in group):
+                return True
+        for s, d, start, stop in cfg.link_windows:
+            if s == src and d == dst and start <= round < stop:
+                return True
+        side_src = self._drawn_side(round, src)
+        if side_src is not None and side_src != self._drawn_side(round, dst):
+            return True
+        return False
+
     def plan(self, round: int) -> FaultPlan:
         if self.down(round):
             return FaultPlan(kind="down")
@@ -130,9 +170,14 @@ def mutate_frame(payload: bytes, kind: str) -> Optional[bytes]:
         # Flip the magic: the fetcher's header validation must reject it.
         return b"XXXX" + payload[4:]
     if kind == "truncate":
-        # Cut mid-payload (past the header, so the fetcher commits to a
+        # Cut mid-VECTOR (past the header, so the fetcher commits to a
         # payload read and then hits the peer-closed short-read path).
-        cut = _HDR.size + max(1, (len(payload) - _HDR.size) // 2)
+        # The cut is placed from the header's nbytes, not the frame
+        # length: a membership digest trailer after the vector must not
+        # absorb the truncation and leave the vector intact.
+        nbytes = _HDR.unpack_from(payload, 0)[5]
+        body = min(int(nbytes), len(payload) - _HDR.size)
+        cut = _HDR.size + max(1, body // 2)
         return payload[: min(cut, len(payload) - 1)]
     return payload
 
@@ -158,25 +203,40 @@ class ChaosPeerServer:
 
         self._srv = _Server(host, port)
         self.port = self._srv.port
+        # Relay probes from this node honor the injected partition too:
+        # a relayer inside our component cannot reach a suspect across
+        # the split, exactly like a real partition.
+        self._srv.relay_guard = (
+            lambda target: engine.link_blocked(
+                self._round, engine.peer, target
+            )
+        )
 
-    def publish(self, vec, clock, loss, code=None) -> None:
+    def publish(self, vec, clock, loss, code=None, digest=None) -> None:
         # The integer publish clock IS the round key: training loops
         # publish clock = step, pinning faults to gossip rounds.
         self._round = int(clock)
-        self._srv.publish(vec, clock, loss, code)
+        self._srv.publish(vec, clock, loss, code, digest)
 
     def publish_state(self, blob: bytes) -> None:
         self._srv.publish_state(blob)
 
     def _serve_with_faults(self, srv, conn) -> None:
         from dpwa_tpu.parallel.tcp import (
-            _REQ, _STATE_REQ, _STATE_REQ_BODY, _recv_exact,
+            _RELAY_REQ, _REQ, _STATE_REQ, _STATE_REQ_BODY, _recv_exact,
         )
 
         plan = self.engine.plan(self._round)
         if plan.kind in ("down", "drop"):
             return  # caller closes: the fetcher sees a reset/short read
         req = _recv_exact(conn, len(_REQ))
+        if req == _RELAY_REQ:
+            # Relay probes honor down/drop (above) and delay; the
+            # frame mutations target the gossip blob only.
+            if plan.kind == "delay":
+                time.sleep(plan.delay_s)
+            srv._handle_relay(conn)
+            return
         if req == _STATE_REQ:
             # STATE transfers honor down/drop (a dead peer serves no
             # bootstrap either) and delay; the frame-level mutations

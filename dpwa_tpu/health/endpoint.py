@@ -11,8 +11,10 @@ peers).  Enabled via ``health.healthz_port`` in the YAML config
     $ curl http://127.0.0.1:<port>/healthz
     {"me": 0, "round": 41, "peers": {"1": {"state": "healthy", ...}}}
 
-Any request path gets the same snapshot — the endpoint is a liveness/
-introspection hook, not a router."""
+``/membership`` serves just the snapshot's membership sub-document
+(incarnation, component, partition state — present when the epidemic
+membership plane is enabled); every other path gets the full snapshot —
+the endpoint is a liveness/introspection hook, not a general router."""
 
 from __future__ import annotations
 
@@ -57,14 +59,20 @@ class HealthzServer:
                 break
             try:
                 conn.settimeout(2.0)
-                # Drain the request line + headers (best effort; we serve
-                # the same document whatever was asked).
+                # Read the request line (best effort) for the one routed
+                # path; anything unparseable serves the full snapshot.
+                raw = b""
                 try:
-                    conn.recv(4096)
+                    raw = conn.recv(4096)
                 except OSError:
                     pass
                 try:
-                    body = json.dumps(self._snapshot_fn()).encode()
+                    doc = self._snapshot_fn()
+                    if b" /membership" in raw.split(b"\r\n", 1)[0]:
+                        doc = doc.get("membership") or {
+                            "error": "membership disabled"
+                        }
+                    body = json.dumps(doc).encode()
                 except Exception:  # snapshot must never kill the endpoint
                     body = b'{"error": "snapshot failed"}'
                 conn.sendall(

@@ -28,10 +28,14 @@ public method takes the internal lock.
 from __future__ import annotations
 
 import threading
-from typing import Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Union
 
 from dpwa_tpu.config import HealthConfig
-from dpwa_tpu.health.detector import FailureDetector, Outcome
+from dpwa_tpu.health.detector import (
+    DEFAULT_FAILURE_WEIGHTS,
+    FailureDetector,
+    Outcome,
+)
 from dpwa_tpu.parallel.schedules import backoff_jitter_draw
 
 
@@ -73,6 +77,10 @@ class Scoreboard:
         self._probe_attempts: Dict[int, int] = {}
         self._probe_successes: Dict[int, int] = {}
         self._round = 0  # highest round observed (fallback clock)
+        # Optional membership-view provider (a MembershipManager): when
+        # attached, snapshot() folds the epidemic view (incarnations,
+        # component, partition state) into the health snapshot.
+        self._membership: Optional[Any] = None
 
     # ------------------------------------------------------------------
     # Outcome ingestion
@@ -100,13 +108,47 @@ class Scoreboard:
                     self._state[peer] = PeerState.HEALTHY
             return self._state.get(peer, PeerState.HEALTHY)
 
-    def record_probe(self, peer: int, ok: bool, round: Optional[int] = None):
-        """Result of a re-admission probe for a quarantined peer."""
+    def record_probe(
+        self,
+        peer: int,
+        ok: Union[bool, str],
+        round: Optional[int] = None,
+    ):
+        """Result of a header probe against ``peer``.
+
+        ``ok`` is either a bool (legacy re-admission form) or a
+        classified :class:`~dpwa_tpu.health.detector.Outcome` string, so
+        relay/readmission probes feed suspicion symmetrically with
+        fetches.  A QUARANTINED peer keeps the original re-admission
+        semantics (success readmits, failure doubles the backoff); a
+        non-quarantined peer's probe outcome flows through the detector
+        exactly like a fetch outcome — a relayed success decays a false
+        suspicion, a relayed failure is corroborating evidence."""
+        if isinstance(ok, str):
+            outcome = ok
+            success = outcome == Outcome.SUCCESS
+        else:
+            success = bool(ok)
+            outcome = Outcome.SUCCESS if success else Outcome.REFUSED
         with self._lock:
             r = self._clock(round)
             self._probe_attempts[peer] = self._probe_attempts.get(peer, 0) + 1
+            if self._state.get(peer) != PeerState.QUARANTINED:
+                # Symmetric path: probes are evidence, same as fetches.
+                if success:
+                    self._probe_successes[peer] = (
+                        self._probe_successes.get(peer, 0) + 1
+                    )
+                suspicion = self.detector.observe(peer, outcome)
+                if suspicion >= self.config.suspicion_threshold:
+                    self._enter_quarantine(peer, r)
+                elif suspicion > 0.0:
+                    self._state[peer] = PeerState.SUSPECT
+                else:
+                    self._state[peer] = PeerState.HEALTHY
+                return
             self._settle_quarantined_rounds(peer, r)
-            if ok:
+            if success:
                 self._probe_successes[peer] = (
                     self._probe_successes.get(peer, 0) + 1
                 )
@@ -118,6 +160,62 @@ class Scoreboard:
             else:
                 # Still dead: back off again, twice as long.
                 self._enter_quarantine(peer, r)
+
+    def would_quarantine(self, peer: int, outcome: str) -> bool:
+        """True when recording ``outcome`` against ``peer`` NOW would
+        cross the quarantine threshold — the transport's trigger for
+        indirect probing: ask relays *before* the promoting record."""
+        weight = DEFAULT_FAILURE_WEIGHTS.get(outcome)
+        if weight is None:
+            return False
+        with self._lock:
+            if self._state.get(peer) == PeerState.QUARANTINED:
+                return False
+            current = self.detector.suspicion(peer)
+            return current + weight >= self.config.suspicion_threshold
+
+    def readmit(self, peer: int, round: Optional[int] = None) -> bool:
+        """Force ``peer`` back to healthy on refutation evidence (it
+        disseminated ``alive`` at a higher incarnation than our
+        suspicion/quarantine of it).  Returns True when state changed."""
+        with self._lock:
+            r = self._clock(round)
+            state = self._state.get(peer, PeerState.HEALTHY)
+            if state == PeerState.HEALTHY:
+                return False
+            self._settle_quarantined_rounds(peer, r)
+            self._state[peer] = PeerState.HEALTHY
+            self._quarantine_streak[peer] = 0
+            rec = self.detector.record(peer)
+            rec.suspicion = 0.0
+            rec.failure_streak = 0
+            return True
+
+    def adopt_quarantine(self, peer: int, round: Optional[int] = None) -> bool:
+        """Adopt a REMOTE quarantine claim disseminated by the digest:
+        quarantine ``peer`` without local failure evidence, with the
+        standard streak backoff.  No-op (False) when already quarantined."""
+        with self._lock:
+            r = self._clock(round)
+            if self._state.get(peer) == PeerState.QUARANTINED:
+                return False
+            self._enter_quarantine(peer, r)
+            return True
+
+    def quarantine_streak(self, peer: int) -> int:
+        """Consecutive failed re-admissions (feeds the ``dead`` label)."""
+        with self._lock:
+            return self._quarantine_streak.get(peer, 0)
+
+    def suspicion(self, peer: int) -> float:
+        with self._lock:
+            return self.detector.suspicion(peer)
+
+    def attach_membership(self, provider: Any) -> None:
+        """Attach a membership-view provider (``view_snapshot()`` dict)
+        so health snapshots carry the epidemic view."""
+        with self._lock:
+            self._membership = provider
 
     # ------------------------------------------------------------------
     # Queries (the transport's decision points)
@@ -199,9 +297,13 @@ class Scoreboard:
         """JSON-ready health snapshot for metrics / the /healthz endpoint.
 
         Per remote peer: state, suspicion, quarantine accounting, and the
-        detector's EWMA statistics."""
+        detector's EWMA statistics.  With a membership provider attached,
+        adds per-peer ``incarnation`` and a top-level ``membership`` dict
+        (own incarnation, component id/size, partition state)."""
         with self._lock:
             r = self._clock(round)
+            membership = self._membership
+            view = membership.view_snapshot() if membership is not None else None
             peers = {}
             for p in range(self.n_peers):
                 if p == self.me:
@@ -225,8 +327,15 @@ class Scoreboard:
                     probe_attempts=self._probe_attempts.get(p, 0),
                     probe_successes=self._probe_successes.get(p, 0),
                 )
+                if view is not None:
+                    info["incarnation"] = view["incarnations"].get(p, 0)
                 peers[p] = info
-            return {"me": self.me, "round": r, "peers": peers}
+            snap = {"me": self.me, "round": r, "peers": peers}
+            if view is not None:
+                snap["membership"] = {
+                    k: v for k, v in view.items() if k != "incarnations"
+                }
+            return snap
 
 
 def run_probe(

@@ -1,0 +1,43 @@
+"""Epidemic membership, partition tolerance, heal-time reconciliation.
+
+SWIM-style membership for the TCP gossip ring (docs/membership.md):
+
+- :mod:`~dpwa_tpu.membership.digest` — the compact versioned digest
+  piggybacked as an optional trailing section on every gossip frame,
+  plus the incarnation-based merge rules;
+- :mod:`~dpwa_tpu.membership.manager` — the merged view, refutation,
+  connected-component / quorum / degraded-mode bookkeeping, and the
+  heal-reconciliation advice the adapter acts on.
+
+The transport wiring (digest trailer, relay-probe verb, indirect
+probing) lives in :mod:`dpwa_tpu.parallel.tcp`; the state merge itself
+reuses the PR 2 recovery machinery (state transfer + validate_payload +
+RollbackRing)."""
+
+from dpwa_tpu.membership.digest import (
+    ALIVE,
+    DEAD,
+    QUARANTINED,
+    SUSPECT,
+    STATE_NAMES,
+    Digest,
+    MemberEntry,
+    decode_digest,
+    encode_digest,
+    merge_entry,
+)
+from dpwa_tpu.membership.manager import MembershipManager
+
+__all__ = [
+    "ALIVE",
+    "SUSPECT",
+    "QUARANTINED",
+    "DEAD",
+    "STATE_NAMES",
+    "Digest",
+    "MemberEntry",
+    "decode_digest",
+    "encode_digest",
+    "merge_entry",
+    "MembershipManager",
+]

@@ -1,0 +1,193 @@
+"""Compact versioned membership digest — the epidemic payload.
+
+SWIM-style dissemination (cf. the Prime collective-communications design,
+PAPERS.md): every gossip frame carries the sender's current view of every
+peer as a fixed-width trailing section, and receivers fold it into their
+own view.  The digest is deliberately tiny — 11 bytes per peer — so
+piggybacking it on every exchange costs nothing next to the replica
+payload, which is the whole point of epidemic dissemination: membership
+information spreads at the gossip fan-out rate with zero extra
+connections.
+
+Wire layout (append-only versioned; see docs/membership.md)::
+
+    DPWM | u8 version | u16 origin | u32 origin_round | u16 n_entries
+    then n_entries ×:
+    u16 peer | u8 state | u32 incarnation | f32 suspicion
+
+States are severity-ordered so "more damning wins" is an integer
+comparison.  ``dead`` is a gossip label (give up remapping to this peer),
+not a tombstone — the origin keeps probing and will disseminate ``alive``
+again if the peer returns.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import struct
+from typing import Dict, Iterator, Optional, Tuple
+
+DIGEST_MAGIC = b"DPWM"
+DIGEST_VERSION = 1
+
+# Severity-ordered member states (merge rule: same incarnation -> the
+# numerically larger state wins).
+ALIVE = 0
+SUSPECT = 1
+QUARANTINED = 2
+DEAD = 3
+
+STATE_NAMES = ("alive", "suspect", "quarantined", "dead")
+
+_DIGEST_HDR = struct.Struct("<4sBHIH")  # magic, version, origin, round, n
+_ENTRY = struct.Struct("<HBIf")  # peer, state, incarnation, suspicion
+
+# Upper bound a receiver will buffer for one digest body; far above any
+# real ring (65535 peers × 11 B ≈ 700 KiB) but finite, so a corrupt
+# length field cannot make the reader allocate unboundedly.
+MAX_DIGEST_BYTES = 1 << 20
+
+# Wire-reader helpers (dpwa_tpu/parallel/tcp.py): the trailing-section
+# read is two-phase — fixed header first, then the entry block the
+# header's count implies.
+HEADER_SIZE = _DIGEST_HDR.size
+
+
+def header_entry_count(header: bytes) -> Optional[int]:
+    """Entry count from a digest header, or None when the bytes are not
+    a digest (wrong magic/version/length) — the old-peer/no-digest case."""
+    if len(header) != _DIGEST_HDR.size:
+        return None
+    magic, version, _origin, _rnd, n = _DIGEST_HDR.unpack(header)
+    if magic != DIGEST_MAGIC or version != DIGEST_VERSION:
+        return None
+    if n * _ENTRY.size > MAX_DIGEST_BYTES:
+        return None
+    return int(n)
+
+
+def entries_size(n_entries: int) -> int:
+    return int(n_entries) * _ENTRY.size
+
+
+@dataclasses.dataclass
+class MemberEntry:
+    """One peer's disseminated state."""
+
+    state: int = ALIVE
+    incarnation: int = 0
+    suspicion: float = 0.0
+
+    @property
+    def state_name(self) -> str:
+        return STATE_NAMES[self.state]
+
+
+@dataclasses.dataclass
+class Digest:
+    """A decoded membership digest: who said what, as of which round."""
+
+    origin: int
+    round: int
+    entries: Dict[int, MemberEntry]
+    version: int = DIGEST_VERSION
+
+    def items(self) -> Iterator[Tuple[int, MemberEntry]]:
+        return iter(self.entries.items())
+
+
+def encode_digest(digest: Digest) -> bytes:
+    """Serialize to the trailing-section wire form (header + entries)."""
+    entries = sorted(digest.entries.items())
+    parts = [
+        _DIGEST_HDR.pack(
+            DIGEST_MAGIC,
+            DIGEST_VERSION,
+            digest.origin & 0xFFFF,
+            digest.round & 0xFFFFFFFF,
+            len(entries),
+        )
+    ]
+    for peer, e in entries:
+        parts.append(
+            _ENTRY.pack(
+                peer & 0xFFFF,
+                e.state & 0xFF,
+                e.incarnation & 0xFFFFFFFF,
+                float(e.suspicion),
+            )
+        )
+    return b"".join(parts)
+
+
+def decode_digest(blob: bytes) -> Optional[Digest]:
+    """Parse a digest blob; None for anything malformed.
+
+    Tolerant by design: the digest rides as an OPTIONAL trailing section
+    after the replica payload, and an old-format peer (or a chaos-
+    truncated frame) simply has no valid digest there — that must never
+    fail the exchange, so every malformation maps to None rather than an
+    exception.  Unknown FUTURE versions also return None (entry width
+    may differ); version bumps that keep the layout should append, not
+    reshape."""
+    if len(blob) < _DIGEST_HDR.size or len(blob) > MAX_DIGEST_BYTES:
+        return None
+    magic, version, origin, rnd, n = _DIGEST_HDR.unpack_from(blob, 0)
+    if magic != DIGEST_MAGIC or version != DIGEST_VERSION:
+        return None
+    need = _DIGEST_HDR.size + n * _ENTRY.size
+    if len(blob) < need:
+        return None
+    entries: Dict[int, MemberEntry] = {}
+    off = _DIGEST_HDR.size
+    for _ in range(n):
+        peer, state, incarnation, suspicion = _ENTRY.unpack_from(blob, off)
+        off += _ENTRY.size
+        if state > DEAD:
+            return None
+        entries[int(peer)] = MemberEntry(
+            state=int(state),
+            incarnation=int(incarnation),
+            suspicion=float(suspicion),
+        )
+    return Digest(origin=int(origin), round=int(rnd), entries=entries)
+
+
+def merge_entry(
+    local: MemberEntry, claim: MemberEntry
+) -> Tuple[MemberEntry, bool]:
+    """Fold one remote claim into a local view entry.
+
+    Incarnation-based conflict resolution (the SWIM rule set):
+
+    - a higher incarnation always wins outright — the subject itself is
+      the only writer of its incarnation, so a bigger number is strictly
+      fresher information;
+    - at equal incarnations the more-damning state wins and suspicion
+      takes the max (failure evidence accumulates, it never un-happens
+      without a refutation);
+    - a lower incarnation is stale noise and is dropped.
+
+    Returns ``(merged, changed)``."""
+    if claim.incarnation > local.incarnation:
+        return (
+            MemberEntry(
+                state=claim.state,
+                incarnation=claim.incarnation,
+                suspicion=claim.suspicion,
+            ),
+            True,
+        )
+    if claim.incarnation < local.incarnation:
+        return local, False
+    state = max(local.state, claim.state)
+    suspicion = max(local.suspicion, claim.suspicion)
+    changed = state != local.state or suspicion != local.suspicion
+    if changed:
+        return (
+            MemberEntry(
+                state=state, incarnation=local.incarnation, suspicion=suspicion
+            ),
+            True,
+        )
+    return local, False

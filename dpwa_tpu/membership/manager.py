@@ -1,0 +1,329 @@
+"""Membership manager: merged epidemic view, partition state, heal advice.
+
+One instance per local node, attached to its
+:class:`~dpwa_tpu.health.scoreboard.Scoreboard`.  The manager owns three
+things the scoreboard alone cannot provide:
+
+- the **merged view**: every peer's last-known disseminated state and
+  incarnation (gossip claims, folded with the SWIM merge rules in
+  :mod:`dpwa_tpu.membership.digest`), overlaid with local fetch evidence
+  at digest-encode time;
+- the node's own **incarnation**: bumped exactly when a digest claims
+  *this* node is suspect/quarantined/dead at an incarnation at least as
+  fresh as ours — the refutation that lets a falsely-suspected live node
+  clear its name ring-wide without any central authority;
+- **partition bookkeeping**: the connected component implied by the
+  view, quorum/degraded state, and the heal advice the adapter turns
+  into an anti-entropy state merge.
+
+The component is the *epidemic approximation* of graph reachability: a
+peer is "in our component" when we can reach it or someone reachable
+vouches for it (state alive/suspect in the merged view).  Under a clean
+two-way split this equals the true connected component once suspicion
+has disseminated — within O(1) gossip rounds of the split.
+
+Every decision here is keyed on gossip rounds and deterministic draws;
+there is no wall clock anywhere, so identical seeds and outcome
+sequences replay bit-identical membership event streams (the determinism
+test pins this).
+
+Thread safety: digests merge on the overlapped-fetch thread while the
+training thread reads snapshots, so state mutations take the internal
+lock.  Scoreboard calls are made OUTSIDE the lock (the scoreboard's
+snapshot calls back into :meth:`view_snapshot`; holding both locks in
+opposite orders would deadlock).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Set
+
+from dpwa_tpu.config import MembershipConfig
+from dpwa_tpu.health.scoreboard import PeerState, Scoreboard
+from dpwa_tpu.membership.digest import (
+    ALIVE,
+    DEAD,
+    QUARANTINED,
+    STATE_NAMES,
+    SUSPECT,
+    Digest,
+    MemberEntry,
+    decode_digest,
+    encode_digest,
+    merge_entry,
+)
+
+# A peer that returned from unreachable stays in the pending-heal pool
+# this many rounds while waiting for enough of its component to follow;
+# after that it is treated as an isolated rejoin (recovery's resync
+# advice covers that case) rather than a partition heal.
+RETURN_WINDOW_ROUNDS = 8
+
+
+class MembershipManager:
+    """Merged membership view + partition/heal state for one node."""
+
+    def __init__(
+        self,
+        n_peers: int,
+        me: int,
+        scoreboard: Scoreboard,
+        config: Optional[MembershipConfig] = None,
+        seed: int = 0,
+    ):
+        self.config = config if config is not None else MembershipConfig()
+        self.n_peers = n_peers
+        self.me = me
+        self.seed = seed
+        self.scoreboard = scoreboard
+        self._lock = threading.Lock()
+        self.incarnation = 0
+        self._view: Dict[int, MemberEntry] = {}
+        self._events: List[dict] = []
+        self._heal_advice: Optional[dict] = None
+        self._component: Set[int] = set(range(n_peers))
+        self._degraded = False
+        # Peers recently back from unreachable: peer -> round it returned.
+        self._returned_pending: Dict[int, int] = {}
+        self._round = 0
+        scoreboard.attach_membership(self)
+
+    # ------------------------------------------------------------------
+    # Local evidence -> digest states
+    # ------------------------------------------------------------------
+
+    def _local_state(self, peer: int) -> int:
+        """This node's own fetch evidence about ``peer`` as a digest state."""
+        sb_state = self.scoreboard.state(peer)
+        if sb_state == PeerState.QUARANTINED:
+            streak = self.scoreboard.quarantine_streak(peer)
+            return (
+                DEAD
+                if streak >= self.config.dead_after_quarantines
+                else QUARANTINED
+            )
+        if sb_state == PeerState.SUSPECT:
+            return SUSPECT
+        return ALIVE
+
+    def _combined(self, peer: int) -> MemberEntry:
+        """Gossip view overlaid with local evidence (max severity)."""
+        view = self._view.get(peer, MemberEntry())
+        local_state = self._local_state(peer)
+        local_susp = self.scoreboard.suspicion(peer)
+        return MemberEntry(
+            state=max(view.state, local_state),
+            incarnation=view.incarnation,
+            suspicion=max(view.suspicion, local_susp),
+        )
+
+    # ------------------------------------------------------------------
+    # Digest I/O (called from the transport's publish / fetch paths)
+    # ------------------------------------------------------------------
+
+    def encode(self, round: int) -> bytes:
+        """The digest to piggyback on this round's published frame."""
+        # Scoreboard reads happen before taking our lock (lock ordering).
+        combined = {
+            p: self._combined(p) for p in range(self.n_peers) if p != self.me
+        }
+        with self._lock:
+            self._round = max(self._round, int(round))
+            entries = dict(combined)
+            entries[self.me] = MemberEntry(
+                state=ALIVE, incarnation=self.incarnation, suspicion=0.0
+            )
+            return encode_digest(
+                Digest(origin=self.me, round=int(round), entries=entries)
+            )
+
+    def merge(self, blob: Optional[bytes], round: Optional[int] = None) -> None:
+        """Fold a received digest blob into the view (None is a no-op —
+        old-format peers simply carry no digest)."""
+        if not blob:
+            return
+        digest = decode_digest(blob)
+        if digest is None:
+            return
+        r = int(round) if round is not None else self._round
+        readmits: List[int] = []
+        adopts: List[int] = []
+        events: List[dict] = []
+        with self._lock:
+            self._round = max(self._round, r)
+            for peer, claim in sorted(digest.entries.items()):
+                if peer >= self.n_peers:
+                    continue
+                if peer == self.me:
+                    # Refutation: someone thinks we are sick at an
+                    # incarnation as fresh as ours — outbid them.  We are
+                    # demonstrably alive (we are executing this merge).
+                    if (
+                        claim.state > ALIVE
+                        and claim.incarnation >= self.incarnation
+                    ):
+                        self.incarnation = claim.incarnation + 1
+                        events.append(
+                            {
+                                "event": "refutation",
+                                "peer": self.me,
+                                "claimed_state": STATE_NAMES[claim.state],
+                                "claimed_by": digest.origin,
+                                "incarnation": self.incarnation,
+                            }
+                        )
+                    continue
+                local = self._view.get(peer, MemberEntry())
+                merged, changed = merge_entry(local, claim)
+                if not changed:
+                    continue
+                self._view[peer] = merged
+                fresher = merged.incarnation > local.incarnation
+                if merged.state >= QUARANTINED and local.state < QUARANTINED:
+                    # Adopt a remote quarantine claim: stop spending
+                    # fetch budget on a peer the ring agrees is down.
+                    adopts.append(peer)
+                elif fresher and merged.state == ALIVE:
+                    # The peer refuted a suspicion we were carrying.
+                    readmits.append(peer)
+            self._events.extend(events)
+        for peer in adopts:
+            self.scoreboard.adopt_quarantine(peer, round=r)
+        refuted: List[dict] = []
+        for peer in readmits:
+            if self.scoreboard.readmit(peer, round=r):
+                refuted.append(
+                    {
+                        "event": "peer_refuted",
+                        "peer": peer,
+                        "incarnation": self._view[peer].incarnation,
+                    }
+                )
+        if refuted:
+            with self._lock:
+                self._events.extend(refuted)
+
+    # ------------------------------------------------------------------
+    # Round boundary: component / quorum / heal bookkeeping
+    # ------------------------------------------------------------------
+
+    def end_round(self, step: int) -> None:
+        """Recompute the component after this round's exchange."""
+        combined = {
+            p: self._combined(p) for p in range(self.n_peers) if p != self.me
+        }
+        component = {self.me} | {
+            p for p, e in combined.items() if e.state <= SUSPECT
+        }
+        events: List[dict] = []
+        with self._lock:
+            self._round = max(self._round, int(step))
+            prev = self._component
+            if component != prev:
+                events.append(
+                    {
+                        "event": "component_changed",
+                        "component": sorted(component),
+                        "size": len(component),
+                        "component_id": min(component),
+                    }
+                )
+            # Heal tracking: peers newly back from unreachable.
+            returned = component - prev
+            for p in returned:
+                self._returned_pending[p] = int(step)
+            # Peers that dropped out again, or aged out, leave the pool.
+            self._returned_pending = {
+                p: r
+                for p, r in self._returned_pending.items()
+                if p in component and int(step) - r <= RETURN_WINDOW_ROUNDS
+            }
+            degraded = (
+                len(component) / self.n_peers < self.config.quorum_fraction
+            )
+            if degraded and not self._degraded:
+                events.append(
+                    {
+                        "event": "partition_entered",
+                        "component": sorted(component),
+                        "size": len(component),
+                        "quorum_fraction": self.config.quorum_fraction,
+                    }
+                )
+            healed = False
+            pending = set(self._returned_pending)
+            if (
+                pending
+                and len(pending) / self.n_peers
+                >= self.config.reconcile_min_fraction
+            ):
+                healed = True
+                weight = min(
+                    self.config.max_heal_weight,
+                    len(pending) / max(1, len(component)),
+                )
+                self._heal_advice = {
+                    "returning": sorted(pending),
+                    "weight": weight,
+                    "step": int(step),
+                }
+                self._returned_pending = {}
+            if healed or (self._degraded and not degraded):
+                events.append(
+                    {
+                        "event": "partition_healed",
+                        "component": sorted(component),
+                        "size": len(component),
+                        "returning": sorted(pending) if healed else [],
+                    }
+                )
+            self._component = component
+            self._degraded = degraded
+            self._events.extend(events)
+
+    # ------------------------------------------------------------------
+    # Consumers
+    # ------------------------------------------------------------------
+
+    @property
+    def degraded(self) -> bool:
+        with self._lock:
+            return self._degraded
+
+    def alpha_scale(self) -> float:
+        """Interpolation damping factor in effect (1.0 when not degraded)."""
+        with self._lock:
+            if self._degraded:
+                return self.config.degraded_alpha_scale
+            return 1.0
+
+    def pop_events(self) -> List[dict]:
+        """Drain accumulated membership events (for the metrics JSONL)."""
+        with self._lock:
+            events, self._events = self._events, []
+            return events
+
+    def pop_heal_advice(self) -> Optional[dict]:
+        """The pending heal-reconciliation advice, if any (one-shot)."""
+        with self._lock:
+            advice, self._heal_advice = self._heal_advice, None
+            return advice
+
+    def view_snapshot(self) -> dict:
+        """JSON-ready membership view for /healthz and health records.
+
+        NOTE: called by ``Scoreboard.snapshot`` WITH the scoreboard lock
+        held — must not call back into the scoreboard (lock ordering),
+        so it reports the gossip view, not the local overlay."""
+        with self._lock:
+            return {
+                "incarnation": self.incarnation,
+                "component_id": min(self._component),
+                "component": sorted(self._component),
+                "component_size": len(self._component),
+                "partition_state": "degraded" if self._degraded else "ok",
+                "incarnations": {
+                    p: e.incarnation for p, e in sorted(self._view.items())
+                },
+            }

@@ -152,6 +152,20 @@ class MetricsLogger:
         peers = snapshot.get("peers", {})
         order = sorted(peers)
         cols = lambda key: [peers[p].get(key) for p in order]  # noqa: E731
+        membership = snapshot.get("membership")
+        if membership is not None:
+            # Membership view rides the same record: the merged-view
+            # incarnation column plus the node's own component/quorum
+            # state (scoreboards without an attached MembershipManager
+            # produce records byte-identical to the pre-membership ones).
+            extra = dict(
+                extra,
+                incarnation=cols("incarnation"),
+                own_incarnation=membership.get("incarnation"),
+                component=membership.get("component"),
+                component_id=membership.get("component_id"),
+                partition_state=membership.get("partition_state"),
+            )
         self.log(
             step,
             record="health",

@@ -116,10 +116,68 @@ def donor_draw(seed, step, me, n_candidates: int):
     )
 
 
+def relay_draw(seed, step, me, probe_slot: int, n_candidates: int):
+    """Index of the ``probe_slot``-th relay a suspecting peer asks to
+    header-probe a suspect before quarantining it (tag 6 — independent
+    of every other control stream; ``probe_slot`` is folded in so the K
+    indirect probes of one round draw distinct streams).
+
+    Keyed on ``(seed, step, me, probe_slot)``: replays of a seed pick
+    the identical relay set, so indirect-probe outcomes — and therefore
+    quarantine decisions — stay bit-identical across runs."""
+    return jax.random.randint(
+        jax.random.fold_in(_pair_key(seed, step, me, 6), probe_slot),
+        (), 0, n_candidates,
+    )
+
+
+def heal_draw(seed, step, me, n_candidates: int):
+    """Index of the reconciliation donor drawn from a returning
+    partition component at heal time (tag 7).
+
+    Keyed on ``(seed, step, me)`` like :func:`donor_draw`: every member
+    of the staying component reconciles against a deterministically
+    drawn member of the returning one, spreading the anti-entropy fetch
+    load while keeping heal events replayable."""
+    return jax.random.randint(
+        _pair_key(seed, step, me, 7), (), 0, n_candidates
+    )
+
+
+_CONTROL_DRAWS_WARM = False
+
+
+def warm_control_draws(seed: int = 0, me: int = 0) -> None:
+    """Pay every control-plane draw's first-call jit compile up front.
+
+    Each draw family above is a distinct jitted computation whose first
+    invocation compiles (~1s apiece on CPU).  Left lazy, that cost lands
+    at the first *failure* — only on the replicas that experience one —
+    which stalls their step clock mid-incident, skews every round-keyed
+    decision (chaos windows, relay vouching, backoff expiry) ring-wide,
+    and is exactly the wall-clock sensitivity the control plane is
+    designed not to have.  Calling this at transport init moves every
+    compile off the training clock; repeat calls are near-free (the jit
+    cache is the real latch, the module flag just skips the dispatch).
+    """
+    global _CONTROL_DRAWS_WARM
+    if _CONTROL_DRAWS_WARM:
+        return
+    bool(participation_draw(seed, 0, 0, 0.5))
+    bool(fault_draw(seed, 0, 0, 0.5))
+    int(fallback_draw(seed, 0, me, 2))
+    backoff_jitter_draw(seed, me, 1, 1)
+    int(donor_draw(seed, 0, me, 2))
+    int(relay_draw(seed, 0, me, 0, 2))
+    int(heal_draw(seed, 0, me, 2))
+    float(chaos_draw(seed, 0, me, 0))
+    _CONTROL_DRAWS_WARM = True
+
+
 # Chaos fault-kind tags start at 16: far clear of the control-plane tags
 # (0 participation, 1 fault, 2 pool, 3 fallback, 4 backoff jitter,
-# 5 bootstrap donor), so new control draws can claim 6..15 without
-# colliding with fault kinds.
+# 5 bootstrap donor, 6 relay probe, 7 heal donor), so new control draws
+# can claim 8..15 without colliding with fault kinds.
 CHAOS_TAG_BASE = 16
 
 

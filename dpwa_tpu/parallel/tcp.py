@@ -43,7 +43,7 @@ from dpwa_tpu.config import DEFAULT_MIN_WIRE_MB_PER_S, DpwaConfig
 # detector/scoreboard import config + schedules only — no cycle; chaos
 # (which imports THIS module) is loaded lazily inside TcpTransport.
 from dpwa_tpu.health.detector import Outcome
-from dpwa_tpu.health.scoreboard import Scoreboard
+from dpwa_tpu.health.scoreboard import PeerState, Scoreboard
 from dpwa_tpu.interpolation import PeerMeta, make_interpolation
 from dpwa_tpu.parallel.schedules import Schedule, build_schedule
 
@@ -85,6 +85,31 @@ _STATE_REQ_BODY = struct.Struct("<QI")
 _STATE_MAGIC = b"DPWS"
 _STATE_HDR = struct.Struct("<4sBIQQII")
 _MAX_STATE_CHUNK = 1 << 26  # 64 MiB server-side clamp on one chunk
+
+# RELAY probe wire (epidemic membership, dpwa_tpu/membership/): before a
+# node promotes a suspect to quarantined on its own evidence alone, it
+# asks K drawn healthy peers to header-probe the suspect FOR it — an
+# asymmetric fault (my link to the suspect is down, yours is not) then
+# yields "alive" votes that avert a false quarantine.  The request is a
+# distinct 5-byte magic (same dispatch as _REQ/_STATE_REQ) followed by
+# <H target_index><H target_port><I probe_timeout_ms><B hostlen> + host
+# bytes; the response is magic(4s) version(B) outcome(B) clock(d) where
+# ``outcome`` indexes _RELAY_OUTCOMES — the relay's CLASSIFIED result of
+# its own probe_header_classified against the target.
+_RELAY_REQ = b"DPWA!"
+_RELAY_BODY = struct.Struct("<HHIB")
+_RELAY_MAGIC = b"DPWR"
+_RELAY_HDR = struct.Struct("<4sBBd")
+_RELAY_OUTCOMES = (
+    Outcome.SUCCESS,
+    Outcome.TIMEOUT,
+    Outcome.REFUSED,
+    Outcome.SHORT_READ,
+    Outcome.CORRUPT,
+)
+# Server-side clamp on the relayed probe budget: a malicious requester
+# must not be able to pin a relay's Rx thread with a huge timeout.
+_MAX_RELAY_TIMEOUT_MS = 500
 # Default deadline floor for the payload read (bytes/s): the fetch
 # budget grows at this rate per byte RECEIVED, so a healthy peer
 # streaming a large replica is never killed by a fixed timeout_ms sized
@@ -134,13 +159,24 @@ def _recv_exact(
 
 
 def _frame(
-    vec: np.ndarray, clock: float, loss: float, code: Optional[int] = None
+    vec: np.ndarray,
+    clock: float,
+    loss: float,
+    code: Optional[int] = None,
+    digest: Optional[bytes] = None,
 ) -> bytes:
     """Header + raw vector bytes — the one definition of the wire format,
     shared by the Python and native Rx servers.
 
     ``code`` overrides the dtype byte for structured payloads
-    (``_INT8_CHUNKED``: ``vec`` is the already-encoded uint8 buffer)."""
+    (``_INT8_CHUNKED``: ``vec`` is the already-encoded uint8 buffer).
+
+    ``digest`` (a serialized membership digest) rides as an OPTIONAL
+    trailing section AFTER the nbytes payload: the header's ``nbytes``
+    still counts only the vector, so a pre-membership fetcher reads
+    exactly header + payload and never sees the trailer, while a
+    digest-aware fetcher attempts a tolerant trailing read — version-
+    gated wire compatibility in both directions (docs/membership.md)."""
     vec = np.ascontiguousarray(vec)
     if code is None:
         # Exact-dtype lookup first (covers bf16, whose custom numpy dtype
@@ -159,6 +195,8 @@ def _frame(
             code = _DTYPE_CODES[np.dtype("<f4")]
     data = vec.tobytes()
     header = _HDR.pack(_MAGIC, 1, code, float(clock), float(loss), len(data))
+    if digest:
+        return header + data + digest
     return header + data
 
 
@@ -168,6 +206,12 @@ class PeerServer:
     Mirrors the reference's always-on listener (SURVEY.md §3.3): the training
     thread and the Rx thread share only the publish buffer, guarded by a
     lock."""
+
+    # Optional hook consulted by the relay-probe handler: a callable
+    # (target_index) -> bool that returns True when this node's OWN link
+    # to the target is blocked (the chaos harness wires it so injected
+    # partitions constrain relays exactly like real ones).
+    relay_guard = None
 
     def __init__(self, host: str, port: int):
         self._lock = threading.Lock()
@@ -191,8 +235,9 @@ class PeerServer:
         clock: float,
         loss: float,
         code: Optional[int] = None,
+        digest: Optional[bytes] = None,
     ) -> None:
-        payload = _frame(vec, clock, loss, code)
+        payload = _frame(vec, clock, loss, code, digest)
         with self._lock:
             self._payload = payload
 
@@ -240,12 +285,44 @@ class PeerServer:
             offset, max_chunk = _STATE_REQ_BODY.unpack(body)
             self._handle_state(conn, offset, max_chunk)
             return
+        if req == _RELAY_REQ:
+            self._handle_relay(conn)
+            return
         if req != _REQ:
             return
         with self._lock:
             payload = self._payload
         if payload is not None:
             conn.sendall(payload)
+
+    def _handle_relay(self, conn: socket.socket) -> None:
+        """Serve one relayed header probe: probe the requested target
+        ourselves and report the CLASSIFIED outcome plus the target's
+        publish clock.  The probe runs on this Rx thread with a clamped
+        budget — relays are drawn from healthy peers and one header
+        probe is the cheapest thing on this wire, so the serving stall
+        is bounded and rare."""
+        body = _recv_exact(conn, _RELAY_BODY.size)
+        target, port, timeout_ms, hostlen = _RELAY_BODY.unpack(body)
+        host = (
+            _recv_exact(conn, hostlen).decode("ascii", "replace")
+            if hostlen
+            else "127.0.0.1"
+        )
+        timeout_ms = min(max(int(timeout_ms), 1), _MAX_RELAY_TIMEOUT_MS)
+        guard = self.relay_guard
+        if guard is not None and guard(int(target)):
+            outcome, clock = Outcome.REFUSED, None
+        else:
+            outcome, clock = probe_header_classified(host, port, timeout_ms)
+        conn.sendall(
+            _RELAY_HDR.pack(
+                _RELAY_MAGIC,
+                1,
+                _RELAY_OUTCOMES.index(outcome),
+                float(clock) if clock is not None else -1.0,
+            )
+        )
 
     def _handle_state(
         self, conn: socket.socket, offset: int, max_chunk: int
@@ -296,8 +373,11 @@ class NativePeerServer:
         clock: float,
         loss: float,
         code: Optional[int] = None,
+        digest: Optional[bytes] = None,
     ) -> None:
-        self._srv.publish_framed(_frame(vec, clock, loss, code))
+        # The native loop serves the framed bytes verbatim, so the
+        # digest trailer rides along without the C++ side knowing.
+        self._srv.publish_framed(_frame(vec, clock, loss, code, digest))
 
     def publish_state(self, blob: bytes) -> None:
         raise RuntimeError(
@@ -325,19 +405,68 @@ def make_peer_server(host: str, port: int):
     return PeerServer(host, port)
 
 
-def fetch_blob_ex(
+def _recv_trailing(
+    sock: socket.socket, n: int, deadline: float
+) -> Optional[bytes]:
+    """Best-effort exact read for an OPTIONAL trailing section.
+
+    Returns None — never raises — on timeout/EOF/reset: a peer that
+    closed right after its payload simply has no trailer, which is the
+    normal pre-membership wire and must not look like a failure."""
+    try:
+        return _recv_exact(sock, n, deadline)
+    except (socket.timeout, ConnectionError, OSError):
+        return None
+
+
+def _read_digest_trailer(
+    sock: socket.socket, budget_s: float = 0.25
+) -> Optional[bytes]:
+    """Read the optional membership-digest trailer after a payload.
+
+    Two-phase tolerant read (fixed digest header, then the entry block
+    the header's count implies); ANY malformation — missing bytes, bad
+    magic, absurd count — yields None rather than an error, because an
+    old-format peer legitimately serves no trailer.  The budget is small
+    and fixed: the digest is ~11 B/peer and the peer has already proven
+    responsive by streaming the whole payload."""
+    from dpwa_tpu.membership.digest import (
+        HEADER_SIZE,
+        entries_size,
+        header_entry_count,
+    )
+
+    deadline = time.monotonic() + budget_s
+    head = _recv_trailing(sock, HEADER_SIZE, deadline)
+    if head is None:
+        return None
+    n = header_entry_count(head)
+    if n is None:
+        return None
+    body = _recv_trailing(sock, entries_size(n), deadline)
+    if body is None:
+        return None
+    return head + body
+
+
+def fetch_blob_full(
     host: str,
     port: int,
     timeout_ms: int,
     min_bandwidth_bps: float = _MIN_WIRE_BANDWIDTH,
+    want_digest: bool = False,
 ) -> Tuple[
-    Optional[Tuple[np.ndarray, float, float]], str, float, int
+    Optional[Tuple[np.ndarray, float, float]], str, float, int,
+    Optional[bytes],
 ]:
     """:func:`fetch_blob` plus the classified outcome the health
-    subsystem feeds on.
+    subsystem feeds on, plus the optional membership-digest trailer.
 
-    Returns ``(result, outcome, latency_s, payload_bytes_received)``
-    where ``result`` is ``(vec, clock, loss)`` or None and ``outcome``
+    Returns ``(result, outcome, latency_s, payload_bytes_received,
+    digest)`` where ``result`` is ``(vec, clock, loss)`` or None,
+    ``digest`` is the raw trailer bytes (only attempted when
+    ``want_digest`` and the payload fetch succeeded; None whenever the
+    peer served no valid trailer) and ``outcome``
     is one of :class:`dpwa_tpu.health.detector.Outcome`:
 
     - ``refused`` — the connect itself failed (peer process gone);
@@ -366,11 +495,11 @@ def fetch_blob_ex(
             (host, port), timeout=timeout_ms / 1000.0
         )
     except socket.timeout:
-        return None, Outcome.TIMEOUT, time.monotonic() - t0, 0
+        return None, Outcome.TIMEOUT, time.monotonic() - t0, 0, None
     except (ConnectionError, OSError):
         # Refused, unreachable, reset during handshake: no peer process
         # is answering on that port.
-        return None, Outcome.REFUSED, time.monotonic() - t0, 0
+        return None, Outcome.REFUSED, time.monotonic() - t0, 0, None
     try:
         with sock:
             # The request send draws from the SAME cumulative budget as
@@ -390,9 +519,9 @@ def fetch_blob_ex(
             if magic != _MAGIC or version != 1 or (
                 code not in _DTYPES and code != _INT8_CHUNKED
             ):
-                return None, Outcome.CORRUPT, time.monotonic() - t0, 0
+                return None, Outcome.CORRUPT, time.monotonic() - t0, 0, None
             if nbytes > _MAX_BLOB:
-                return None, Outcome.CORRUPT, time.monotonic() - t0, 0
+                return None, Outcome.CORRUPT, time.monotonic() - t0, 0, None
             data = _recv_exact(
                 sock, nbytes, deadline, 1.0 / min_bandwidth_bps
             )
@@ -410,20 +539,40 @@ def fetch_blob_ex(
                     # malformed payload == skipped fetch
                     return (
                         None, Outcome.CORRUPT,
-                        time.monotonic() - t0, nbytes_rx,
+                        time.monotonic() - t0, nbytes_rx, None,
                     )
             else:
                 vec = np.frombuffer(data, dtype=_DTYPES[code]).copy()
+            # Optional epidemic-membership trailer: attempted only after
+            # a fully valid payload (a frame that failed above carries
+            # no trustworthy trailer), tolerant of its absence.
+            digest = _read_digest_trailer(sock) if want_digest else None
             return (
                 (vec, clock, loss), Outcome.SUCCESS,
-                time.monotonic() - t0, nbytes_rx,
+                time.monotonic() - t0, nbytes_rx, digest,
             )
     except socket.timeout:
-        return None, Outcome.TIMEOUT, time.monotonic() - t0, nbytes_rx
+        return None, Outcome.TIMEOUT, time.monotonic() - t0, nbytes_rx, None
     except (ConnectionError, OSError):
         # Accepted, then closed/reset mid-frame: the peer process is
         # alive enough to accept but served a broken stream.
-        return None, Outcome.SHORT_READ, time.monotonic() - t0, nbytes_rx
+        return (
+            None, Outcome.SHORT_READ, time.monotonic() - t0, nbytes_rx, None
+        )
+
+
+def fetch_blob_ex(
+    host: str,
+    port: int,
+    timeout_ms: int,
+    min_bandwidth_bps: float = _MIN_WIRE_BANDWIDTH,
+) -> Tuple[
+    Optional[Tuple[np.ndarray, float, float]], str, float, int
+]:
+    """:func:`fetch_blob_full` without the digest trailer — the
+    4-tuple ``(result, outcome, latency_s, nbytes_rx)`` shape the
+    health subsystem and existing callers consume."""
+    return fetch_blob_full(host, port, timeout_ms, min_bandwidth_bps)[:4]
 
 
 def fetch_blob(
@@ -575,6 +724,48 @@ def fetch_state(
             retries += 1
 
 
+def probe_header_classified(
+    host: str, port: int, timeout_ms: int = 100
+) -> Tuple[str, Optional[float]]:
+    """Header-only liveness probe with the CLASSIFIED outcome.
+
+    Same wire exchange as :func:`probe_header` but the failure mode is
+    reported as a :class:`~dpwa_tpu.health.detector.Outcome` string —
+    the membership layer treats "nothing listening" (``refused``) very
+    differently from "listening but serving garbage" (``corrupt``), and
+    relays forward exactly this classification to the asking node."""
+    deadline = time.monotonic() + timeout_ms / 1000.0
+    try:
+        sock = socket.create_connection(
+            (host, port), timeout=timeout_ms / 1000.0
+        )
+    except socket.timeout:
+        return Outcome.TIMEOUT, None
+    except (ConnectionError, OSError):
+        return Outcome.REFUSED, None
+    try:
+        with sock:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return Outcome.TIMEOUT, None
+            sock.settimeout(remaining)
+            sock.sendall(_REQ)
+            raw = _recv_exact(sock, _HDR.size, deadline)
+            magic, version, code, clock, _loss, nbytes = _HDR.unpack(raw)
+            if (
+                magic != _MAGIC
+                or version != 1
+                or (code not in _DTYPES and code != _INT8_CHUNKED)
+                or nbytes > _MAX_BLOB
+            ):
+                return Outcome.CORRUPT, None
+            return Outcome.SUCCESS, float(clock)
+    except socket.timeout:
+        return Outcome.TIMEOUT, None
+    except (ConnectionError, OSError):
+        return Outcome.SHORT_READ, None
+
+
 def probe_header_ex(
     host: str, port: int, timeout_ms: int = 100
 ) -> Tuple[bool, Optional[float]]:
@@ -583,28 +774,77 @@ def probe_header_ex(
     The clock rides the header for free, and re-admission wants it: a
     readmitted peer whose clock is far AHEAD of ours means we are the
     stale replica (we were partitioned while it kept training) — the
-    freshness check behind ``recovery.max_clock_lag``."""
+    freshness check behind ``recovery.max_clock_lag``.  Thin wrapper
+    over :func:`probe_header_classified`, which keeps the failure
+    taxonomy."""
+    outcome, clock = probe_header_classified(host, port, timeout_ms)
+    return outcome == Outcome.SUCCESS, clock
+
+
+def relay_probe(
+    relay_host: str,
+    relay_port: int,
+    target_index: int,
+    target_host: str,
+    target_port: int,
+    probe_timeout_ms: int,
+    timeout_ms: int,
+) -> Tuple[str, Optional[str], Optional[float]]:
+    """Ask a relay peer to header-probe ``target`` on our behalf.
+
+    The SWIM indirect-probe leg: returns ``(relay_outcome,
+    probe_outcome, clock)`` where ``relay_outcome`` classifies OUR
+    connection to the relay (it feeds the relay's own health record),
+    ``probe_outcome`` is the relay's classified
+    :func:`probe_header_classified` result against the target (None
+    whenever the relay leg itself failed), and ``clock`` is the
+    target's publish clock as the relay saw it (None when unknown).
+
+    ``timeout_ms`` must comfortably exceed ``probe_timeout_ms``: the
+    relay performs its probe synchronously before answering."""
     deadline = time.monotonic() + timeout_ms / 1000.0
     try:
-        with socket.create_connection(
-            (host, port), timeout=timeout_ms / 1000.0
-        ) as sock:
+        sock = socket.create_connection(
+            (relay_host, relay_port), timeout=timeout_ms / 1000.0
+        )
+    except socket.timeout:
+        return Outcome.TIMEOUT, None, None
+    except (ConnectionError, OSError):
+        return Outcome.REFUSED, None, None
+    try:
+        with sock:
             remaining = deadline - time.monotonic()
             if remaining <= 0:
-                return False, None
+                return Outcome.TIMEOUT, None, None
             sock.settimeout(remaining)
-            sock.sendall(_REQ)
-            raw = _recv_exact(sock, _HDR.size, deadline)
-            magic, version, code, clock, _loss, nbytes = _HDR.unpack(raw)
-            ok = (
-                magic == _MAGIC
-                and version == 1
-                and (code in _DTYPES or code == _INT8_CHUNKED)
-                and nbytes <= _MAX_BLOB
+            host_b = target_host.encode("ascii", "replace")[:255]
+            sock.sendall(
+                _RELAY_REQ
+                + _RELAY_BODY.pack(
+                    target_index & 0xFFFF,
+                    target_port & 0xFFFF,
+                    int(probe_timeout_ms) & 0xFFFFFFFF,
+                    len(host_b),
+                )
+                + host_b
             )
-            return ok, (float(clock) if ok else None)
-    except (OSError, ConnectionError, struct.error):
-        return False, None
+            raw = _recv_exact(sock, _RELAY_HDR.size, deadline)
+            magic, version, code, clock = _RELAY_HDR.unpack(raw)
+            if (
+                magic != _RELAY_MAGIC
+                or version != 1
+                or code >= len(_RELAY_OUTCOMES)
+            ):
+                return Outcome.CORRUPT, None, None
+            return (
+                Outcome.SUCCESS,
+                _RELAY_OUTCOMES[code],
+                float(clock) if clock >= 0 else None,
+            )
+    except socket.timeout:
+        return Outcome.TIMEOUT, None, None
+    except (ConnectionError, OSError):
+        return Outcome.SHORT_READ, None, None
 
 
 def probe_header(host: str, port: int, timeout_ms: int = 100) -> bool:
@@ -706,6 +946,10 @@ class _OverlappedExchange:
                 / (self._t.config.protocol.min_wire_mb_per_s * 1e6)
             )
         got = self._got if self._thread is not None else None
+        # The overlapped path never runs _round, so the membership round
+        # boundary lands here — after the fetch (and its digest merge)
+        # has been joined.
+        self._t._membership_end_round(self._step)
         if got is None:
             merged, alpha = pre_vec, 0.0
         else:
@@ -761,17 +1005,24 @@ class TcpTransport:
         if self._wire_bf16 and ml_dtypes is None:  # pragma: no cover
             raise RuntimeError("wire_dtype bf16 requires ml_dtypes")
         spec = config.nodes[self.me]
+        # Kept when chaos is on so the FETCHING side can honor injected
+        # partitions (the serving side cannot know who is connecting).
+        self._chaos_engine = None
         if config.chaos.enabled:
             # Chaos wraps the PYTHON Rx server (fault injection needs
             # per-connection control of the serve loop); the import is
             # deferred because health.chaos imports this module.
             from dpwa_tpu.health.chaos import ChaosEngine, ChaosPeerServer
 
+            self._chaos_engine = ChaosEngine(config.chaos, self.me)
             self.server = ChaosPeerServer(
-                spec.host, spec.port, ChaosEngine(config.chaos, self.me)
+                spec.host, spec.port, self._chaos_engine
             )
-        elif config.recovery.enabled:
-            # STATE serving (peer-assisted bootstrap) lives in the
+        elif config.recovery.enabled or (
+            config.health.enabled and config.membership.enabled
+        ):
+            # STATE serving (peer-assisted bootstrap) and the RELAY
+            # probe verb (indirect membership probing) live in the
             # Python Rx server only — the native C++ loop speaks just
             # the blob protocol.  Same forcing rationale as chaos.
             self.server = PeerServer(spec.host, spec.port)
@@ -792,6 +1043,16 @@ class TcpTransport:
             if config.health.enabled
             else None
         )
+        # Epidemic membership rides on the scoreboard: digests merge
+        # into the same per-peer records the fetch outcomes feed.
+        self.membership = None
+        if self.scoreboard is not None and config.membership.enabled:
+            from dpwa_tpu.membership.manager import MembershipManager
+
+            self.membership = MembershipManager(
+                len(config.nodes), self.me, self.scoreboard,
+                config.membership, seed=self.schedule.seed,
+            )
         self.healthz = None
         if config.health.enabled and config.health.healthz_port is not None:
             from dpwa_tpu.health.endpoint import HealthzServer
@@ -809,6 +1070,20 @@ class TcpTransport:
         # WE are the stale replica.
         self._last_clock = 0.0
         self.resync_advice: Optional[dict] = None
+        if self._chaos_engine is not None:
+            # Compile-once discipline for the control plane: the threefry
+            # draws (fallback/relay/heal/...) jit on first call, and left
+            # lazy that compile fires at the first FAILURE — stalling only
+            # the replicas having the incident.  Under chaos the injected
+            # windows are keyed on each process's own publish clock, so a
+            # seconds-long stall on half the ring desynchronizes the very
+            # faults being injected; warm the draws off the step clock.
+            # Without chaos the stall is a one-time latency blip and lazy
+            # compile wins: a restarted worker must reach its bootstrap
+            # probes before the survivors move on, not sit in jit.
+            from dpwa_tpu.parallel.schedules import warm_control_draws
+
+            warm_control_draws(self.schedule.seed, self.me)
 
     @property
     def port(self) -> int:
@@ -827,17 +1102,26 @@ class TcpTransport:
         # with stochastic rounding keyed on (seed, clock, me) and
         # dequantized by the FETCHING side (ops/quantize.py).
         self._last_clock = float(clock)
+        # Epidemic piggyback: the current membership digest rides every
+        # published frame as the optional trailer (_frame docstring).
+        digest = (
+            self.membership.encode(int(clock))
+            if self.membership is not None
+            else None
+        )
         if self._wire_int8 and vec.dtype == np.float32:
             from dpwa_tpu.ops.quantize import encode_int8_payload
 
             payload = encode_int8_payload(
                 vec, self.schedule.seed, clock, self.me
             )
-            self.server.publish(payload, clock, loss, code=_INT8_CHUNKED)
+            self.server.publish(
+                payload, clock, loss, code=_INT8_CHUNKED, digest=digest
+            )
             return
         if self._wire_bf16 and vec.dtype == np.float32:
             vec = vec.astype(_DTYPES[3])
-        self.server.publish(vec, clock, loss)
+        self.server.publish(vec, clock, loss, digest=digest)
 
     def fetch(
         self,
@@ -848,10 +1132,22 @@ class TcpTransport:
         host, port = self._ports[peer_index]
         if timeout_ms is None:
             timeout_ms = self.config.protocol.timeout_ms
-        got, outcome, latency_s, nbytes = fetch_blob_ex(
-            host, port, timeout_ms,
-            min_bandwidth_bps=self.config.protocol.min_wire_mb_per_s * 1e6,
-        )
+        if self._link_blocked(peer_index):
+            # Injected partition, fetcher side: the chaos harness blocks
+            # this directed link, so no socket is even opened — the
+            # round records a refused fetch, exactly what a firewalled
+            # link produces.
+            got, outcome, latency_s, nbytes, digest = (
+                None, Outcome.REFUSED, 0.0, 0, None,
+            )
+        else:
+            got, outcome, latency_s, nbytes, digest = fetch_blob_full(
+                host, port, timeout_ms,
+                min_bandwidth_bps=(
+                    self.config.protocol.min_wire_mb_per_s * 1e6
+                ),
+                want_digest=self.membership is not None,
+            )
         reason = None
         if got is not None and self.config.recovery.enabled:
             # Divergence/poison guard: a frame can be perfectly formed
@@ -873,12 +1169,96 @@ class TcpTransport:
         }
         if reason is not None:
             self.last_fetch["poison_reason"] = reason
+        if self.membership is not None and digest is not None:
+            self.membership.merge(digest, round=step)
+        if (
+            self.membership is not None
+            and self.scoreboard is not None
+            and step is not None
+            and outcome
+            in (
+                Outcome.TIMEOUT,
+                Outcome.REFUSED,
+                Outcome.SHORT_READ,
+                Outcome.CORRUPT,
+            )
+            and self.config.membership.indirect_probes > 0
+            and self.scoreboard.would_quarantine(peer_index, outcome)
+        ):
+            # SWIM indirect probing: this failure WOULD cross the
+            # quarantine threshold on our evidence alone — before the
+            # record below promotes the peer, ask drawn healthy relays
+            # to probe it for us.  A single vouch decays our suspicion
+            # (an asymmetric-link false positive); when every relay
+            # agrees the peer is gone, nothing is fed and the record
+            # promotes on the ordinary single-failure weight.  POISONED
+            # is deliberately not gated: a diverged peer answers header
+            # probes perfectly and every relay would vouch for it.
+            self._indirect_probe(peer_index, step)
         if self.scoreboard is not None:
             self.scoreboard.record(
                 peer_index, outcome,
                 latency_s=latency_s, nbytes=nbytes, round=step,
             )
         return got
+
+    def _link_blocked(self, peer_index: int) -> bool:
+        """Fetcher-side view of an injected partition (False without
+        chaos).  Keyed on the last PUBLISHED clock — publish always
+        precedes fetch in a round, so both endpoints and any relay
+        agree on the same round key."""
+        if self._chaos_engine is None:
+            return False
+        return self._chaos_engine.link_blocked(
+            int(self._last_clock), self.me, peer_index
+        )
+
+    def _indirect_probe(self, suspect: int, step: int) -> None:
+        """Ask K deterministically-drawn healthy peers to header-probe
+        ``suspect`` on our behalf (the RELAY verb), and feed the
+        scoreboard AT MOST one summarized outcome for the suspect.
+
+        The relay set is drawn with :func:`~dpwa_tpu.parallel.schedules.
+        relay_draw` — counter-based threefry keyed on (seed, step, me,
+        slot), no wall clock — so replays pick identical relays.  Each
+        relay's OWN reachability outcome feeds its record too: a relay
+        that cannot be reached is itself evidence."""
+        from dpwa_tpu.parallel.schedules import relay_draw
+
+        sb = self.scoreboard
+        candidates = [
+            p
+            for p in range(len(self.config.nodes))
+            if p != self.me
+            and p != suspect
+            and sb.state(p) == PeerState.HEALTHY
+        ]
+        if not candidates:
+            return
+        k = min(int(self.config.membership.indirect_probes), len(candidates))
+        s_host, s_port = self._ports[suspect]
+        vouched = False
+        for slot in range(k):
+            idx = int(
+                relay_draw(
+                    self.schedule.seed, step, self.me, slot, len(candidates)
+                )
+            )
+            relay = candidates.pop(idx)
+            if self._link_blocked(relay):
+                relay_outcome, probe_outcome = Outcome.REFUSED, None
+            else:
+                r_host, r_port = self._ports[relay]
+                relay_outcome, probe_outcome, _clock = relay_probe(
+                    r_host, r_port, suspect, s_host, s_port,
+                    self.config.health.probe_timeout_ms,
+                    self.config.membership.relay_timeout_ms,
+                )
+            sb.record_probe(relay, relay_outcome, round=step)
+            if probe_outcome == Outcome.SUCCESS:
+                vouched = True
+        if vouched:
+            sb.record_probe(suspect, Outcome.SUCCESS, round=step)
 
     def _resolve_partner(self, step: int) -> Tuple[int, int, bool]:
         """Health-aware partner resolution: ``(scheduled, actual,
@@ -897,11 +1277,15 @@ class TcpTransport:
         sb = self.scoreboard
         if sb is not None and sched != self.me:
             if sb.probe_due(sched, step):
-                host, port = self._ports[sched]
-                ok, remote_clock = probe_header_ex(
-                    host, port, self.config.health.probe_timeout_ms
-                )
-                sb.record_probe(sched, ok, round=step)
+                if self._link_blocked(sched):
+                    outcome, remote_clock = Outcome.REFUSED, None
+                else:
+                    host, port = self._ports[sched]
+                    outcome, remote_clock = probe_header_classified(
+                        host, port, self.config.health.probe_timeout_ms
+                    )
+                sb.record_probe(sched, outcome, round=step)
+                ok = outcome == Outcome.SUCCESS
                 if (
                     ok
                     and remote_clock is not None
@@ -938,6 +1322,8 @@ class TcpTransport:
         """Pull a donor's full serialized state (chunked, CRC-checked,
         resumable — :func:`fetch_state`), sized by the ``recovery:``
         config block."""
+        if self._link_blocked(peer_index):
+            return None, Outcome.REFUSED, 0.0, 0
         host, port = self._ports[peer_index]
         rec = self.config.recovery
         if timeout_ms is None:
@@ -986,6 +1372,12 @@ class TcpTransport:
         local = PeerMeta(np.float32(clock), np.float32(loss))
         remote = PeerMeta(np.float32(remote_clock), np.float32(remote_loss))
         alpha = float(self.interp(local, remote))
+        if self.membership is not None:
+            # Degraded-mode damping: inside a below-quorum component the
+            # merge pull is optionally scaled down (1.0 by default — a
+            # bit-exact no-op) so a small island doesn't overcommit to
+            # its own consensus before the heal.
+            alpha *= self.membership.alpha_scale()
         if ml_dtypes is not None and remote_vec.dtype == _DTYPES[3]:
             # bf16 off the wire: upcast once, merge in f32 (same math as
             # the ICI transport's bf16-wire merge).
@@ -1000,23 +1392,50 @@ class TcpTransport:
         bf16-wire upcast.  Returns (remote_f32_vector | None, alpha,
         partner); None means the round was skipped (self-pair, masked, or
         fetch timeout) and the caller keeps its vector untouched."""
-        self.publish(vec, clock, loss)
-        sched, partner, remapped = self._resolve_partner(step)
-        self.last_round = {
-            "step": step, "sched_partner": sched, "partner": partner,
-            "remapped": remapped, "outcome": None,
-        }
-        # Participation stays keyed on the ORIGINAL pairing (identical
-        # threefry draw to the ICI path); remap changes only the fetch
-        # target.  A remap to self (no healthy candidate) skips.
-        if partner == self.me or not self.schedule.participates(step, self.me):
-            return None, 0.0, partner
-        got = self.fetch(partner, step=step)
-        self.last_round["outcome"] = self.last_fetch.get("outcome")
-        if got is None:
-            return None, 0.0, partner  # dead/slow peer: skip, keep training
-        remote_vec, alpha = self._weigh_remote(got, clock, loss)
-        return remote_vec, alpha, partner
+        try:
+            self.publish(vec, clock, loss)
+            sched, partner, remapped = self._resolve_partner(step)
+            self.last_round = {
+                "step": step, "sched_partner": sched, "partner": partner,
+                "remapped": remapped, "outcome": None,
+            }
+            # Participation stays keyed on the ORIGINAL pairing (identical
+            # threefry draw to the ICI path); remap changes only the fetch
+            # target.  A remap to self (no healthy candidate) skips.
+            if partner == self.me or not self.schedule.participates(
+                step, self.me
+            ):
+                return None, 0.0, partner
+            got = self.fetch(partner, step=step)
+            self.last_round["outcome"] = self.last_fetch.get("outcome")
+            if got is None:
+                # dead/slow peer: skip, keep training
+                return None, 0.0, partner
+            remote_vec, alpha = self._weigh_remote(got, clock, loss)
+            return remote_vec, alpha, partner
+        finally:
+            # Membership round boundary runs on EVERY exit path —
+            # component/quorum state must advance even on skipped rounds
+            # (a partitioned node skips every round, and that is exactly
+            # when it must notice it is partitioned).
+            self._membership_end_round(step)
+
+    def _membership_end_round(self, step: int) -> None:
+        if self.membership is not None:
+            self.membership.end_round(step)
+
+    def pop_membership_events(self) -> list:
+        """Drain membership events (refutations, component changes,
+        partition entered/healed) for the metrics JSONL."""
+        if self.membership is None:
+            return []
+        return self.membership.pop_events()
+
+    def pop_heal_advice(self) -> Optional[dict]:
+        """Consume the pending heal-reconciliation advice, if any."""
+        if self.membership is None:
+            return None
+        return self.membership.pop_heal_advice()
 
     def exchange(
         self, vec: np.ndarray, clock: float, loss: float, step: int

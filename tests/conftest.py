@@ -44,6 +44,7 @@ import pytest
 _SOCKET_TEST_MODULES = (
     "test_recovery",
     "test_health",
+    "test_membership",
     "test_tcp_transport",
     "test_native",
     "test_wire_dtype",

@@ -1,0 +1,156 @@
+"""One gossip worker process for the partition-heal membership soak.
+
+Spawned by ``tests/test_membership.py`` (and usable by hand): fixed
+ports, a deterministic chaos partition window shared by every process
+(``chaos.partition_windows`` is pure config — both sides of every link
+agree on the block with no coordination), and the epidemic membership
+plane enabled.  During the split each side drifts its replica in an
+opposite direction, so the cross-component divergence at heal time is
+real and the post-heal reconciliation has something to visibly repair.
+
+Two pieces of pacing discipline matter here.  The chaos round key is
+each process's *own* publish clock, so the injected window is only
+consistent across the ring while the processes stay step-aligned: the
+loop below paces each step to a deadline (a fast node waits; it never
+races ahead) that REBASES after an overrun rather than letting the
+node free-run to catch up, and a startup barrier waits for every
+peer's server before step 0 so nobody burns rounds against peers that
+have not bound their port yet.  A short grace sleep before close keeps
+this worker's server up for the stragglers' last fetches.  (The
+transport itself warms the control-draw jits at init — the original
+source of a mid-window stall that only hit the nodes seeing failures.)
+
+Evidence is write-only: per-step ``replica_probe`` events (replica mean)
+plus the adapter's ordinary exchange/health/membership records land in
+the metrics JSONL; the test reads the files, never the processes.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import socket
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+from dpwa_tpu.adapters.tcp_adapter import DpwaTcpAdapter  # noqa: E402
+from dpwa_tpu.config import make_local_config  # noqa: E402
+
+
+def _wait_for_peers(
+    base_port: int, n: int, me: int, deadline_s: float = 60.0
+) -> None:
+    """Block until every peer's Rx port accepts a connection (their
+    adapter is constructed and has published step 0)."""
+    stop = time.monotonic() + deadline_s
+    for i in range(n):
+        if i == me:
+            continue
+        while True:
+            try:
+                socket.create_connection(
+                    ("127.0.0.1", base_port + i), timeout=0.25
+                ).close()
+                break
+            except OSError:
+                if time.monotonic() >= stop:
+                    raise RuntimeError(f"peer {i} never came up")
+                time.sleep(0.05)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--index", type=int, required=True)
+    ap.add_argument("--n", type=int, default=5)
+    ap.add_argument("--base-port", type=int, required=True)
+    ap.add_argument("--steps", type=int, default=70)
+    ap.add_argument("--dim", type=int, default=64)
+    ap.add_argument("--seed", type=int, default=11)
+    ap.add_argument("--metrics", required=True)
+    ap.add_argument(
+        "--split-group", default="1,2",
+        help="comma-separated indices forming one side of the partition",
+    )
+    ap.add_argument("--split-start", type=int, default=10)
+    ap.add_argument("--split-stop", type=int, default=30)
+    ap.add_argument(
+        "--step-sleep", type=float, default=0.05,
+        help="absolute wall budget per step (keeps processes step-aligned)",
+    )
+    args = ap.parse_args()
+
+    group = tuple(int(s) for s in args.split_group.split(","))
+    cfg = make_local_config(
+        args.n,
+        base_port=args.base_port,
+        schedule="ring",
+        seed=args.seed,
+        timeout_ms=400,
+        health=dict(
+            jitter_rounds=1,
+            quarantine_base_rounds=2,
+            quarantine_max_rounds=8,
+        ),
+        chaos=dict(
+            enabled=True,
+            seed=args.seed,
+            partition_windows=((group, args.split_start, args.split_stop),),
+        ),
+        membership=dict(quorum_fraction=0.5),
+    )
+    params = {"w": np.zeros(args.dim, np.float32)}
+    ad = DpwaTcpAdapter(
+        params, f"node{args.index}", cfg, metrics=args.metrics,
+        health_every=3,
+    )
+    # Opposite per-side drift while the drift phase lasts: everyone
+    # starts from the identical replica, the two components visibly
+    # diverge during the split, then the drift stops and post-heal
+    # gossip + reconciliation must close the gap.
+    side = 1.0 if args.index in group else -1.0
+    drift = np.full(args.dim, side * 0.02, np.float32)
+    w = params
+    try:
+        _wait_for_peers(args.base_port, args.n, args.index)
+        t0 = time.monotonic()
+        deadline = t0
+        while ad.step < args.steps:
+            step = ad.step
+            if step < args.split_stop:
+                # The "train step": drift applied before the exchange.
+                w = {"w": np.asarray(w["w"], np.float32) + drift}
+                w = ad.update(loss=1.0 / (1.0 + step), params=w)
+            else:
+                w = ad.update(loss=1.0 / (1.0 + step))
+            if ad.metrics is not None:
+                ad.metrics.log_event(
+                    step, "replica_probe",
+                    vec_mean=float(np.asarray(w["w"]).mean()),
+                    wall=round(time.monotonic() - t0, 4),
+                )
+            # Forgiving per-step pacing: a fast step sleeps to the
+            # deadline (instant refused fetches and solo rounds never
+            # race ahead), while a step that overran REBASES the
+            # deadline instead of free-running to catch up — one stall
+            # shifts this node's timeline but cannot turn pacing off
+            # for the rest of the run.
+            deadline += args.step_sleep
+            now = time.monotonic()
+            if deadline > now:
+                time.sleep(deadline - now)
+            else:
+                deadline = now
+        # Keep serving while step-aligned stragglers finish their last
+        # rounds against us.
+        time.sleep(max(1.0, 20.0 * args.step_sleep))
+    finally:
+        ad.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,1198 @@
+"""Epidemic membership tests: digest wire/merge rules, refutation via
+incarnation, relay-verb indirect probing, partition detection + quorum /
+degraded mode, heal reconciliation, and wire-format back-compat.
+
+The in-process acceptance scenario
+(:func:`test_partition_detect_heal_in_process`) runs four TCP transports
+lock-step under a deterministic chaos partition window: both sides
+quarantine the far side, drop below quorum, emit ``partition_entered``,
+then — after the window closes — probe-readmit, refute the stale
+quarantine claims via incarnation bumps, and converge back to the full
+component.  :func:`test_partition_scenario_is_deterministic` replays it
+and pins the full trace + membership event streams bit-identical.
+
+The 5-process split → diverge → heal → reconcile soak (slow tier) lives
+at the bottom, driving ``tests/membership_worker.py`` subprocesses.
+"""
+
+import importlib.util
+import io
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from dpwa_tpu.adapters.tcp_adapter import DpwaTcpAdapter
+from dpwa_tpu.config import MembershipConfig, make_local_config
+from dpwa_tpu.health import Outcome, PeerState, Scoreboard
+from dpwa_tpu.health.chaos import ChaosEngine, mutate_frame
+from dpwa_tpu.health.endpoint import HealthzServer
+from dpwa_tpu.membership import (
+    ALIVE,
+    DEAD,
+    QUARANTINED,
+    SUSPECT,
+    Digest,
+    MemberEntry,
+    MembershipManager,
+    decode_digest,
+    encode_digest,
+    merge_entry,
+)
+from dpwa_tpu.membership.digest import (
+    HEADER_SIZE,
+    entries_size,
+    header_entry_count,
+)
+from dpwa_tpu.metrics import MetricsLogger
+from dpwa_tpu.parallel.tcp import (
+    _HDR,
+    PeerServer,
+    TcpTransport,
+    _frame,
+    fetch_blob,
+    fetch_blob_ex,
+    fetch_blob_full,
+    probe_header,
+    probe_header_classified,
+    relay_probe,
+)
+
+
+def make_ring(n, **cfg_kwargs):
+    """n transports on OS-assigned ports, all wired to each other."""
+    cfg = make_local_config(n, base_port=0, **cfg_kwargs)
+    ts = [TcpTransport(cfg, f"node{i}") for i in range(n)]
+    for t in ts:
+        for i, other in enumerate(ts):
+            t.set_peer_port(i, other.port)
+    return ts
+
+
+def close_all(ts):
+    for t in ts:
+        t.close()
+
+
+def _dead_port():
+    """A port with nothing listening on it."""
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+# ---------------------------------------------------------------------------
+# Digest wire format
+# ---------------------------------------------------------------------------
+
+
+def test_digest_roundtrip():
+    d = Digest(
+        origin=2,
+        round=41,
+        entries={
+            0: MemberEntry(state=ALIVE, incarnation=0, suspicion=0.0),
+            1: MemberEntry(state=SUSPECT, incarnation=3, suspicion=1.5),
+            3: MemberEntry(state=DEAD, incarnation=7, suspicion=9.0),
+        },
+    )
+    blob = encode_digest(d)
+    assert len(blob) == HEADER_SIZE + entries_size(3)
+    back = decode_digest(blob)
+    assert back is not None
+    assert back.origin == 2 and back.round == 41
+    assert sorted(back.entries) == [0, 1, 3]
+    assert back.entries[1].state == SUSPECT
+    assert back.entries[1].incarnation == 3
+    assert back.entries[1].suspicion == pytest.approx(1.5)
+    assert back.entries[3].state == DEAD
+
+
+def test_digest_decode_is_tolerant():
+    blob = encode_digest(
+        Digest(origin=0, round=1, entries={1: MemberEntry(state=QUARANTINED)})
+    )
+    assert decode_digest(blob) is not None
+    # Truncated header / truncated entries / empty.
+    assert decode_digest(b"") is None
+    assert decode_digest(blob[:5]) is None
+    assert decode_digest(blob[:-1]) is None
+    # Wrong magic.
+    assert decode_digest(b"XXXX" + blob[4:]) is None
+    # Unknown FUTURE version must be skipped, not misparsed.
+    assert decode_digest(blob[:4] + bytes([99]) + blob[5:]) is None
+    # Out-of-range state byte.
+    bad = bytearray(blob)
+    bad[HEADER_SIZE + 2] = 9  # entry layout: u16 peer | u8 state | ...
+    assert decode_digest(bytes(bad)) is None
+
+
+def test_header_entry_count():
+    blob = encode_digest(
+        Digest(
+            origin=1,
+            round=2,
+            entries={0: MemberEntry(), 2: MemberEntry(state=SUSPECT)},
+        )
+    )
+    assert header_entry_count(blob[:HEADER_SIZE]) == 2
+    assert header_entry_count(blob[: HEADER_SIZE - 1]) is None
+    assert header_entry_count(b"XXXX" + blob[4:HEADER_SIZE]) is None
+
+
+def test_merge_entry_incarnation_rules():
+    local = MemberEntry(state=QUARANTINED, incarnation=1, suspicion=3.0)
+    # Higher incarnation wins outright — even a plain alive claim.
+    merged, changed = merge_entry(
+        local, MemberEntry(state=ALIVE, incarnation=2, suspicion=0.0)
+    )
+    assert changed and merged.state == ALIVE and merged.incarnation == 2
+    # Lower incarnation is stale noise.
+    merged, changed = merge_entry(
+        local, MemberEntry(state=DEAD, incarnation=0, suspicion=9.0)
+    )
+    assert not changed and merged is local
+    # Equal incarnation: more-damning state and max suspicion win.
+    merged, changed = merge_entry(
+        MemberEntry(state=SUSPECT, incarnation=1, suspicion=1.0),
+        MemberEntry(state=QUARANTINED, incarnation=1, suspicion=0.5),
+    )
+    assert changed and merged.state == QUARANTINED
+    assert merged.suspicion == pytest.approx(1.0)
+    # Equal incarnation, nothing new: unchanged.
+    merged, changed = merge_entry(
+        local, MemberEntry(state=SUSPECT, incarnation=1, suspicion=1.0)
+    )
+    assert not changed
+
+
+# ---------------------------------------------------------------------------
+# Membership manager: refutation, adoption, quorum, heal advice
+# ---------------------------------------------------------------------------
+
+
+def _claim(origin, round, entries):
+    return encode_digest(Digest(origin=origin, round=round, entries=entries))
+
+
+def test_refutation_bumps_own_incarnation():
+    sb = Scoreboard(4, me=1)
+    mgr = MembershipManager(4, 1, sb)
+    # A peer claims WE are quarantined at our current incarnation.
+    mgr.merge(
+        _claim(0, 5, {1: MemberEntry(state=QUARANTINED, incarnation=0)}),
+        round=5,
+    )
+    assert mgr.incarnation == 1
+    events = mgr.pop_events()
+    refs = [e for e in events if e["event"] == "refutation"]
+    assert len(refs) == 1
+    assert refs[0]["claimed_by"] == 0
+    assert refs[0]["claimed_state"] == "quarantined"
+    assert refs[0]["incarnation"] == 1
+    # The same stale claim again is outbid — no second bump.
+    mgr.merge(
+        _claim(2, 6, {1: MemberEntry(state=QUARANTINED, incarnation=0)}),
+        round=6,
+    )
+    assert mgr.incarnation == 1
+    # A claim that caught up to the new incarnation bumps again.
+    mgr.merge(
+        _claim(2, 7, {1: MemberEntry(state=SUSPECT, incarnation=1)}),
+        round=7,
+    )
+    assert mgr.incarnation == 2
+    # Our own encoded digest advertises the refuted incarnation.
+    own = decode_digest(mgr.encode(8))
+    assert own.entries[1].state == ALIVE
+    assert own.entries[1].incarnation == 2
+
+
+def test_remote_quarantine_claim_is_adopted():
+    sb = Scoreboard(4, me=0)
+    mgr = MembershipManager(4, 0, sb)
+    mgr.merge(
+        _claim(1, 3, {2: MemberEntry(state=QUARANTINED, incarnation=0)}),
+        round=3,
+    )
+    assert sb.state(2) == PeerState.QUARANTINED
+    # A SECOND identical claim changes nothing (no re-quarantine).
+    streak = sb.quarantine_streak(2)
+    mgr.merge(
+        _claim(3, 4, {2: MemberEntry(state=QUARANTINED, incarnation=0)}),
+        round=4,
+    )
+    assert sb.quarantine_streak(2) == streak
+
+
+def test_fresher_alive_claim_readmits_peer():
+    sb = Scoreboard(4, me=0)
+    mgr = MembershipManager(4, 0, sb)
+    mgr.merge(
+        _claim(1, 3, {2: MemberEntry(state=QUARANTINED, incarnation=0)}),
+        round=3,
+    )
+    assert sb.state(2) == PeerState.QUARANTINED
+    mgr.pop_events()
+    # Peer 2 refuted: alive at a HIGHER incarnation beats the claim.
+    mgr.merge(
+        _claim(2, 6, {2: MemberEntry(state=ALIVE, incarnation=1)}), round=6
+    )
+    assert sb.state(2) == PeerState.HEALTHY
+    refs = [e for e in mgr.pop_events() if e["event"] == "peer_refuted"]
+    assert refs == [{"event": "peer_refuted", "peer": 2, "incarnation": 1}]
+
+
+def test_quorum_degraded_mode_and_heal_advice():
+    sb = Scoreboard(5, me=0)
+    mgr = MembershipManager(
+        5,
+        0,
+        sb,
+        MembershipConfig(quorum_fraction=0.5, degraded_alpha_scale=0.25),
+    )
+    assert not mgr.degraded and mgr.alpha_scale() == 1.0
+    for p in (2, 3, 4):
+        sb.adopt_quarantine(p, round=1)
+    mgr.end_round(1)
+    # Component {0, 1} is 2/5 < 0.5 -> degraded.
+    assert mgr.degraded
+    assert mgr.alpha_scale() == 0.25
+    events = mgr.pop_events()
+    kinds = [e["event"] for e in events]
+    assert "component_changed" in kinds
+    entered = [e for e in events if e["event"] == "partition_entered"]
+    assert len(entered) == 1 and entered[0]["component"] == [0, 1]
+    # Still degraded next round: no duplicate partition_entered.
+    mgr.end_round(2)
+    assert not [
+        e for e in mgr.pop_events() if e["event"] == "partition_entered"
+    ]
+    # The far side returns: quorum restored, heal advice issued once.
+    for p in (2, 3, 4):
+        sb.readmit(p, round=3)
+    mgr.end_round(3)
+    assert not mgr.degraded
+    events = mgr.pop_events()
+    healed = [e for e in events if e["event"] == "partition_healed"]
+    assert len(healed) == 1 and healed[0]["component"] == [0, 1, 2, 3, 4]
+    advice = mgr.pop_heal_advice()
+    assert advice is not None
+    assert advice["returning"] == [2, 3, 4]
+    assert advice["weight"] == pytest.approx(min(0.75, 3 / 5))
+    assert advice["step"] == 3
+    assert mgr.pop_heal_advice() is None  # one-shot
+
+
+def test_dead_label_after_quarantine_streak():
+    sb = Scoreboard(3, me=0)
+    mgr = MembershipManager(
+        3, 0, sb, MembershipConfig(dead_after_quarantines=2)
+    )
+    sb.adopt_quarantine(2, round=1)
+    d = decode_digest(mgr.encode(1))
+    assert d.entries[2].state == QUARANTINED  # streak 1 < 2: not dead yet
+    sb.record_probe(2, False, round=5)  # failed re-admission: streak 2
+    d = decode_digest(mgr.encode(6))
+    assert d.entries[2].state == DEAD
+    # Dead is a label, not a tombstone: a successful probe revives it.
+    sb.record_probe(2, True, round=9)
+    d = decode_digest(mgr.encode(10))
+    assert d.entries[2].state == ALIVE
+
+
+# ---------------------------------------------------------------------------
+# Classified probe outcomes through the scoreboard (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_classified_probe_outcomes_accrue_suspicion():
+    sb = Scoreboard(3, me=0)
+    sb.record_probe(1, Outcome.TIMEOUT, round=1)
+    assert sb.state(1) == PeerState.SUSPECT
+    assert sb.suspicion(1) > 0.0
+    sb.record_probe(1, Outcome.TIMEOUT, round=2)
+    assert sb.state(1) == PeerState.QUARANTINED  # 2 × 1.0 hits threshold
+    # Probe attempts are accounted like always.
+    assert sb.snapshot()["peers"][1]["probe_attempts"] == 2
+
+
+def test_classified_probe_success_decays_suspicion():
+    sb = Scoreboard(3, me=0)
+    sb.record_probe(1, Outcome.REFUSED, round=1)
+    s0 = sb.suspicion(1)
+    sb.record_probe(1, Outcome.SUCCESS, round=2)
+    assert 0.0 < sb.suspicion(1) < s0
+    assert sb.state(1) == PeerState.SUSPECT
+
+
+def test_would_quarantine_predicts_threshold_crossing():
+    sb = Scoreboard(3, me=0)
+    assert not sb.would_quarantine(1, Outcome.TIMEOUT)  # 0 + 1.0 < 2.0
+    sb.record_probe(1, Outcome.TIMEOUT, round=1)
+    assert sb.would_quarantine(1, Outcome.TIMEOUT)  # 1.0 + 1.0 >= 2.0
+    assert not sb.would_quarantine(1, "no-such-outcome")
+    sb.adopt_quarantine(1, round=2)
+    assert not sb.would_quarantine(1, Outcome.TIMEOUT)  # already there
+
+
+# ---------------------------------------------------------------------------
+# Wire-format compatibility: the digest is an OPTIONAL trailing section
+# ---------------------------------------------------------------------------
+
+
+def test_frame_without_digest_still_parses():
+    """Regression: pre-membership frames (no trailer) must stay fully
+    readable, including by a digest-wanting reader."""
+    srv = PeerServer("127.0.0.1", 0)
+    try:
+        vec = np.arange(16, dtype=np.float32)
+        srv.publish(vec, 3.0, 0.25)  # no digest
+        result, outcome, _lat, nrx, digest = fetch_blob_full(
+            "127.0.0.1", srv.port, 500, want_digest=True
+        )
+        assert outcome == Outcome.SUCCESS
+        np.testing.assert_array_equal(result[0], vec)
+        assert result[1] == 3.0
+        assert nrx == vec.nbytes
+        assert digest is None
+        assert probe_header("127.0.0.1", srv.port)
+    finally:
+        srv.close()
+
+
+def test_frame_with_digest_is_backward_compatible():
+    """A digest-carrying frame reads identically through every OLD
+    reader (fetch_blob / fetch_blob_ex / probe_header ignore the
+    trailer), and the new reader recovers the exact digest bytes."""
+    srv = PeerServer("127.0.0.1", 0)
+    try:
+        vec = np.arange(32, dtype=np.float32)
+        dg = encode_digest(
+            Digest(
+                origin=1,
+                round=9,
+                entries={
+                    0: MemberEntry(state=ALIVE, incarnation=4),
+                    2: MemberEntry(state=QUARANTINED, suspicion=2.5),
+                },
+            )
+        )
+        srv.publish(vec, 7.0, 0.5, digest=dg)
+        # Old readers: payload parses, trailer invisible.
+        got = fetch_blob("127.0.0.1", srv.port, 500)
+        np.testing.assert_array_equal(got[0], vec)
+        result, outcome, _lat, nrx = fetch_blob_ex(
+            "127.0.0.1", srv.port, 500
+        )
+        assert outcome == Outcome.SUCCESS and nrx == vec.nbytes
+        outcome, clock = probe_header_classified("127.0.0.1", srv.port)
+        assert outcome == Outcome.SUCCESS and clock == 7.0
+        # New reader: the digest comes back byte-identical.
+        *_, digest = fetch_blob_full(
+            "127.0.0.1", srv.port, 500, want_digest=True
+        )
+        assert digest == dg
+        back = decode_digest(digest)
+        assert back.origin == 1 and back.entries[2].state == QUARANTINED
+    finally:
+        srv.close()
+
+
+def test_truncate_fault_cuts_the_vector_not_the_trailer():
+    """Chaos 'truncate' must land mid-VECTOR even when a digest trailer
+    pads the frame — otherwise the fault silently degrades to 'lost
+    digest' and stops exercising the short-read path."""
+    vec = np.arange(64, dtype=np.float32)
+    dg = encode_digest(
+        Digest(origin=0, round=1, entries={1: MemberEntry()})
+    )
+    payload = _frame(vec, 1.0, 0.1, digest=dg)
+    assert len(payload) == _HDR.size + vec.nbytes + len(dg)
+    cut = mutate_frame(payload, "truncate")
+    assert len(cut) == _HDR.size + vec.nbytes // 2
+
+
+# ---------------------------------------------------------------------------
+# Relay verb (SWIM indirect probe leg)
+# ---------------------------------------------------------------------------
+
+
+def test_relay_probe_vouches_for_live_target():
+    target = PeerServer("127.0.0.1", 0)
+    relay = PeerServer("127.0.0.1", 0)
+    try:
+        target.publish(np.zeros(8, np.float32), 11.0, 0.1)
+        relay_outcome, probe_outcome, clock = relay_probe(
+            "127.0.0.1", relay.port, 1, "127.0.0.1", target.port,
+            probe_timeout_ms=200, timeout_ms=1000,
+        )
+        assert relay_outcome == Outcome.SUCCESS
+        assert probe_outcome == Outcome.SUCCESS
+        assert clock == 11.0
+    finally:
+        target.close()
+        relay.close()
+
+
+def test_relay_probe_reports_dead_target():
+    relay = PeerServer("127.0.0.1", 0)
+    try:
+        relay_outcome, probe_outcome, clock = relay_probe(
+            "127.0.0.1", relay.port, 1, "127.0.0.1", _dead_port(),
+            probe_timeout_ms=100, timeout_ms=1000,
+        )
+        assert relay_outcome == Outcome.SUCCESS
+        assert probe_outcome == Outcome.REFUSED
+        assert clock is None
+    finally:
+        relay.close()
+
+
+def test_relay_guard_refuses_blocked_targets():
+    """A partitioned relay must not vouch across the split: the guard
+    hook answers REFUSED without probing."""
+    target = PeerServer("127.0.0.1", 0)
+    relay = PeerServer("127.0.0.1", 0)
+    try:
+        target.publish(np.zeros(8, np.float32), 5.0, 0.1)
+        relay.relay_guard = lambda t: True
+        relay_outcome, probe_outcome, _clock = relay_probe(
+            "127.0.0.1", relay.port, 1, "127.0.0.1", target.port,
+            probe_timeout_ms=200, timeout_ms=1000,
+        )
+        assert relay_outcome == Outcome.SUCCESS
+        assert probe_outcome == Outcome.REFUSED
+    finally:
+        target.close()
+        relay.close()
+
+
+# ---------------------------------------------------------------------------
+# Chaos partition injection (deterministic, config-agreed)
+# ---------------------------------------------------------------------------
+
+
+def test_partition_window_blocks_cross_links_only():
+    from dpwa_tpu.config import ChaosConfig
+
+    cfg = ChaosConfig(
+        enabled=True, seed=3, partition_windows=(((0, 1), 5, 10),)
+    )
+    engines = [ChaosEngine(cfg, p) for p in range(4)]
+    for e in engines:
+        # Inside the window: cross-group links blocked BOTH directions,
+        # intra-group links open — and every engine agrees.
+        assert e.link_blocked(5, 0, 2) and e.link_blocked(5, 2, 0)
+        assert e.link_blocked(9, 1, 3) and e.link_blocked(9, 3, 1)
+        assert not e.link_blocked(5, 0, 1)
+        assert not e.link_blocked(5, 2, 3)
+        # Outside the window: everything open.
+        assert not e.link_blocked(4, 0, 2)
+        assert not e.link_blocked(10, 2, 0)
+        assert not e.link_blocked(7, 2, 2)  # self never blocked
+
+
+def test_link_window_is_one_sided():
+    from dpwa_tpu.config import ChaosConfig
+
+    cfg = ChaosConfig(enabled=True, link_windows=((0, 1, 2, 8),))
+    e = ChaosEngine(cfg, 0)
+    assert e.link_blocked(4, 0, 1)
+    assert not e.link_blocked(4, 1, 0)  # genuinely asymmetric
+    assert not e.link_blocked(8, 0, 1)
+
+
+def test_drawn_partition_is_deterministic_and_agreed():
+    from dpwa_tpu.config import ChaosConfig
+
+    cfg = ChaosConfig(
+        enabled=True, seed=7, partition_probability=0.5,
+        partition_len_rounds=4,
+    )
+    engines = [ChaosEngine(cfg, p) for p in range(6)]
+    rounds = (0, 3, 4, 8, 12, 17)
+
+    def picture(e):
+        return [
+            [[e.link_blocked(r, s, d) for d in range(6)] for s in range(6)]
+            for r in rounds
+        ]
+
+    # Every engine computes the identical block/side picture from config
+    # alone (no coordination), and a fresh engine replays it bit-exact.
+    ref = picture(engines[0])
+    for e in engines[1:]:
+        assert picture(e) == ref
+    assert picture(ChaosEngine(cfg, 0)) == ref
+    # Some block in a long horizon actually splits (p=0.5), and inside a
+    # split block the relation is symmetric.
+    split_rounds = [
+        r for r in range(0, 64, 4)
+        if any(engines[0].link_blocked(r, s, d)
+               for s in range(6) for d in range(6))
+    ]
+    assert split_rounds  # p=0.5 over 16 blocks: astronomically unlikely none
+    r = split_rounds[0]
+    for s in range(6):
+        for d in range(6):
+            assert engines[0].link_blocked(r, s, d) == engines[0].link_blocked(
+                r, d, s
+            )
+
+
+# ---------------------------------------------------------------------------
+# In-process acceptance: split -> detect -> degrade -> heal -> refute
+# ---------------------------------------------------------------------------
+
+_SPLIT = (6, 18)  # chaos partition window (rounds) for the scenario
+
+
+def _run_partition_scenario(seed, steps=48, n=4):
+    """Four lock-step transports, {0,1} | {2,3} split for rounds [6,18).
+
+    Returns (vecs, traces, events, comp_log, quarantine_log, advice)."""
+    ts = make_ring(
+        n,
+        seed=seed,
+        schedule="ring",
+        timeout_ms=300,
+        health=dict(
+            jitter_rounds=1,
+            quarantine_base_rounds=2,
+            quarantine_max_rounds=8,
+        ),
+        chaos=dict(
+            enabled=True,
+            seed=seed,
+            partition_windows=(((0, 1), _SPLIT[0], _SPLIT[1]),),
+        ),
+        membership=dict(quorum_fraction=0.6),
+    )
+    vecs = [np.full(32, float(i), np.float32) for i in range(n)]
+    traces = [[] for _ in range(n)]
+    events = [[] for _ in range(n)]
+    comp_log = [[] for _ in range(n)]  # (step, component tuple, degraded)
+    quarantine_log = [[] for _ in range(n)]  # (step, tuple of quarantined)
+    advice = [[] for _ in range(n)]
+    try:
+        for step in range(steps):
+            for i, t in enumerate(ts):
+                vecs[i], _alpha, _partner = t.exchange(
+                    vecs[i], float(step), 0.1, step
+                )
+                lr = t.last_round
+                traces[i].append(
+                    (
+                        step,
+                        lr.get("sched_partner"),
+                        lr.get("partner"),
+                        lr.get("remapped"),
+                        lr.get("outcome"),
+                    )
+                )
+                for ev in t.pop_membership_events():
+                    events[i].append(dict(ev, step=step))
+                a = t.pop_heal_advice()
+                if a is not None:
+                    advice[i].append(a)
+                view = t.membership.view_snapshot()
+                comp_log[i].append(
+                    (
+                        step,
+                        tuple(view["component"]),
+                        view["partition_state"] == "degraded",
+                    )
+                )
+                quarantine_log[i].append(
+                    (
+                        step,
+                        tuple(
+                            p
+                            for p in range(n)
+                            if p != i
+                            and t.scoreboard.state(p)
+                            == PeerState.QUARANTINED
+                        ),
+                    )
+                )
+    finally:
+        close_all(ts)
+    return vecs, traces, events, comp_log, quarantine_log, advice
+
+
+_SCENARIO_CACHE = {}
+
+
+def _partition_scenario(seed=5):
+    if seed not in _SCENARIO_CACHE:
+        _SCENARIO_CACHE[seed] = _run_partition_scenario(seed)
+    return _SCENARIO_CACHE[seed]
+
+
+def test_partition_detect_heal_in_process():
+    n = 4
+    vecs, traces, events, comp_log, _ql, advice = _partition_scenario()
+    split_start, split_stop = _SPLIT
+    for i in range(n):
+        kinds = [e["event"] for e in events[i]]
+        # Every node detected the split (below 0.6 quorum on BOTH sides
+        # of a 2|2 split) and recovered from it.
+        assert "partition_entered" in kinds, (i, events[i])
+        assert "partition_healed" in kinds, (i, events[i])
+        entered = next(
+            e for e in events[i] if e["event"] == "partition_entered"
+        )
+        # Detection happened inside the window, after real evidence
+        # accrued (threshold is 2 failures/peer + 1 dissemination hop).
+        assert split_start < entered["step"] < split_stop, entered
+        my_side = {0, 1} if i in (0, 1) else {2, 3}
+        assert set(entered["component"]) <= my_side
+        # The component closed back to FULL by the end of the run.
+        assert comp_log[i][-1][1] == (0, 1, 2, 3), comp_log[i][-6:]
+        assert comp_log[i][-1][2] is False  # not degraded
+    # Detection is epidemic: within each side the two nodes agree within
+    # <= 3 rounds of each other (the dissemination bound).
+    det = [
+        next(e["step"] for e in events[i] if e["event"] == "partition_entered")
+        for i in range(n)
+    ]
+    assert abs(det[0] - det[1]) <= 3, det
+    assert abs(det[2] - det[3]) <= 3, det
+    # Stale quarantine claims were refuted via incarnation bumps — the
+    # readmissions could not have spread ring-wide without them.
+    all_events = [e for evs in events for e in evs]
+    refutations = [e for e in all_events if e["event"] == "refutation"]
+    assert refutations, all_events
+    assert all(e["incarnation"] >= 1 for e in refutations)
+    assert [e for e in all_events if e["event"] == "peer_refuted"]
+    # Heal advice fired somewhere with a real returning set.
+    fired = [a for node in advice for a in node]
+    assert fired, advice
+    assert all(set(a["returning"]) for a in fired)
+    assert all(0.0 < a["weight"] <= 0.75 for a in fired)
+    # Gossip re-converged the ring after the heal: final spread is far
+    # below the initial spread (vectors started 0..3 apart).
+    means = [float(v.mean()) for v in vecs]
+    assert max(means) - min(means) < 0.5, means
+
+
+def test_partition_scenario_is_deterministic():
+    """Identical seeds => bit-identical partner/remap traces AND
+    bit-identical membership event sequences (ISSUE acceptance: no wall
+    clock in any decision path)."""
+    a = _run_partition_scenario(seed=9)
+    b = _run_partition_scenario(seed=9)
+    # traces: (step, sched_partner, partner, remapped, outcome) per node.
+    assert a[1] == b[1]
+    # membership event streams, component evolution, quarantine windows,
+    # heal advice: all replayed exactly.
+    assert json.dumps(a[2], sort_keys=True) == json.dumps(
+        b[2], sort_keys=True
+    )
+    assert a[3] == b[3]
+    assert a[4] == b[4]
+    assert a[5] == b[5]
+
+
+def test_false_suspicion_refuted_without_quarantine():
+    """Asymmetric failure (only the 0->1 link is down): node 0 accrues
+    suspicion against a perfectly healthy node 1, but the indirect-probe
+    vouch path keeps it below the quarantine threshold, and node 1
+    clears the disseminated suspicion by bumping its incarnation —
+    NEVER entering quarantine anywhere in the ring."""
+    n, steps = 4, 30
+    ts = make_ring(
+        n,
+        seed=2,
+        schedule="ring",
+        timeout_ms=300,
+        health=dict(jitter_rounds=1, quarantine_base_rounds=2),
+        chaos=dict(enabled=True, seed=2, link_windows=((0, 1, 4, steps),)),
+        membership=dict(indirect_probes=2),
+    )
+    vecs = [np.full(16, float(i), np.float32) for i in range(n)]
+    events = [[] for _ in range(n)]
+    try:
+        for step in range(steps):
+            for i, t in enumerate(ts):
+                vecs[i], _a, _p = t.exchange(vecs[i], float(step), 0.1, step)
+                events[i].extend(t.pop_membership_events())
+                # THE acceptance bit: the falsely-suspected node is never
+                # quarantined by anyone, at any point in the run.
+                for j, tj in enumerate(ts):
+                    if j != 1:
+                        assert (
+                            tj.scoreboard.state(1) != PeerState.QUARANTINED
+                        ), (step, j)
+        # Node 0 really did accrue evidence (its link IS broken)...
+        assert ts[0].scoreboard.suspicion(1) > 0.0
+        # ...and really did ask relays: probe attempts recorded against
+        # the relay peers it drew.
+        snap0 = ts[0].scoreboard.snapshot()
+        assert (
+            snap0["peers"][2]["probe_attempts"]
+            + snap0["peers"][3]["probe_attempts"]
+            > 0
+        )
+        # Node 1 refuted the disseminated suspicion via incarnation bump.
+        refs = [e for e in events[1] if e["event"] == "refutation"]
+        assert refs and refs[0]["incarnation"] >= 1
+        assert ts[1].membership.incarnation >= 1
+    finally:
+        close_all(ts)
+
+
+# ---------------------------------------------------------------------------
+# Adapter heal reconciliation (anti-entropy merge over the STATE wire)
+# ---------------------------------------------------------------------------
+
+
+def _make_adapters(n, dim=16, seed=0):
+    cfg = make_local_config(n, base_port=0, seed=seed, timeout_ms=500)
+    streams = [io.StringIO() for _ in range(n)]
+    ads = [
+        DpwaTcpAdapter(
+            {"w": np.full(dim, float(i), np.float32)},
+            f"node{i}",
+            cfg,
+            metrics=MetricsLogger(stream=streams[i]),
+        )
+        for i in range(n)
+    ]
+    for a in ads:
+        for i, b in enumerate(ads):
+            a.transport.set_peer_port(i, b.transport.port)
+    return ads, streams
+
+
+def _stream_events(stream):
+    return [
+        json.loads(l)
+        for l in stream.getvalue().splitlines()
+        if json.loads(l).get("record") == "event"
+    ]
+
+
+def test_reconcile_heal_merges_returning_state():
+    ads, streams = _make_adapters(2)
+    try:
+        ads[0]._reconcile_heal({"returning": [1], "weight": 0.5, "step": 0})
+        evs = _stream_events(streams[0])
+        rec = [e for e in evs if e["event"] == "partition_reconciled"]
+        assert len(rec) == 1
+        assert rec[0]["donor"] == 1 and rec[0]["weight"] == 0.5
+        # 0.5 * zeros + 0.5 * ones = 0.5 everywhere.
+        np.testing.assert_allclose(ads[0]._vec, 0.5)
+        # The pre-reconcile replica was banked for rollback first.
+        assert ads[0].ring.pushes >= 1
+    finally:
+        for a in ads:
+            a.close()
+
+
+def test_reconcile_heal_rejects_poisoned_donor():
+    ads, streams = _make_adapters(2)
+    try:
+        # The returning component diverged to NaN during the split: the
+        # guard must refuse the merge and keep the local replica.
+        ads[1]._vec = np.full_like(ads[1]._vec, np.nan)
+        ads[1].transport.publish_state(ads[1]._packed_state())
+        before = ads[0]._vec.copy()
+        ads[0]._reconcile_heal({"returning": [1], "weight": 0.5, "step": 0})
+        evs = _stream_events(streams[0])
+        rej = [
+            e for e in evs if e["event"] == "partition_reconcile_rejected"
+        ]
+        assert len(rej) == 1 and rej[0]["reason"] == "nonfinite_params"
+        assert not [
+            e for e in evs if e["event"] == "partition_reconciled"
+        ]
+        np.testing.assert_array_equal(ads[0]._vec, before)
+    finally:
+        for a in ads:
+            a.close()
+
+
+def test_reconcile_heal_donor_election_is_deterministic():
+    ads, _streams = _make_adapters(3)
+    try:
+        from dpwa_tpu.parallel.schedules import heal_draw
+
+        seed = ads[0].transport.schedule.seed
+        picks = [
+            int(heal_draw(seed, step, 0, 2)) for step in range(8)
+        ]
+        assert picks == [
+            int(heal_draw(seed, step, 0, 2)) for step in range(8)
+        ]
+        assert set(picks) <= {0, 1}
+    finally:
+        for a in ads:
+            a.close()
+
+
+# ---------------------------------------------------------------------------
+# Observability satellites: snapshot, /healthz, metrics, health_report
+# ---------------------------------------------------------------------------
+
+
+def test_scoreboard_snapshot_carries_membership_view():
+    sb = Scoreboard(3, me=0)
+    mgr = MembershipManager(3, 0, sb)
+    mgr.merge(
+        _claim(1, 2, {2: MemberEntry(state=QUARANTINED, incarnation=4)}),
+        round=2,
+    )
+    mgr.end_round(2)
+    snap = sb.snapshot()
+    assert snap["membership"]["incarnation"] == 0
+    assert snap["membership"]["component"] == [0, 1]
+    assert snap["membership"]["partition_state"] == "ok"  # 2/3 >= 0.5 quorum
+    assert snap["peers"][2]["incarnation"] == 4
+    assert snap["peers"][1]["incarnation"] == 0
+    # A bare scoreboard (no manager attached) stays membership-free.
+    bare = Scoreboard(3, me=0).snapshot()
+    assert "membership" not in bare
+    assert "incarnation" not in bare["peers"][1]
+
+
+def test_healthz_serves_membership_route():
+    import http.client
+
+    doc = {
+        "me": 0,
+        "peers": {"1": {"state": "healthy"}},
+        "membership": {
+            "incarnation": 2,
+            "component": [0, 1],
+            "partition_state": "degraded",
+        },
+    }
+    srv = HealthzServer(lambda: doc)
+    try:
+        def get(path):
+            c = http.client.HTTPConnection("127.0.0.1", srv.port, timeout=5)
+            c.request("GET", path)
+            body = json.loads(c.getresponse().read())
+            c.close()
+            return body
+
+        assert get("/healthz") == doc
+        assert get("/membership") == doc["membership"]
+    finally:
+        srv.close()
+    # Membership disabled: the route answers with an explanation, not a
+    # crash or the full document.
+    srv = HealthzServer(lambda: {"me": 0, "peers": {}})
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", srv.port, timeout=5)
+        conn.request("GET", "/membership")
+        assert json.loads(conn.getresponse().read()) == {
+            "error": "membership disabled"
+        }
+        conn.close()
+    finally:
+        srv.close()
+
+
+def test_log_health_flattens_membership_columns():
+    sio = io.StringIO()
+    log = MetricsLogger(stream=sio)
+    snap = {
+        "me": 0,
+        "round": 7,
+        "peers": {
+            1: {"state": "healthy", "suspicion": 0.0, "incarnation": 3},
+            2: {"state": "quarantined", "suspicion": 2.5, "incarnation": 0},
+        },
+        "membership": {
+            "incarnation": 1,
+            "component": [0, 1],
+            "component_id": 0,
+            "component_size": 2,
+            "partition_state": "degraded",
+        },
+    }
+    log.log_health(0, snap)
+    rec = json.loads(sio.getvalue().splitlines()[-1])
+    assert rec["incarnation"] == [3, 0]
+    assert rec["own_incarnation"] == 1
+    assert rec["component"] == [0, 1]
+    assert rec["partition_state"] == "degraded"
+    # Pre-membership snapshots produce pre-membership records.
+    sio2 = io.StringIO()
+    log2 = MetricsLogger(stream=sio2)
+    log2.log_health(
+        0,
+        {"me": 0, "round": 1, "peers": {1: {"state": "healthy"}}},
+    )
+    rec2 = json.loads(sio2.getvalue().splitlines()[-1])
+    for key in ("incarnation", "own_incarnation", "partition_state"):
+        assert key not in rec2
+    log.close()
+    log2.close()
+
+
+def _load_health_report():
+    spec = importlib.util.spec_from_file_location(
+        "health_report",
+        os.path.join(
+            os.path.dirname(__file__), os.pardir, "tools", "health_report.py"
+        ),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_health_report_digests_membership_events(tmp_path):
+    report = _load_health_report()
+    path = tmp_path / "m.jsonl"
+    recs = [
+        {"step": 10, "record": "event", "event": "partition_entered",
+         "component": [0, 1], "size": 2, "quorum_fraction": 0.6},
+        {"step": 12, "record": "event", "event": "refutation", "peer": 2,
+         "claimed_state": "quarantined", "claimed_by": 0, "incarnation": 1},
+        {"step": 13, "record": "event", "event": "component_changed",
+         "component": [0, 1, 2], "size": 3, "component_id": 0},
+        {"step": 14, "record": "event", "event": "peer_refuted", "peer": 2,
+         "incarnation": 1},
+        {"step": 20, "record": "event", "event": "partition_healed",
+         "component": [0, 1, 2, 3], "size": 4, "returning": [2, 3]},
+        {"step": 21, "record": "event", "event": "partition_reconciled",
+         "donor": 2, "weight": 0.5, "nbytes": 128, "returning": [2, 3]},
+        {"step": 22, "record": "event",
+         "event": "partition_reconcile_rejected", "donor": 3,
+         "reason": "nonfinite_params"},
+        {"step": 24, "record": "health", "me": 0, "round": 24,
+         "peer": [1, 2, 3], "peer_state": ["healthy"] * 3,
+         "suspicion": [0.0] * 3, "quarantined_rounds": [0] * 3,
+         "quarantines": [0] * 3, "attempts": [1] * 3, "failures": [0] * 3,
+         "probe_attempts": [0] * 3, "last_outcome": ["success"] * 3,
+         "partition_state": "ok"},
+    ]
+    path.write_text("".join(json.dumps(r) + "\n" for r in recs))
+    summary = report.summarize([str(path)], split_step=8)
+    mem = summary["membership"]
+    assert mem["partitions_entered"] == 1
+    assert mem["partitions_healed"] == 1
+    ep = mem["episodes"][0]
+    assert ep["entered_step"] == 10 and ep["healed_step"] == 20
+    assert ep["time_to_heal"] == 10
+    assert ep["time_to_detect"] == 2
+    assert mem["refutations"] == 1
+    assert mem["peers_refuted"] == 1
+    assert mem["component_changes"] == 1
+    assert mem["reconciliations"] == 1
+    assert mem["reconcile_rejected"] == 1
+    assert mem["reconcile_donors"] == {"2": 1}
+    assert mem["last_partition_state"] == "ok"
+    # The printed table renders the membership section without crashing.
+    report._print_table(summary)
+
+
+# ---------------------------------------------------------------------------
+# The five-process split -> diverge -> heal -> reconcile soak (slow tier)
+# ---------------------------------------------------------------------------
+
+_WORKER = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "membership_worker.py"
+)
+
+
+def _free_base_port(span):
+    for _ in range(50):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        p = s.getsockname()[1]
+        s.close()
+        if p + span >= 65536:
+            continue
+        held = []
+        try:
+            for k in range(span):
+                t = socket.socket()
+                t.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+                t.bind(("127.0.0.1", p + k))
+                held.append(t)
+        except OSError:
+            continue
+        finally:
+            for t in held:
+                t.close()
+        if len(held) == span:
+            return p
+    raise RuntimeError("no consecutive free port range found")
+
+
+@pytest.mark.slow
+def test_acceptance_five_process_partition_soak(tmp_path):
+    """ISSUE 3 acceptance: five worker processes, a 2|3 partition window
+    injected by deterministic chaos config.  Both components keep
+    training through the split; every node detects the split (epidemic
+    dissemination, <= 3 rounds skew inside a side); after the window the
+    ring heals, stale suspicions are refuted via incarnation bumps
+    without quarantining a healthy node's refuted claim, heal
+    reconciliation fires, and the replicas converge back below their
+    at-split-end divergence — with zero poisoned rejections of healthy
+    payloads.
+
+    The group is (1, 2): the ring schedule for odd n is the path
+    0-1-2-3-4, so this cut severs the two edges 0-1 and 2-3 and all
+    four endpoint nodes observe the split on their own fetches; the
+    remaining evidence (nodes 0/4, the far side of each path) arrives
+    epidemically and via the quarantine-remap draws that double as
+    SWIM's random probing."""
+    n, steps = 5, 70
+    group = (1, 2)
+    split_start, split_stop = 10, 30
+    base_port = _free_base_port(n)
+    paths = [str(tmp_path / f"m_{i}.jsonl") for i in range(n)]
+    procs = []
+    for i in range(n):
+        procs.append(
+            subprocess.Popen(
+                [
+                    sys.executable, _WORKER,
+                    "--index", str(i), "--n", str(n),
+                    "--base-port", str(base_port),
+                    "--steps", str(steps),
+                    "--seed", "11",
+                    "--metrics", paths[i],
+                    "--split-group", ",".join(map(str, group)),
+                    "--split-start", str(split_start),
+                    "--split-stop", str(split_stop),
+                ],
+                env=dict(os.environ, JAX_PLATFORMS="cpu"),
+            )
+        )
+    deadline = time.monotonic() + 240.0
+    try:
+        for p in procs:
+            p.wait(timeout=max(1.0, deadline - time.monotonic()))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    assert all(p.returncode == 0 for p in procs), [
+        p.returncode for p in procs
+    ]
+
+    records = [[json.loads(l) for l in open(p)] for p in paths]
+    sides = [group, tuple(i for i in range(n) if i not in group)]
+
+    def events(i, kind):
+        return [
+            r for r in records[i]
+            if r.get("record") == "event" and r.get("event") == kind
+        ]
+
+    # 1. Every worker completed every step, and BOTH components kept
+    #    exchanging successfully during the split (intra-side gossip).
+    for i in range(n):
+        ex = [r for r in records[i] if "sched_partner" in r]
+        assert [r["step"] for r in ex] == list(range(steps))
+    for side in sides:
+        ok_in_window = [
+            r
+            for i in side
+            for r in records[i]
+            if "sched_partner" in r
+            and split_start + 2 <= r["step"] < split_stop
+            and r.get("outcome") == "success"
+        ]
+        assert ok_in_window, f"side {side} made no progress during split"
+
+    # 2. Every node detected the split inside the window; within each
+    #    side the detection steps agree to <= 3 rounds (epidemic bound).
+    detect = {}
+    for i in range(n):
+        shrunk = [
+            r["step"]
+            for r in events(i, "component_changed")
+            if r.get("size", n) < n
+        ]
+        assert shrunk, f"node {i} never saw the component shrink"
+        detect[i] = min(shrunk)
+        assert split_start <= detect[i] <= split_stop + 3, (i, detect[i])
+    for side in sides:
+        dets = [detect[i] for i in side]
+        assert max(dets) - min(dets) <= 3, (side, dets)
+
+    # 3. The minority side (2/5 < 0.5) entered degraded mode; everyone
+    #    eventually healed back to the full component.
+    for i in group:
+        assert events(i, "partition_entered"), i
+    for i in range(n):
+        healed = events(i, "partition_healed") or [
+            r
+            for r in events(i, "component_changed")
+            if r.get("size") == n
+        ]
+        assert healed, f"node {i} never healed"
+        full = [
+            r["step"]
+            for r in events(i, "component_changed")
+            if r.get("size") == n
+        ]
+        assert full and min(full) >= split_stop, (i, full)
+
+    # 4. Refutation: stale quarantine claims were cleared by incarnation
+    #    bumps, not by another quarantine cycle.
+    refutations = [e for i in range(n) for e in events(i, "refutation")]
+    assert refutations
+    assert all(e["incarnation"] >= 1 for e in refutations)
+
+    # 5. Heal reconciliation fired (anti-entropy merge over the STATE
+    #    wire) — and nothing healthy was rejected as poisoned.
+    reconciled = [
+        e for i in range(n) for e in events(i, "partition_reconciled")
+    ]
+    assert reconciled
+    for i in range(n):
+        assert not [
+            r for r in records[i]
+            if "sched_partner" in r and r.get("outcome") == "poisoned"
+        ], f"node {i} rejected a healthy payload as poisoned"
+        assert not events(i, "partition_reconcile_rejected")
+
+    # 6. Convergence: the cross-ring replica spread at the end is well
+    #    below the spread when the split ended (the sides drifted apart
+    #    during the window; heal + reconcile pulled them back together).
+    def spread_at(step_lo, step_hi):
+        means = []
+        for i in range(n):
+            probes = [
+                r["vec_mean"]
+                for r in records[i]
+                if r.get("event") == "replica_probe"
+                and step_lo <= r["step"] < step_hi
+            ]
+            assert probes, (i, step_lo, step_hi)
+            means.append(probes[-1])
+        return max(means) - min(means)
+
+    split_end_spread = spread_at(split_stop - 3, split_stop + 1)
+    final_spread = spread_at(steps - 5, steps)
+    assert split_end_spread > 0.2, split_end_spread  # the split was real
+    assert final_spread < 0.5 * split_end_spread, (
+        split_end_spread, final_spread,
+    )
+
+    # 7. tools/health_report.py folds the whole story (a minority-side
+    #    node: it owns a full entered/healed partition episode).
+    report = _load_health_report()
+    summary = report.summarize([paths[group[0]]], split_step=split_start)
+    mem = summary["membership"]
+    assert mem["partitions_entered"] >= 1
+    assert mem["component_changes"] >= 2
+    ep = mem["episodes"][0]
+    assert ep["time_to_detect"] is not None and ep["time_to_detect"] >= 0

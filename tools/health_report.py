@@ -15,12 +15,19 @@ peer:
 plus a recovery-event digest folded from the ``record: "event"``
 lines :meth:`~dpwa_tpu.metrics.MetricsLogger.log_event` writes —
 rollbacks (with reasons), peer bootstraps (with donors), resyncs, and
-poisoned-payload rejections (see docs/recovery.md).
+poisoned-payload rejections (see docs/recovery.md) — and a membership
+digest (docs/membership.md): partition episodes with entered/healed
+steps and time-to-heal, refuted false suspicions (own-incarnation bumps
+and remote refutations adopted), heal reconciliations with donors, and
+component changes.  ``--split-step N`` (the round a known injected
+partition began, e.g. the chaos window start) additionally reports
+time-to-detect for each episode.
 
 Usage::
 
     python tools/health_report.py metrics.jsonl [more.jsonl ...]
     python tools/health_report.py --json metrics.jsonl   # machine-readable
+    python tools/health_report.py --split-step 20 metrics.jsonl
 """
 
 from __future__ import annotations
@@ -48,7 +55,9 @@ def _iter_records(paths: Iterable[str]):
                 stream.close()
 
 
-def summarize(paths: Iterable[str]) -> Dict[str, Any]:
+def summarize(
+    paths: Iterable[str], split_step: Any = None
+) -> Dict[str, Any]:
     """Fold every record into one per-peer summary dict."""
     peers: Dict[int, Dict[str, Any]] = {}
     last_health: Dict[int, Dict[str, Any]] = {}
@@ -64,6 +73,18 @@ def summarize(paths: Iterable[str]) -> Dict[str, Any]:
         "resyncs": 0,
         "resync_advised": 0,
         "other": {},
+    }
+    membership: Dict[str, Any] = {
+        "partitions_entered": 0,
+        "partitions_healed": 0,
+        "episodes": [],  # {"entered_step","healed_step","time_to_heal",...}
+        "refutations": 0,  # own-incarnation bumps (false suspicion refuted)
+        "peers_refuted": 0,  # remote refutations adopted into the view
+        "component_changes": 0,
+        "reconciliations": 0,
+        "reconcile_rejected": 0,
+        "reconcile_donors": {},
+        "last_partition_state": None,
     }
 
     def slot(p: int) -> Dict[str, Any]:
@@ -102,6 +123,50 @@ def summarize(paths: Iterable[str]) -> Dict[str, Any]:
                 events["resyncs"] += 1
             elif kind == "resync_advised":
                 events["resync_advised"] += 1
+            elif kind == "partition_entered":
+                membership["partitions_entered"] += 1
+                ep: Dict[str, Any] = {
+                    "entered_step": rec.get("step"),
+                    "component": rec.get("component"),
+                    "healed_step": None,
+                    "time_to_heal": None,
+                }
+                if split_step is not None and rec.get("step") is not None:
+                    ep["time_to_detect"] = rec["step"] - split_step
+                membership["episodes"].append(ep)
+            elif kind == "partition_healed":
+                membership["partitions_healed"] += 1
+                open_eps = [
+                    e
+                    for e in membership["episodes"]
+                    if e["healed_step"] is None
+                ]
+                if open_eps:
+                    ep = open_eps[-1]
+                    ep["healed_step"] = rec.get("step")
+                    if (
+                        ep["entered_step"] is not None
+                        and ep["healed_step"] is not None
+                    ):
+                        ep["time_to_heal"] = (
+                            ep["healed_step"] - ep["entered_step"]
+                        )
+            elif kind == "refutation":
+                membership["refutations"] += 1
+            elif kind == "peer_refuted":
+                membership["peers_refuted"] += 1
+            elif kind == "component_changed":
+                membership["component_changes"] += 1
+            elif kind == "partition_reconciled":
+                membership["reconciliations"] += 1
+                donor = str(rec.get("donor", "?"))
+                membership["reconcile_donors"][donor] = (
+                    membership["reconcile_donors"].get(donor, 0) + 1
+                )
+            elif kind in (
+                "partition_reconcile_rejected", "partition_reconcile_failed"
+            ):
+                membership["reconcile_rejected"] += 1
             else:
                 events["other"][str(kind)] = (
                     events["other"].get(str(kind), 0) + 1
@@ -109,6 +174,8 @@ def summarize(paths: Iterable[str]) -> Dict[str, Any]:
             continue
         if rec.get("record") == "health":
             n_health += 1
+            if rec.get("partition_state") is not None:
+                membership["last_partition_state"] = rec["partition_state"]
             for i, p in enumerate(rec.get("peer", [])):
                 last_health[int(p)] = {
                     "state": rec["peer_state"][i],
@@ -149,6 +216,7 @@ def summarize(paths: Iterable[str]) -> Dict[str, Any]:
         "last_step": last_step,
         "peers": {p: peers[p] for p in sorted(peers)},
         "recovery": events,
+        "membership": membership,
     }
 
 
@@ -218,6 +286,56 @@ def _print_table(summary: Dict[str, Any]) -> None:
             )
         for k, v in sorted(ev.get("other", {}).items()):
             print(f"  {k}: {v}")
+    mem = summary.get("membership", {})
+    if (
+        mem.get("partitions_entered")
+        or mem.get("refutations")
+        or mem.get("peers_refuted")
+        or mem.get("reconciliations")
+        or mem.get("component_changes")
+    ):
+        print()
+        print("# membership")
+        if mem.get("partitions_entered") or mem.get("partitions_healed"):
+            print(
+                f"  partitions: entered {mem['partitions_entered']}, "
+                f"healed {mem['partitions_healed']} "
+                f"(last state: {mem.get('last_partition_state')})"
+            )
+            for ep in mem.get("episodes", []):
+                detect = (
+                    f", detect lag {ep['time_to_detect']}"
+                    if "time_to_detect" in ep
+                    else ""
+                )
+                heal = (
+                    f"healed at {ep['healed_step']} "
+                    f"(time-to-heal {ep['time_to_heal']})"
+                    if ep.get("healed_step") is not None
+                    else "unhealed"
+                )
+                print(
+                    f"    split detected at step {ep['entered_step']}"
+                    f"{detect}; {heal}"
+                )
+        if mem.get("refutations") or mem.get("peers_refuted"):
+            print(
+                f"  false suspicions refuted: own incarnation bumps "
+                f"{mem['refutations']}, peer refutations adopted "
+                f"{mem['peers_refuted']}"
+            )
+        if mem.get("reconciliations") or mem.get("reconcile_rejected"):
+            donors = ", ".join(
+                f"donor {k}: {v}"
+                for k, v in sorted(mem["reconcile_donors"].items())
+            )
+            print(
+                f"  heal reconciliations: {mem['reconciliations']} "
+                f"({donors}); rejected/failed: "
+                f"{mem['reconcile_rejected']}"
+            )
+        if mem.get("component_changes"):
+            print(f"  component changes: {mem['component_changes']}")
 
 
 def main(argv=None) -> int:
@@ -226,8 +344,15 @@ def main(argv=None) -> int:
     ap.add_argument(
         "--json", action="store_true", help="emit the summary as JSON"
     )
+    ap.add_argument(
+        "--split-step",
+        type=int,
+        default=None,
+        help="round a known injected partition began (e.g. the chaos "
+        "partition_windows start); enables per-episode time-to-detect",
+    )
     args = ap.parse_args(argv)
-    summary = summarize(args.paths)
+    summary = summarize(args.paths, split_step=args.split_step)
     if args.json:
         json.dump(summary, sys.stdout, indent=2)
         print()
