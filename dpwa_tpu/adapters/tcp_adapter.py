@@ -22,7 +22,7 @@ from dpwa_tpu.metrics import MetricsLogger
 from dpwa_tpu.parallel.tcp import TcpTransport
 from dpwa_tpu.recovery.guard import RollbackRing, validate_payload
 from dpwa_tpu.recovery.state_transfer import pack_state
-from dpwa_tpu.utils.pytree import ravel
+from dpwa_tpu.utils.pytree import leaf_sizes, ravel
 
 PyTree = Any
 
@@ -70,6 +70,9 @@ class DpwaTcpAdapter:
         self.transport = TcpTransport(self.config, name)
         flat, self._unravel = ravel(params)
         self._vec = np.asarray(flat, dtype=np.float32)
+        # The trust plane's per-leaf screening statistic follows the real
+        # parameter boundaries of this adapter's pytree.
+        self.transport.set_trust_leaves(leaf_sizes(params))
         self._clock = 0.0
         self._step = 0
         self._last_loss = 0.0
@@ -225,6 +228,11 @@ class DpwaTcpAdapter:
         for ev in self.transport.pop_membership_events():
             fields = dict(ev)
             self._event(fields.pop("event"), **fields)
+        # Trust plane: surface collapse/recovery/clock-reset events the
+        # same way (tools/health_report.py --trust folds them).
+        for ev in self.transport.pop_trust_events():
+            fields = dict(ev)
+            self._event(fields.pop("event"), **fields)
         heal = self.transport.pop_heal_advice()
         if (
             heal is not None
@@ -234,6 +242,12 @@ class DpwaTcpAdapter:
             self._reconcile_heal(heal)
         if self.metrics is not None:
             info = self.transport.last_round
+            extra = {}
+            if "trust" in info:
+                # Per-exchange trust columns (absent when the trust
+                # plane is off, keeping pre-trust records identical).
+                extra["trust_verdict"] = info["trust"].get("verdict")
+                extra["trust_scale"] = info["trust"].get("alpha_scale")
             self.metrics.log(
                 step,
                 loss=loss,
@@ -242,6 +256,7 @@ class DpwaTcpAdapter:
                 partner=info.get("partner"),
                 remapped=info.get("remapped"),
                 outcome=info.get("outcome"),
+                **extra,
             )
             if step % self._health_every == 0:
                 self.metrics.log_health(
@@ -309,7 +324,10 @@ class DpwaTcpAdapter:
             )
             return
         remote_loss = float(meta.get("loss", 0.0))
-        reason = validate_payload(remote_vec, remote_loss, self._recovery)
+        reason = validate_payload(
+            remote_vec, remote_loss, self._recovery,
+            local_norm=float(np.linalg.norm(self._vec.astype(np.float64))),
+        )
         if reason is not None:
             self._event(
                 "partition_reconcile_rejected", donor=donor, reason=reason

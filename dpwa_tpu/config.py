@@ -64,10 +64,23 @@ Schema::
                                 #   partition_len_rounds splits the ring
                                 #   into two drawn groups at this rate
       partition_len_rounds: 8
+      byzantine_peers: []       # peers eligible for byzantine injection
+                                #   ([] = all peers)
+      byzantine_start_round: 0  # rounds before this serve honestly
+      byzantine_sign_probability: 0.0   # serve the sign-flipped replica
+      byzantine_scale_probability: 0.0  # serve a scaled replica (finite,
+                                #   below recovery.max_param_norm)
+      byzantine_scale_factor: 100.0
+      byzantine_replay_probability: 0.0 # re-serve an old own snapshot
+      byzantine_replay_age: 8   # how many rounds stale the replay is
+      byzantine_zero_probability: 0.0   # serve an all-zero replica
     recovery:                   # crash recovery & divergence guard
       enabled: true             # peer bootstrap serving + payload guard
       max_param_norm: 1.0e12    # reject/roll back when ||vec||_2 exceeds
       max_loss: 1.0e9           # reject/roll back when |loss| exceeds
+      min_param_norm_ratio: 1.0e-4  # reject a remote whose norm is below
+                                #   this fraction of the local norm
+                                #   (zero-energy payload; 0 = off)
       snapshot_every: 1         # push a last-good ring snapshot every k
                                 #   healthy steps
       snapshot_ring: 4          # in-memory last-good snapshots kept
@@ -94,6 +107,27 @@ Schema::
       reconcile_min_fraction: 0.3  # reconcile only when the returning
                                 #   component is at least this fraction
       max_heal_weight: 0.75     # clamp on the returning side's merge weight
+    trust:                      # content-trust plane (docs/trust.md)
+      enabled: true             # screen every decoded REMOTE payload
+      window: 32                # median/MAD window of accepted exchanges
+      min_window: 8             # screening arms once this many accepted
+                                #   exchanges exist (cold-start guard)
+      mad_multiplier: 8.0       # robust z beyond this -> suspect (damped)
+      reject_multiplier: 24.0   # robust z beyond this -> rejected
+      damping: 1.0              # alpha *= trust ** damping for suspects
+      ewma_half_life: 4.0       # clean exchanges to halve trust deficit
+      suspect_decay: 0.7        # trust *= this per suspect verdict
+      reject_decay: 0.25        # trust *= this per rejected verdict
+      quarantine_trust: 0.15    # below this, feed 'untrusted' probes to
+                                #   the scoreboard until quarantine
+      cosine_floor: -0.5        # hard bound: reject anti-aligned payloads
+      norm_ratio_max: 64.0      # hard bound: reject scale blow-ups
+      replay_slack: 0.5         # clock may run backward by this much
+                                #   before a payload counts as a replay
+      amnesty_gap: 4            # a peer unscreened for amnesty_gap *
+                                #   (n_peers - 1) rounds is re-acquainted
+      amnesty_rounds: 8         # ...leniently for this many rounds
+                                #   (rejects downgrade to damped suspects)
 """
 
 from __future__ import annotations
@@ -292,6 +326,20 @@ class ChaosConfig:
     # per-peer group assignment drawn per block (chaos_draw kinds 5/6).
     partition_probability: float = 0.0
     partition_len_rounds: int = 8
+    # Byzantine (content) faults: the served payload is mutated so it
+    # stays a VALID wire frame — header, CRC-equivalent structure, and
+    # trailer untouched — and only the vector content lies.  Exercises
+    # the trust plane end-to-end (dpwa_tpu/trust/, docs/trust.md).
+    # ``byzantine_peers`` restricts which peers attack (() = all are
+    # eligible); draws stay per (seed, round, peer) threefry streams.
+    byzantine_peers: tuple[int, ...] = ()
+    byzantine_start_round: int = 0
+    byzantine_sign_probability: float = 0.0
+    byzantine_scale_probability: float = 0.0
+    byzantine_scale_factor: float = 100.0
+    byzantine_replay_probability: float = 0.0
+    byzantine_replay_age: int = 8
+    byzantine_zero_probability: float = 0.0
 
     def __post_init__(self) -> None:
         for name in (
@@ -301,10 +349,33 @@ class ChaosConfig:
             "truncate_probability",
             "corrupt_probability",
             "partition_probability",
+            "byzantine_sign_probability",
+            "byzantine_scale_probability",
+            "byzantine_replay_probability",
+            "byzantine_zero_probability",
         ):
             v = getattr(self, name)
             if not 0.0 <= v <= 1.0:
                 raise ValueError(f"{name} must be in [0, 1], got {v}")
+        if self.byzantine_scale_factor <= 0:
+            raise ValueError(
+                f"byzantine_scale_factor must be > 0, "
+                f"got {self.byzantine_scale_factor}"
+            )
+        if self.byzantine_replay_age < 1:
+            raise ValueError(
+                f"byzantine_replay_age must be >= 1, "
+                f"got {self.byzantine_replay_age}"
+            )
+        if self.byzantine_start_round < 0:
+            raise ValueError(
+                f"byzantine_start_round must be >= 0, "
+                f"got {self.byzantine_start_round}"
+            )
+        byz = tuple(int(p) for p in self.byzantine_peers)
+        if any(p < 0 for p in byz):
+            raise ValueError(f"bad byzantine_peers entry in {byz!r}")
+        object.__setattr__(self, "byzantine_peers", byz)
         if self.delay_ms < 0:
             raise ValueError(f"delay_ms must be >= 0, got {self.delay_ms}")
         if self.throttle_bytes_per_s <= 0:
@@ -372,6 +443,12 @@ class RecoveryConfig:
     enabled: bool = True
     max_param_norm: float = 1e12
     max_loss: float = 1e9
+    # Zero-energy floor: reject a remote whose L2 norm falls below this
+    # fraction of the LOCAL norm (a half-bootstrapped or byzantine peer
+    # serving zeros would otherwise drag honest weights toward zero at
+    # alpha-speed).  0 disables; only enforced when the caller knows its
+    # own norm, so bare fetches without local context are unaffected.
+    min_param_norm_ratio: float = 1e-4
     snapshot_every: int = 1
     snapshot_ring: int = 4
     state_chunk_bytes: int = 1 << 20
@@ -411,6 +488,11 @@ class RecoveryConfig:
         if self.max_clock_lag <= 0:
             raise ValueError(
                 f"max_clock_lag must be > 0, got {self.max_clock_lag}"
+            )
+        if not 0.0 <= self.min_param_norm_ratio < 1.0:
+            raise ValueError(
+                f"min_param_norm_ratio must be in [0, 1), "
+                f"got {self.min_param_norm_ratio}"
             )
 
 
@@ -494,6 +576,110 @@ class MembershipConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class TrustConfig:
+    """``trust:`` block — the content-trust plane's knobs (docs/trust.md).
+
+    Screening defaults ON but conservative: classification only arms
+    after ``min_window`` accepted exchanges (a cold ring has no baseline
+    to deviate from), the MAD multipliers are wide (8σ suspect / 24σ
+    reject — honest heterogeneity across data shards sits well inside),
+    and a fully-trusted peer's alpha scale snaps to exactly 1.0, so an
+    honest ring's trajectory is bit-identical to a trust-disabled run.
+    Applies to the TCP transport (the path with per-peer payloads to
+    screen); needs ``health.enabled`` for the quarantine feedback."""
+
+    enabled: bool = True
+    # Median/MAD window over ACCEPTED exchanges.  Larger = slower to
+    # adapt to genuine regime changes, harder to poison; must comfortably
+    # exceed min_window.
+    window: int = 32
+    min_window: int = 8
+    # Robust z-score thresholds: [mad_multiplier, reject_multiplier) is
+    # the damped band, beyond reject_multiplier the payload never merges.
+    mad_multiplier: float = 8.0
+    reject_multiplier: float = 24.0
+    # Suspect merges at alpha * trust**damping; higher = harsher damping
+    # for partially-trusted peers (1.0 = linear in trust).
+    damping: float = 1.0
+    # Trust EWMA: clean exchanges halve the trust DEFICIT every
+    # ewma_half_life exchanges; verdict decays multiply trust down.
+    ewma_half_life: float = 4.0
+    suspect_decay: float = 0.7
+    reject_decay: float = 0.25
+    # Below this trust, every screening feeds an ``untrusted`` probe to
+    # the scoreboard — a persistently-suspect peer quarantines even if no
+    # single payload is outright rejected.
+    quarantine_trust: float = 0.15
+    # Hard bounds, active once armed, that no drifted baseline excuses:
+    # a sign-flip lands at cosine -1; a scale blow-up below the recovery
+    # guard's explosion bound still trips the norm ratio.
+    cosine_floor: float = -0.5
+    norm_ratio_max: float = 64.0
+    # Replay detection: a peer's publish clock may run backward by this
+    # much (re-serving last round's payload is normal overlap) before the
+    # payload counts as a stale replay.
+    replay_slack: float = 0.5
+    # Re-acquaintance amnesty: a peer unscreened for more than
+    # ``amnesty_gap * (n_peers - 1)`` rounds (partition, quarantine,
+    # crash-rejoin — its replica has legitimately diverged) gets
+    # ``amnesty_rounds`` lenient screenings in which hard rejections
+    # downgrade to damped suspects and a stale clock resets the replay
+    # base instead of rejecting.  0 on either knob disables amnesty.
+    amnesty_gap: int = 4
+    amnesty_rounds: int = 8
+
+    def __post_init__(self) -> None:
+        if self.window < 2:
+            raise ValueError(f"window must be >= 2, got {self.window}")
+        if not 1 <= self.min_window <= self.window:
+            raise ValueError(
+                f"min_window must be in [1, window], got {self.min_window}"
+            )
+        if self.mad_multiplier <= 0:
+            raise ValueError(
+                f"mad_multiplier must be > 0, got {self.mad_multiplier}"
+            )
+        if self.reject_multiplier < self.mad_multiplier:
+            raise ValueError(
+                "reject_multiplier must be >= mad_multiplier, "
+                f"got {self.reject_multiplier} < {self.mad_multiplier}"
+            )
+        if self.damping <= 0:
+            raise ValueError(f"damping must be > 0, got {self.damping}")
+        if self.ewma_half_life <= 0:
+            raise ValueError(
+                f"ewma_half_life must be > 0, got {self.ewma_half_life}"
+            )
+        for name in ("suspect_decay", "reject_decay"):
+            v = getattr(self, name)
+            if not 0.0 <= v < 1.0:
+                raise ValueError(f"{name} must be in [0, 1), got {v}")
+        if not 0.0 < self.quarantine_trust < 1.0:
+            raise ValueError(
+                f"quarantine_trust must be in (0, 1), "
+                f"got {self.quarantine_trust}"
+            )
+        if not -1.0 <= self.cosine_floor <= 1.0:
+            raise ValueError(
+                f"cosine_floor must be in [-1, 1], got {self.cosine_floor}"
+            )
+        if self.norm_ratio_max <= 1.0:
+            raise ValueError(
+                f"norm_ratio_max must be > 1, got {self.norm_ratio_max}"
+            )
+        if self.replay_slack < 0:
+            raise ValueError(
+                f"replay_slack must be >= 0, got {self.replay_slack}"
+            )
+        for name in ("amnesty_gap", "amnesty_rounds"):
+            v = getattr(self, name)
+            if not isinstance(v, int) or v < 0:
+                raise ValueError(
+                    f"{name} must be a non-negative int, got {v!r}"
+                )
+
+
+@dataclasses.dataclass(frozen=True)
 class InterpolationConfig:
     type: str = "constant"
     factor: float = 0.5
@@ -514,6 +700,7 @@ class DpwaConfig:
     chaos: ChaosConfig = ChaosConfig()
     recovery: RecoveryConfig = RecoveryConfig()
     membership: MembershipConfig = MembershipConfig()
+    trust: TrustConfig = TrustConfig()
 
     @property
     def n_peers(self) -> int:
@@ -571,7 +758,11 @@ def config_from_dict(raw: Mapping[str, Any]) -> DpwaConfig:
     chaos = dict(raw.get("chaos") or {})
     recovery = dict(raw.get("recovery") or {})
     membership = dict(raw.get("membership") or {})
-    for key in ("down_windows", "partition_windows", "link_windows"):
+    trust = dict(raw.get("trust") or {})
+    for key in (
+        "down_windows", "partition_windows", "link_windows",
+        "byzantine_peers",
+    ):
         if chaos.get(key) is not None:
             chaos[key] = tuple(chaos[key])
     return DpwaConfig(
@@ -582,6 +773,7 @@ def config_from_dict(raw: Mapping[str, Any]) -> DpwaConfig:
         chaos=ChaosConfig(**chaos),
         recovery=RecoveryConfig(**recovery),
         membership=MembershipConfig(**membership),
+        trust=TrustConfig(**trust),
     )
 
 
@@ -607,12 +799,13 @@ def make_local_config(
     chaos: "ChaosConfig | Mapping[str, Any] | None" = None,
     recovery: "RecoveryConfig | Mapping[str, Any] | None" = None,
     membership: "MembershipConfig | Mapping[str, Any] | None" = None,
+    trust: "TrustConfig | Mapping[str, Any] | None" = None,
     **protocol_kwargs: Any,
 ) -> DpwaConfig:
     """Programmatic config for tests/benchmarks: n local peers on 127.0.0.1.
 
-    ``health`` / ``chaos`` / ``recovery`` / ``membership`` accept a
-    config object or a plain dict (the YAML-block shorthand)."""
+    ``health`` / ``chaos`` / ``recovery`` / ``membership`` / ``trust``
+    accept a config object or a plain dict (the YAML-block shorthand)."""
     if isinstance(health, Mapping):
         health = HealthConfig(**health)
     if isinstance(chaos, Mapping):
@@ -621,6 +814,8 @@ def make_local_config(
         recovery = RecoveryConfig(**recovery)
     if isinstance(membership, Mapping):
         membership = MembershipConfig(**membership)
+    if isinstance(trust, Mapping):
+        trust = TrustConfig(**trust)
     return DpwaConfig(
         nodes=tuple(
             NodeSpec(name=f"node{i}", host="127.0.0.1", port=base_port + i)
@@ -637,4 +832,5 @@ def make_local_config(
         chaos=chaos if chaos is not None else ChaosConfig(),
         recovery=recovery if recovery is not None else RecoveryConfig(),
         membership=membership if membership is not None else MembershipConfig(),
+        trust=trust if trust is not None else TrustConfig(),
     )

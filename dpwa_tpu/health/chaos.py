@@ -19,6 +19,14 @@ seed replays the identical fault schedule run after run):
 - **truncate** — cut the frame mid-payload (short read on the fetcher);
 - **corrupt** — flip the frame's magic bytes (malformed-header path).
 
+Plus **byzantine content faults** (kinds 7–10, drawn independently of
+the wire faults so a peer can lie about content AND be slow): the served
+frame stays perfectly wire-valid but its vector content lies — sign-flip,
+scale blow-up below the recovery guard's explosion bound, stale replay of
+the peer's own old frame, zero-energy payloads.  Applied on the SERVING
+side so the fetcher exercises its full wire + decode + screening path
+(:mod:`dpwa_tpu.trust`); see :func:`byzantine_frame`.
+
 Plus **down windows**: hard intervals ``[start, stop)`` of gossip rounds
 during which a peer serves nothing at all — the 'process died, later
 came back' scenario that the quarantine → backoff → probe → re-admission
@@ -36,7 +44,10 @@ from __future__ import annotations
 import dataclasses
 import threading
 import time
-from typing import Optional
+from collections import deque
+from typing import Deque, Optional, Tuple
+
+import numpy as np
 
 from dpwa_tpu.config import ChaosConfig
 from dpwa_tpu.parallel.schedules import chaos_draw
@@ -51,6 +62,12 @@ _KIND_CORRUPT = 4
 # once per block, peer key 0); kind 6 assigns each peer a side.
 _KIND_PARTITION = 5
 _KIND_PARTITION_SIDE = 6
+# Byzantine content faults (served frame stays wire-valid; only the
+# vector content lies — see byzantine_frame).
+_KIND_BYZ_SIGN = 7
+_KIND_BYZ_SCALE = 8
+_KIND_BYZ_REPLAY = 9
+_KIND_BYZ_ZERO = 10
 # Priority order when several draws fire in one round: exactly one fault
 # kind applies per (round, peer) so injected behavior stays analyzable.
 _PRIORITY = (
@@ -59,6 +76,15 @@ _PRIORITY = (
     ("corrupt", _KIND_CORRUPT, "corrupt_probability"),
     ("throttle", _KIND_THROTTLE, "throttle_probability"),
     ("delay", _KIND_DELAY, "delay_probability"),
+)
+# Byzantine draws are independent of the wire-fault draws (different
+# tags), so content attacks compose with — and are distinguishable
+# from — transport faults in a soak.
+_BYZ_PRIORITY = (
+    ("sign", _KIND_BYZ_SIGN, "byzantine_sign_probability"),
+    ("scale", _KIND_BYZ_SCALE, "byzantine_scale_probability"),
+    ("replay", _KIND_BYZ_REPLAY, "byzantine_replay_probability"),
+    ("zero", _KIND_BYZ_ZERO, "byzantine_zero_probability"),
 )
 
 
@@ -69,10 +95,16 @@ class FaultPlan:
     kind: str = "none"  # none | down | drop | delay | throttle | truncate | corrupt
     delay_s: float = 0.0
     throttle_bps: float = 0.0
+    # Byzantine content fault, drawn independently of ``kind`` (a peer
+    # can lie about content AND be slow): none | sign | scale | replay
+    # | zero.
+    byzantine: str = "none"
+    byz_scale: float = 0.0
+    byz_replay_age: int = 0
 
     @property
     def faulty(self) -> bool:
-        return self.kind != "none"
+        return self.kind != "none" or self.byzantine != "none"
 
 
 class ChaosEngine:
@@ -139,18 +171,33 @@ class ChaosEngine:
             if cached is not None:
                 return cached
         cfg = self.config
-        plan = FaultPlan()
+        wire_kind = "none"
         for kind, tag, prob_field in _PRIORITY:
             prob = getattr(cfg, prob_field)
             if prob <= 0.0:
                 continue
             if chaos_draw(cfg.seed, round, self.peer, tag) < prob:
-                plan = FaultPlan(
-                    kind=kind,
-                    delay_s=cfg.delay_ms / 1000.0,
-                    throttle_bps=cfg.throttle_bytes_per_s,
-                )
+                wire_kind = kind
                 break
+        byz = "none"
+        if round >= cfg.byzantine_start_round and (
+            not cfg.byzantine_peers or self.peer in cfg.byzantine_peers
+        ):
+            for kind, tag, prob_field in _BYZ_PRIORITY:
+                prob = getattr(cfg, prob_field)
+                if prob <= 0.0:
+                    continue
+                if chaos_draw(cfg.seed, round, self.peer, tag) < prob:
+                    byz = kind
+                    break
+        plan = FaultPlan(
+            kind=wire_kind,
+            delay_s=cfg.delay_ms / 1000.0,
+            throttle_bps=cfg.throttle_bytes_per_s,
+            byzantine=byz,
+            byz_scale=cfg.byzantine_scale_factor,
+            byz_replay_age=cfg.byzantine_replay_age,
+        )
         with self._lock:
             if len(self._cache) > 64:  # bound memory on long soaks
                 self._cache.clear()
@@ -182,6 +229,47 @@ def mutate_frame(payload: bytes, kind: str) -> Optional[bytes]:
     return payload
 
 
+def byzantine_frame(
+    payload: bytes, kind: str, scale: float = 100.0
+) -> bytes:
+    """Mutate a gossip frame's VECTOR CONTENT while keeping the frame
+    wire-valid — header (magic, version, dtype, clock, loss, nbytes) and
+    any membership-digest trailer untouched, so every parser on the
+    fetch path accepts it and only the trust plane can object.
+
+    ``kind``: ``sign`` multiplies the vector by −1, ``zero`` by 0,
+    ``scale`` by ``scale`` (chosen to stay far below the recovery
+    guard's ``max_param_norm`` explosion bound — the attack the guard
+    canNOT see).  The int8-chunked payload is mutated via its per-chunk
+    f32 scales — multiplying the scales exactly multiplies the DECODED
+    vector, proving screening runs after dequantization.  u2 (raw-bits)
+    payloads are served unchanged (no meaningful linear mutation of a
+    bit pattern)."""
+    from dpwa_tpu.ops.quantize import _n_chunks
+    from dpwa_tpu.parallel.tcp import _DTYPES, _HDR, _INT8_CHUNKED
+
+    factor = {"sign": -1.0, "zero": 0.0}.get(kind, float(scale))
+    magic, version, code, clock, loss, nbytes = _HDR.unpack_from(payload, 0)
+    body = payload[_HDR.size : _HDR.size + nbytes]
+    trailer = payload[_HDR.size + nbytes :]
+    if code == _INT8_CHUNKED:
+        if len(body) < 8:
+            return payload
+        n = int(np.frombuffer(body[:8], "<u8")[0])
+        k = _n_chunks(n)
+        scales = np.frombuffer(body[8 : 8 + 4 * k], "<f4") * np.float32(
+            factor
+        )
+        body = body[:8] + scales.astype("<f4").tobytes() + body[8 + 4 * k :]
+    else:
+        dt = _DTYPES.get(code)
+        if dt is None or code == 2:  # u2 raw-bits: leave unchanged
+            return payload
+        vec = np.frombuffer(body, dt).astype(np.float64) * factor
+        body = vec.astype(dt).tobytes()
+    return payload[: _HDR.size] + body + trailer
+
+
 class ChaosPeerServer:
     """A :class:`~dpwa_tpu.parallel.tcp.PeerServer` that injects the
     engine's fault plan into every served connection.
@@ -195,6 +283,10 @@ class ChaosPeerServer:
 
         self.engine = engine
         self._round = 0
+        # Framed payloads by publish round, for byzantine stale-replay:
+        # the attacker re-serves its own old frame (old clock AND old
+        # weights), exactly what a stuck or malicious peer would emit.
+        self._history: Deque[Tuple[int, bytes]] = deque(maxlen=64)
         outer = self
 
         class _Server(_tcp.PeerServer):
@@ -217,6 +309,10 @@ class ChaosPeerServer:
         # publish clock = step, pinning faults to gossip rounds.
         self._round = int(clock)
         self._srv.publish(vec, clock, loss, code, digest)
+        with self._srv._lock:
+            framed = self._srv._payload
+        if framed is not None:
+            self._history.append((self._round, framed))
 
     def publish_state(self, blob: bytes) -> None:
         self._srv.publish_state(blob)
@@ -255,6 +351,15 @@ class ChaosPeerServer:
             payload = srv._payload
         if payload is None:
             return
+        # Byzantine content mutation FIRST, wire faults second: a
+        # byzantine peer serves a lying-but-valid frame, and that frame
+        # can then still be delayed/throttled/truncated like any other.
+        if plan.byzantine == "replay":
+            payload = self._replay_frame(payload, plan.byz_replay_age)
+        elif plan.byzantine != "none":
+            payload = byzantine_frame(
+                payload, plan.byzantine, plan.byz_scale
+            )
         if plan.kind == "delay":
             time.sleep(plan.delay_s)
             conn.sendall(payload)
@@ -269,6 +374,18 @@ class ChaosPeerServer:
         mutated = mutate_frame(payload, plan.kind)
         if mutated is not None:
             conn.sendall(mutated)
+
+    def _replay_frame(self, current: bytes, age: int) -> bytes:
+        """The newest banked frame at least ``age`` rounds stale (falling
+        back to the oldest banked frame that is stale at all, else the
+        current frame — replay needs history to lie with)."""
+        stale = [
+            f for r, f in self._history if r <= self._round - age
+        ]
+        if stale:
+            return stale[-1]
+        older = [f for r, f in self._history if r < self._round]
+        return older[0] if older else current
 
     def close(self) -> None:
         self._srv.close()

@@ -48,8 +48,12 @@ class Outcome:
     POISONED = "poisoned"  # frame decoded fine but failed the recovery
     #   guard: non-finite values, exploded norm, or an insane loss
     #   (dpwa_tpu.recovery.guard) — the peer is up but its replica is sick
+    UNTRUSTED = "untrusted"  # frame decoded fine, passed the recovery
+    #   guard, but failed trust screening (dpwa_tpu.trust): statistically
+    #   anomalous vs. the accepted-exchange baseline, anti-aligned, or a
+    #   stale replay — finite byzantine content the guard cannot see
 
-    FAILURES = (TIMEOUT, REFUSED, SHORT_READ, CORRUPT, POISONED)
+    FAILURES = (TIMEOUT, REFUSED, SHORT_READ, CORRUPT, POISONED, UNTRUSTED)
     ALL = (SUCCESS,) + FAILURES
 
 
@@ -60,13 +64,16 @@ class Outcome:
 # on the other side — and weighs slightly more; a timeout is the
 # weakest signal (the network, not the peer, may be at fault).  A
 # poisoned payload (clean frame, sick contents) is as damning as a
-# corrupt one: merging it would actively damage the local replica.
+# corrupt one: merging it would actively damage the local replica; an
+# untrusted payload (finite but byzantine content) is the same class of
+# harm, caught one layer later.
 DEFAULT_FAILURE_WEIGHTS: Mapping[str, float] = {
     Outcome.TIMEOUT: 1.0,
     Outcome.REFUSED: 1.0,
     Outcome.SHORT_READ: 1.0,
     Outcome.CORRUPT: 1.5,
     Outcome.POISONED: 1.5,
+    Outcome.UNTRUSTED: 1.5,
 }
 
 

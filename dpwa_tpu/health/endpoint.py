@@ -13,8 +13,11 @@ peers).  Enabled via ``health.healthz_port`` in the YAML config
 
 ``/membership`` serves just the snapshot's membership sub-document
 (incarnation, component, partition state — present when the epidemic
-membership plane is enabled); every other path gets the full snapshot —
-the endpoint is a liveness/introspection hook, not a general router."""
+membership plane is enabled) and ``/trust`` the trust sub-document
+(per-peer trust scores, verdicts, baseline fill — present when the
+content-trust plane is enabled); every other path gets the full
+snapshot — the endpoint is a liveness/introspection hook, not a
+general router."""
 
 from __future__ import annotations
 
@@ -68,9 +71,14 @@ class HealthzServer:
                     pass
                 try:
                     doc = self._snapshot_fn()
-                    if b" /membership" in raw.split(b"\r\n", 1)[0]:
+                    request_line = raw.split(b"\r\n", 1)[0]
+                    if b" /membership" in request_line:
                         doc = doc.get("membership") or {
                             "error": "membership disabled"
+                        }
+                    elif b" /trust" in request_line:
+                        doc = doc.get("trust") or {
+                            "error": "trust disabled"
                         }
                     body = json.dumps(doc).encode()
                 except Exception:  # snapshot must never kill the endpoint

@@ -81,7 +81,9 @@ def loss_weighted(factor: float = 1.0) -> Interpolation:
 
 
 def _clamped(
-    strategy: Interpolation, max_abs_loss: float | None = None
+    strategy: Interpolation,
+    max_abs_loss: float | None = None,
+    trust_scale: Callable[[], float] | None = None,
 ) -> Interpolation:
     """Restrict α to [0, 1] so the merge is always an interpolation.
 
@@ -108,7 +110,14 @@ def _clamped(
     clipped path (e.g. ``loss_weighted``'s ratio capped at
     ``min(factor, 1)``) and never got the full α = 1 rescue its state
     needs.  With no bound configured, finite-but-huge keeps the ordinary
-    path — only actually-poisoned metadata rescues."""
+    path — only actually-poisoned metadata rescues.
+
+    ``trust_scale`` — the content-trust plane's merge damping
+    (:meth:`dpwa_tpu.trust.TrustManager.alpha_scale`, threaded by the
+    TCP transport as a zero-arg callable so the CURRENT exchange's
+    verdict applies).  A fully-trusted peer reports exactly 1.0, a
+    bit-exact no-op; a suspect peer's alpha shrinks with its trust, so a
+    damped merge is still an interpolation, just a shy one."""
 
     def alpha(local: PeerMeta, remote: PeerMeta) -> jnp.ndarray:
         a = strategy(local, remote)
@@ -120,13 +129,18 @@ def _clamped(
             remote_ok = remote_ok & (jnp.abs(remote.loss) <= bound)
         rescue = jnp.where(~local_ok & remote_ok, 1.0, 0.0)
         a = jnp.where(jnp.isfinite(a) & local_ok, a, rescue)
-        return jnp.clip(a, 0.0, 1.0)
+        a = jnp.clip(a, 0.0, 1.0)
+        if trust_scale is not None:
+            a = a * jnp.clip(jnp.float32(trust_scale()), 0.0, 1.0)
+        return a
 
     return alpha
 
 
 def make_interpolation(
-    config: InterpolationConfig, max_abs_loss: float | None = None
+    config: InterpolationConfig,
+    max_abs_loss: float | None = None,
+    trust_scale: Callable[[], float] | None = None,
 ) -> Interpolation:
     """Factory from the YAML ``interpolation:`` section.
 
@@ -134,11 +148,16 @@ def make_interpolation(
     ``max_abs_loss`` — normally ``recovery.max_loss``, passed by the
     transports when recovery is enabled — additionally treats a
     finite-but-huge local loss as sick metadata deserving the full α = 1
-    rescue."""
+    rescue.  ``trust_scale`` — the trust plane's per-exchange merge
+    damping, multiplied in after the clamp (see ``_clamped``)."""
     if config.type == "constant":
-        return _clamped(constant(config.factor), max_abs_loss)
+        return _clamped(constant(config.factor), max_abs_loss, trust_scale)
     if config.type == "clock":
-        return _clamped(clock_weighted(config.factor), max_abs_loss)
+        return _clamped(
+            clock_weighted(config.factor), max_abs_loss, trust_scale
+        )
     if config.type == "loss":
-        return _clamped(loss_weighted(config.factor), max_abs_loss)
+        return _clamped(
+            loss_weighted(config.factor), max_abs_loss, trust_scale
+        )
     raise ValueError(f"unknown interpolation type {config.type!r}")

@@ -143,6 +143,9 @@ class MetricsLogger:
         - ``peer_state`` — scoreboard state per remote peer;
         - ``suspicion`` — detector suspicion score per remote peer;
         - ``quarantined_rounds`` — lifetime rounds spent quarantined;
+        - ``trust`` / ``trust_damped`` / ``trust_rejected`` — the trust
+          plane's per-peer EWMA and verdict counters (present only when
+          the content-trust plane contributed to the snapshot);
 
         plus attempt/success/quarantine counters.  Obeys ``every`` like
         every other record; written immediately (health snapshots are
@@ -165,6 +168,16 @@ class MetricsLogger:
                 component=membership.get("component"),
                 component_id=membership.get("component_id"),
                 partition_state=membership.get("partition_state"),
+            )
+        if order and "trust" in peers[order[0]]:
+            # Trust columns ride the same record (absent without the
+            # trust plane, keeping pre-trust records byte-identical).
+            extra = dict(
+                extra,
+                trust=cols("trust"),
+                trust_verdict=cols("trust_verdict"),
+                trust_damped=cols("trust_damped"),
+                trust_rejected=cols("trust_rejected"),
             )
         self.log(
             step,

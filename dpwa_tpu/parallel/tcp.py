@@ -994,10 +994,34 @@ class TcpTransport:
         self.config = config
         self.me = config.node_index(name)
         self.schedule: Schedule = build_schedule(config)
+        # Content-trust plane (dpwa_tpu/trust/): screens every decoded
+        # REMOTE payload and damps/rejects the merge.  Deferred import —
+        # trust pulls in the screening jit machinery this module must
+        # not require at import time.
+        self.trust = None
+        if config.trust.enabled:
+            from dpwa_tpu.trust.manager import TrustManager
+
+            self.trust = TrustManager(
+                len(config.nodes), self.me, config.trust
+            )
+        # The CURRENT exchange's trust damping, read by the interpolation
+        # through a zero-arg callable: fetch() writes it (fetch thread in
+        # the overlapped path), _weigh_remote reads it AFTER the fetch is
+        # joined, so the handoff is ordered.  1.0 (fully trusted) is a
+        # bit-exact no-op on alpha.
+        self._pending_trust_scale = 1.0
+        # Local replica view for screening + the zero-energy guard:
+        # stashed by publish() (publish always precedes fetch in a round).
+        self._local_vec: Optional[np.ndarray] = None
+        self._local_norm: Optional[float] = None
         self.interp = make_interpolation(
             config.interpolation,
             max_abs_loss=(
                 config.recovery.max_loss if config.recovery.enabled else None
+            ),
+            trust_scale=(
+                self._trust_alpha_scale if self.trust is not None else None
             ),
         )
         self._wire_bf16 = config.protocol.wire_dtype == "bf16"
@@ -1053,6 +1077,11 @@ class TcpTransport:
                 len(config.nodes), self.me, self.scoreboard,
                 config.membership, seed=self.schedule.seed,
             )
+        if self.trust is not None and self.scoreboard is not None:
+            # Collapsed trust feeds the scoreboard as ``untrusted``
+            # probes — the quarantine path for a persistently-suspect
+            # peer no single rejection condemns.
+            self.trust.attach_scoreboard(self.scoreboard)
         self.healthz = None
         if config.health.enabled and config.health.healthz_port is not None:
             from dpwa_tpu.health.endpoint import HealthzServer
@@ -1102,6 +1131,20 @@ class TcpTransport:
         # with stochastic rounding keyed on (seed, clock, me) and
         # dequantized by the FETCHING side (ops/quantize.py).
         self._last_clock = float(clock)
+        if (
+            self.trust is not None
+            or (
+                self.config.recovery.enabled
+                and self.config.recovery.min_param_norm_ratio > 0.0
+            )
+        ) and vec.dtype in (np.float32, np.float64):
+            # Stash the f32 replica this round merges against: trust
+            # screening and the zero-energy guard both compare the
+            # incoming payload to what we just published.
+            self._local_vec = np.ascontiguousarray(vec, dtype=np.float32)
+            self._local_norm = float(
+                np.linalg.norm(self._local_vec.astype(np.float64))
+            )
         # Epidemic piggyback: the current membership digest rides every
         # published frame as the optional trailer (_frame docstring).
         digest = (
@@ -1158,17 +1201,47 @@ class TcpTransport:
             from dpwa_tpu.recovery.guard import validate_payload
 
             reason = validate_payload(
-                got[0], got[2], self.config.recovery
+                got[0], got[2], self.config.recovery,
+                local_norm=self._local_norm,
             )
             if reason is not None:
                 got = None
                 outcome = Outcome.POISONED
+        trust_info = None
+        self._pending_trust_scale = 1.0
+        if (
+            got is not None
+            and self.trust is not None
+            and self._local_vec is not None
+        ):
+            # Trust screening runs on the DECODED f32 vector (the int8
+            # wire path dequantized inside fetch_blob_full, bf16 casts
+            # in payload_stats) — the payload is judged on what would
+            # actually merge.  A rejection is the ``untrusted`` outcome:
+            # recorded below exactly like ``poisoned``, and — also like
+            # poisoned — never gated behind indirect probing, since a
+            # byzantine peer answers header probes perfectly.
+            verdict, scale, tstats = self.trust.screen(
+                peer_index, got[0], got[1], self._local_vec, round=step
+            )
+            from dpwa_tpu.trust.manager import REJECTED
+
+            trust_info = dict(
+                tstats, verdict=verdict, alpha_scale=round(scale, 4)
+            )
+            if verdict == REJECTED:
+                got = None
+                outcome = Outcome.UNTRUSTED
+            else:
+                self._pending_trust_scale = scale
         self.last_fetch = {
             "peer": peer_index, "outcome": outcome,
             "latency_s": latency_s, "nbytes": nbytes,
         }
         if reason is not None:
             self.last_fetch["poison_reason"] = reason
+        if trust_info is not None:
+            self.last_fetch["trust"] = trust_info
         if self.membership is not None and digest is not None:
             self.membership.merge(digest, round=step)
         if (
@@ -1342,11 +1415,23 @@ class TcpTransport:
 
     def health_snapshot(self) -> dict:
         """JSON-ready per-peer health state (scoreboard + detector
-        EWMAs); the payload behind metrics' ``health`` records and the
-        optional /healthz endpoint."""
+        EWMAs, plus per-peer trust columns and a top-level ``trust``
+        view when the trust plane is on); the payload behind metrics'
+        ``health`` records and the optional /healthz endpoint."""
         if self.scoreboard is None:
-            return {"me": self.me, "round": 0, "peers": {}}
-        return self.scoreboard.snapshot()
+            snap = {"me": self.me, "round": 0, "peers": {}}
+        else:
+            snap = self.scoreboard.snapshot()
+        if self.trust is not None:
+            tsnap = self.trust.snapshot()
+            for p, info in tsnap["peers"].items():
+                snap["peers"].setdefault(p, {}).update(info)
+            snap["trust"] = tsnap
+        return snap
+
+    def _trust_alpha_scale(self) -> float:
+        """The CURRENT exchange's trust damping (interpolation hook)."""
+        return self._pending_trust_scale
 
     def _wire_nbytes(self, vec: np.ndarray) -> int:
         """Bytes the published frame's PAYLOAD occupies on the wire —
@@ -1408,6 +1493,8 @@ class TcpTransport:
                 return None, 0.0, partner
             got = self.fetch(partner, step=step)
             self.last_round["outcome"] = self.last_fetch.get("outcome")
+            if "trust" in self.last_fetch:
+                self.last_round["trust"] = self.last_fetch["trust"]
             if got is None:
                 # dead/slow peer: skip, keep training
                 return None, 0.0, partner
@@ -1436,6 +1523,20 @@ class TcpTransport:
         if self.membership is None:
             return None
         return self.membership.pop_heal_advice()
+
+    def pop_trust_events(self) -> list:
+        """Drain trust events (collapse, recovery, clock resets) for the
+        metrics JSONL."""
+        if self.trust is None:
+            return []
+        return self.trust.pop_events()
+
+    def set_trust_leaves(self, sizes) -> None:
+        """Adopt the adapter pytree's leaf sizes so the per-leaf max-abs
+        screening statistic follows real parameter boundaries instead of
+        fixed segments (adapters call this once at construction)."""
+        if self.trust is not None:
+            self.trust.set_leaf_sizes(sizes)
 
     def exchange(
         self, vec: np.ndarray, clock: float, loss: float, step: int

@@ -31,13 +31,24 @@ def validate_payload(
     vec: np.ndarray,
     loss: float,
     config: RecoveryConfig,
+    local_norm: Optional[float] = None,
 ) -> Optional[str]:
     """None if ``(vec, loss)`` is a sane replica, else the violation.
 
     Violation strings (stable — they ride into metrics JSONL):
-    ``nonfinite_params`` | ``param_norm`` | ``nonfinite_loss`` |
-    ``loss_bound``.  The int8 wire path decodes to f32 before this runs;
-    bf16 payloads are checked in f32 (the merge upcasts anyway)."""
+    ``nonfinite_params`` | ``param_norm`` | ``zero_energy`` |
+    ``nonfinite_loss`` | ``loss_bound``.  The int8 wire path decodes to
+    f32 before this runs; bf16 payloads are checked in f32 (the merge
+    upcasts anyway).
+
+    ``local_norm`` — the caller's OWN replica norm, when it has one (the
+    transport and the heal reconciler do; the rollback ring validating
+    its local state passes nothing).  With it, a remote whose norm falls
+    below ``min_param_norm_ratio`` of the local norm is rejected as
+    ``zero_energy``: an all-zero (or near-zero) payload from a
+    half-bootstrapped or byzantine peer is finite and "sane" in
+    isolation, but merging it drags healthy weights toward zero at
+    alpha-speed."""
     v = np.asarray(vec)
     if v.dtype != np.float32 and v.dtype != np.float64:
         v = v.astype(np.float32)
@@ -46,6 +57,13 @@ def validate_payload(
     norm = float(np.linalg.norm(v.astype(np.float64, copy=False)))
     if norm > config.max_param_norm:
         return "param_norm"
+    if (
+        local_norm is not None
+        and local_norm > 0.0
+        and config.min_param_norm_ratio > 0.0
+        and norm < config.min_param_norm_ratio * local_norm
+    ):
+        return "zero_energy"
     l = float(loss)
     if math.isnan(l) or math.isinf(l):
         return "nonfinite_loss"

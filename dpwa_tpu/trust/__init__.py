@@ -1,0 +1,26 @@
+"""Content-trust plane: screen payload statistics, damp or reject
+suspicious merges, quarantine byzantine peers (docs/trust.md)."""
+
+from dpwa_tpu.trust.manager import (
+    REJECTED,
+    SUSPECT,
+    TRUSTED,
+    TrustManager,
+)
+from dpwa_tpu.trust.screen import (
+    BASE_STATS,
+    RobustBaseline,
+    leaf_starts_from_sizes,
+    payload_stats,
+)
+
+__all__ = [
+    "BASE_STATS",
+    "REJECTED",
+    "SUSPECT",
+    "TRUSTED",
+    "RobustBaseline",
+    "TrustManager",
+    "leaf_starts_from_sizes",
+    "payload_stats",
+]

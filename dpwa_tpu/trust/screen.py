@@ -1,0 +1,160 @@
+"""Payload statistics + robust (median/MAD) baselines for trust screening.
+
+The *sensing* half of the content-trust plane (the policy half lives in
+:mod:`dpwa_tpu.trust.manager`).  Per incoming REMOTE payload it computes
+cheap statistics of the decoded float vector against the local replica:
+
+- ``norm_ratio`` — ``‖remote‖ / ‖local‖`` (a scale attack moves this);
+- ``update_ratio`` — ``‖remote − local‖ / ‖local‖`` (how big a merge
+  step this payload implies — cross-replica updates are predictable
+  enough to screen statistically, arxiv 2004.13336);
+- ``cosine`` — direction agreement with the local replica (a sign-flip
+  lands at −1, uncorrelated garbage near 0);
+- ``leaf_ratio`` — max over tree leaves of the per-leaf max-abs ratio
+  (a single poisoned embedding table hides inside a global norm; the
+  per-leaf view catches it).  Leaf boundaries come from the adapter's
+  pytree when known (:func:`dpwa_tpu.utils.pytree.leaf_sizes` via
+  ``TcpTransport.set_trust_leaves``), else fixed ``SEGMENT``-element
+  segments stand in — the wire only ever sees the flat vector.
+
+The norm/dot reductions are jit-compiled once per shape (the same
+compile-once discipline as the transport's device lerp) and the whole
+pass is O(n) — it rides the per-fetch hot path.  The per-leaf max-abs
+uses ``np.maximum.reduceat`` because leaf boundaries are host data that
+would retrigger the jit cache per distinct pytree.
+
+:class:`RobustBaseline` keeps the running **median/MAD window over
+accepted exchanges**: robust location/scale estimators survive up to
+half the window being outliers, where a mean/std baseline is dragged by
+the very payloads it should flag.  The z-score denominator is floored
+at 5% of ``max(1, |median|)`` so a near-constant honest stream (MAD → 0
+in lock-step tests) doesn't turn harmless jitter into infinite z.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Deque, Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+_EPS = 1e-12
+# Default per-segment granularity when no pytree leaf map is known.
+SEGMENT = 4096
+# Stats the baseline screens (order is stable: it rides into metrics).
+BASE_STATS = ("update_ratio", "norm_ratio", "cosine", "leaf_ratio")
+
+# Jitted reduction kernel, cached per input shape by jax itself; built
+# lazily so this module imports without a JAX backend until first use.
+_KERNEL = []
+
+
+def _reductions(local: np.ndarray, remote: np.ndarray) -> Tuple[float, ...]:
+    """(‖local‖, ‖remote‖, local·remote, ‖remote−local‖) via one jitted
+    pass (f32 inputs, f32 accumulation — the merge itself is f32)."""
+    if not _KERNEL:
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def k(a, b):
+            d = b - a
+            return jnp.stack(
+                [
+                    jnp.sqrt(jnp.sum(a * a)),
+                    jnp.sqrt(jnp.sum(b * b)),
+                    jnp.sum(a * b),
+                    jnp.sqrt(jnp.sum(d * d)),
+                ]
+            )
+
+        _KERNEL.append(k)
+    out = np.asarray(_KERNEL[0](local, remote))
+    return tuple(float(x) for x in out)
+
+
+def _leaf_max_ratio(
+    local: np.ndarray,
+    remote: np.ndarray,
+    starts: Optional[np.ndarray],
+) -> float:
+    """Max over segments of ``max|remote_seg| / max|local_seg|``."""
+    n = local.size
+    if n == 0:
+        return 0.0
+    if starts is None or starts[-1] >= n:
+        starts = np.arange(0, n, SEGMENT)
+    la = np.maximum.reduceat(np.abs(local), starts)
+    ra = np.maximum.reduceat(np.abs(remote), starts)
+    return float(np.max(ra / (la + _EPS)))
+
+
+def payload_stats(
+    local: np.ndarray,
+    remote: np.ndarray,
+    leaf_starts: Optional[np.ndarray] = None,
+) -> Dict[str, float]:
+    """Screening statistics of a decoded remote vector vs. the local one.
+
+    Both inputs are the DECODED float replicas — the int8 wire path
+    dequantizes before this runs (fetch_blob_full), so quantized attacks
+    are screened on what would actually merge, not on wire bytes."""
+    local = np.ascontiguousarray(local, dtype=np.float32)
+    remote = np.ascontiguousarray(remote, dtype=np.float32)
+    nl, nr, dot, upd = _reductions(local, remote)
+    return {
+        "local_norm": nl,
+        "remote_norm": nr,
+        "cosine": dot / max(nl * nr, _EPS),
+        "norm_ratio": nr / max(nl, _EPS),
+        "update_ratio": upd / max(nl, _EPS),
+        "leaf_ratio": _leaf_max_ratio(local, remote, leaf_starts),
+    }
+
+
+def leaf_starts_from_sizes(
+    sizes: Sequence[int], total: int
+) -> Optional[np.ndarray]:
+    """Segment start offsets for a pytree's leaf sizes (None when the
+    sizes don't tile ``total`` — e.g. a subset-ravel vector — so the
+    caller falls back to uniform segments)."""
+    sizes = [int(s) for s in sizes if int(s) > 0]
+    if not sizes or sum(sizes) != total:
+        return None
+    return np.concatenate([[0], np.cumsum(sizes[:-1])]).astype(np.intp)
+
+
+class RobustBaseline:
+    """Median/MAD window over one statistic's accepted history."""
+
+    def __init__(self, window: int):
+        self._window: Deque[float] = deque(maxlen=max(2, int(window)))
+
+    def __len__(self) -> int:
+        return len(self._window)
+
+    def push(self, x: float) -> None:
+        self._window.append(float(x))
+
+    def zscore(self, x: float) -> float:
+        """Robust |z| of ``x`` against the window (0 when empty)."""
+        if not self._window:
+            return 0.0
+        arr = np.asarray(self._window, dtype=np.float64)
+        med = float(np.median(arr))
+        mad = float(np.median(np.abs(arr - med)))
+        # 1.4826·MAD ≈ σ under normality; the relative floor keeps a
+        # degenerate (constant) window from making any deviation infinite.
+        denom = max(1.4826 * mad, 0.05 * max(1.0, abs(med)), _EPS)
+        return abs(float(x) - med) / denom
+
+    def snapshot(self) -> Dict[str, float]:
+        if not self._window:
+            return {"n": 0}
+        arr = np.asarray(self._window, dtype=np.float64)
+        med = float(np.median(arr))
+        return {
+            "n": len(arr),
+            "median": round(med, 6),
+            "mad": round(float(np.median(np.abs(arr - med))), 6),
+        }

@@ -107,6 +107,17 @@ def subset_ravel(
     return flat, restore
 
 
+def leaf_sizes(tree: PyTree) -> Tuple[int, ...]:
+    """Per-leaf element counts in ``tree_leaves`` order — the layout of
+    the :func:`ravel` vector (``ravel_pytree`` concatenates leaves in
+    exactly this order).  The trust plane's per-leaf screening statistic
+    uses these boundaries so a poisoned embedding table is judged
+    against ITS OWN leaf, not diluted into a global norm."""
+    return tuple(
+        int(leaf.size) for leaf in jax.tree_util.tree_leaves(tree)
+    )
+
+
 def tree_size_bytes(tree: PyTree) -> int:
     """Total payload bytes of a pytree — the per-exchange wire/ICI volume."""
     return sum(

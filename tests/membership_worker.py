@@ -102,7 +102,10 @@ def main() -> int:
         ),
         membership=dict(quorum_fraction=0.5),
     )
-    params = {"w": np.zeros(args.dim, np.float32)}
+    # Nonzero start: an all-zero replica served to a drifted peer would
+    # be rejected as zero-energy by the recovery guard's norm floor.
+    # The spread assertions are relative, so the offset is harmless.
+    params = {"w": np.full(args.dim, 1.0, np.float32)}
     ad = DpwaTcpAdapter(
         params, f"node{args.index}", cfg, metrics=args.metrics,
         health_every=3,

@@ -56,7 +56,9 @@ def main() -> int:
         health=dict(jitter_rounds=2),
     )
     bootstrapped = os.environ.get("DPWA_BOOTSTRAP", "0") == "1"
-    params = {"w": np.full(args.dim, float(args.index), np.float32)}
+    # index+1: an all-zero replica (index 0) would be rejected as
+    # zero-energy by the recovery guard's norm floor.
+    params = {"w": np.full(args.dim, float(args.index + 1), np.float32)}
     ad = DpwaTcpAdapter(
         params, f"node{args.index}", cfg, metrics=args.metrics,
         health_every=5,

@@ -55,13 +55,15 @@ def _wire(adapters):
 
 def test_tcp_adapter_two_process_merge():
     cfg = make_local_config(2, base_port=0)
-    a0 = DpwaTcpAdapter({"w": jnp.zeros(4)}, "node0", cfg)
-    a1 = DpwaTcpAdapter({"w": jnp.ones(4)}, "node1", cfg)
+    # Nonzero on both sides: an all-zero replica served to a nonzero
+    # peer is now rejected as zero-energy (recovery guard).
+    a0 = DpwaTcpAdapter({"w": jnp.full(4, 0.25)}, "node0", cfg)
+    a1 = DpwaTcpAdapter({"w": jnp.full(4, 0.75)}, "node1", cfg)
     try:
         _wire([a0, a1])
         # publish happens in update(); run one lock-step round.
-        a0.transport.publish(np.zeros(4, np.float32), 1, 1)
-        a1.transport.publish(np.ones(4, np.float32), 1, 1)
+        a0.transport.publish(np.full(4, 0.25, np.float32), 1, 1)
+        a1.transport.publish(np.full(4, 0.75, np.float32), 1, 1)
         p0 = a0.update(1.0)
         p1 = a1.update(1.0)
         np.testing.assert_allclose(np.asarray(p0["w"]), np.full(4, 0.5))
@@ -77,10 +79,12 @@ def test_torch_adapter_reference_surface():
     model0 = torch.nn.Linear(4, 2)
     model1 = torch.nn.Linear(4, 2)
     with torch.no_grad():
+        # Nonzero on both sides: an all-zero replica served to a nonzero
+        # peer is now rejected as zero-energy (recovery guard).
         for p in model0.parameters():
-            p.zero_()
+            p.fill_(0.25)
         for p in model1.parameters():
-            p.fill_(1.0)
+            p.fill_(0.75)
     cfg = make_local_config(2, base_port=0)
     a0 = DpwaTorchAdapter(model0, "node0", cfg)
     a1 = DpwaTorchAdapter(model1, "node1", cfg)

@@ -115,3 +115,97 @@ def test_random_schedule_pool_follows_auto_default():
         make_local_config(64, schedule="random", mode="pull")
     )
     assert pull64.pool_size == 128
+
+
+def test_trust_block_roundtrip_and_defaults():
+    cfg = config_from_dict({"nodes": ["a", "b"]})
+    assert cfg.trust.enabled
+    assert cfg.trust.window == 32 and cfg.trust.min_window == 8
+    assert cfg.trust.reject_multiplier >= cfg.trust.mad_multiplier
+    cfg = config_from_dict(
+        {
+            "nodes": ["a", "b"],
+            "trust": {
+                "enabled": False,
+                "window": 64,
+                "min_window": 16,
+                "mad_multiplier": 6.0,
+                "reject_multiplier": 12.0,
+                "damping": 2.0,
+                "quarantine_trust": 0.1,
+                "cosine_floor": -0.9,
+                "amnesty_gap": 0,
+                "amnesty_rounds": 0,
+            },
+        }
+    )
+    assert not cfg.trust.enabled
+    assert cfg.trust.window == 64 and cfg.trust.damping == 2.0
+    assert cfg.trust.amnesty_rounds == 0
+
+
+@pytest.mark.parametrize(
+    "bad_trust",
+    [
+        {"window": 1},
+        {"min_window": 0},
+        {"min_window": 40},  # > default window 32
+        {"mad_multiplier": 0.0},
+        {"mad_multiplier": 8.0, "reject_multiplier": 4.0},
+        {"damping": 0.0},
+        {"ewma_half_life": 0.0},
+        {"suspect_decay": 1.0},
+        {"reject_decay": -0.1},
+        {"quarantine_trust": 0.0},
+        {"cosine_floor": -2.0},
+        {"norm_ratio_max": 1.0},
+        {"replay_slack": -1.0},
+        {"amnesty_gap": -1},
+        {"amnesty_rounds": -2},
+    ],
+)
+def test_trust_block_validation(bad_trust):
+    with pytest.raises((ValueError, TypeError)):
+        config_from_dict({"nodes": ["a", "b"], "trust": bad_trust})
+
+
+def test_chaos_byzantine_block_roundtrip_and_validation():
+    cfg = config_from_dict(
+        {
+            "nodes": ["a", "b", "c"],
+            "chaos": {
+                "enabled": True,
+                "byzantine_peers": [1],
+                "byzantine_start_round": 10,
+                "byzantine_sign_probability": 1.0,
+                "byzantine_scale_factor": 50.0,
+                "byzantine_replay_age": 4,
+            },
+        }
+    )
+    assert cfg.chaos.byzantine_peers == (1,)
+    assert cfg.chaos.byzantine_start_round == 10
+    assert cfg.chaos.byzantine_scale_factor == 50.0
+    for bad in (
+        {"byzantine_sign_probability": 1.5},
+        {"byzantine_zero_probability": -0.1},
+        {"byzantine_scale_factor": 0.0},
+        {"byzantine_replay_age": 0},
+        {"byzantine_start_round": -1},
+        {"byzantine_peers": [-1]},
+    ):
+        with pytest.raises(ValueError):
+            config_from_dict({"nodes": ["a", "b"], "chaos": bad})
+
+
+def test_recovery_min_param_norm_ratio_validation():
+    cfg = config_from_dict({"nodes": ["a", "b"]})
+    assert 0.0 < cfg.recovery.min_param_norm_ratio < 1.0
+    ok = config_from_dict(
+        {"nodes": ["a", "b"], "recovery": {"min_param_norm_ratio": 0.0}}
+    )
+    assert ok.recovery.min_param_norm_ratio == 0.0  # floor disabled
+    with pytest.raises(ValueError):
+        config_from_dict(
+            {"nodes": ["a", "b"], "recovery": {"min_param_norm_ratio": 1.0}}
+        )

@@ -161,8 +161,10 @@ def test_float64_and_bf16_roundtrip():
 def test_two_peer_lockstep_exchange_is_half_merge():
     ts = make_ring(2, factor=0.5)
     try:
-        v0 = np.zeros(64, np.float32)
-        v1 = np.ones(64, np.float32)
+        # Nonzero on both sides: an all-zero replica served to a nonzero
+        # peer is now rejected as zero-energy (recovery guard).
+        v0 = np.full(64, 0.25, np.float32)
+        v1 = np.full(64, 0.75, np.float32)
         # Lock-step: both publish before either fetches (barrier), so both
         # merge against pre-merge state — the ICI semantics.
         ts[0].publish(v0, 1, 0.5)
@@ -203,7 +205,9 @@ def test_exchange_survives_dead_partner():
 def test_four_peer_ring_concurrent_exchange():
     ts = make_ring(4, schedule="ring")
     try:
-        vecs = [np.full(32, float(i), np.float32) for i in range(4)]
+        # 1-based values: an all-zero replica would be rejected as
+        # zero-energy by the recovery guard's norm-ratio floor.
+        vecs = [np.full(32, float(i + 1), np.float32) for i in range(4)]
         for t, v in zip(ts, vecs):
             t.publish(v, 1, 1)
         results = [None] * 4
@@ -217,10 +221,10 @@ def test_four_peer_ring_concurrent_exchange():
         for th in threads:
             th.join()
         # Step 0 ring pairing: (0,1) and (2,3); constant alpha = 0.5.
-        np.testing.assert_allclose(results[0][0], np.full(32, 0.5))
-        np.testing.assert_allclose(results[1][0], np.full(32, 0.5))
-        np.testing.assert_allclose(results[2][0], np.full(32, 2.5))
-        np.testing.assert_allclose(results[3][0], np.full(32, 2.5))
+        np.testing.assert_allclose(results[0][0], np.full(32, 1.5))
+        np.testing.assert_allclose(results[1][0], np.full(32, 1.5))
+        np.testing.assert_allclose(results[2][0], np.full(32, 3.5))
+        np.testing.assert_allclose(results[3][0], np.full(32, 3.5))
     finally:
         close_all(ts)
 

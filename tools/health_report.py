@@ -23,11 +23,18 @@ component changes.  ``--split-step N`` (the round a known injected
 partition began, e.g. the chaos window start) additionally reports
 time-to-detect for each episode.
 
+``--trust`` prints the content-trust digest (docs/trust.md): per-peer
+trust trajectory (first/min/final EWMA), screened/damped/rejected
+counts, trust collapse/recovery events, and — per peer that ever served
+an ``untrusted`` payload — the rounds from the first byzantine payload
+to quarantine.
+
 Usage::
 
     python tools/health_report.py metrics.jsonl [more.jsonl ...]
     python tools/health_report.py --json metrics.jsonl   # machine-readable
     python tools/health_report.py --split-step 20 metrics.jsonl
+    python tools/health_report.py --trust metrics.jsonl
 """
 
 from __future__ import annotations
@@ -74,6 +81,31 @@ def summarize(
         "resync_advised": 0,
         "other": {},
     }
+    trust: Dict[str, Any] = {
+        "seen": False,  # any trust column/event/outcome in the records
+        "peers": {},  # p -> trajectory + verdict counters
+        "untrusted_fetches": 0,
+        "damped_exchanges": 0,
+        "collapses": 0,
+        "recoveries": 0,
+        "clock_resets": 0,
+    }
+
+    def trust_slot(p: int) -> Dict[str, Any]:
+        return trust["peers"].setdefault(
+            int(p),
+            {
+                "trajectory": [],  # (step, trust EWMA) samples
+                "final": None,
+                "min": None,
+                "damped": None,
+                "rejected": None,
+                "first_untrusted_step": None,
+                "quarantined_step": None,
+                "rounds_to_quarantine": None,
+            },
+        )
+
     membership: Dict[str, Any] = {
         "partitions_entered": 0,
         "partitions_healed": 0,
@@ -167,6 +199,15 @@ def summarize(
                 "partition_reconcile_rejected", "partition_reconcile_failed"
             ):
                 membership["reconcile_rejected"] += 1
+            elif kind == "trust_collapsed":
+                trust["seen"] = True
+                trust["collapses"] += 1
+            elif kind == "trust_recovered":
+                trust["seen"] = True
+                trust["recoveries"] += 1
+            elif kind == "trust_clock_reset":
+                trust["seen"] = True
+                trust["clock_resets"] += 1
             else:
                 events["other"][str(kind)] = (
                     events["other"].get(str(kind), 0) + 1
@@ -187,6 +228,30 @@ def summarize(
                     )[i],
                     "at_step": rec.get("step"),
                 }
+                if "trust" in rec:
+                    trust["seen"] = True
+                    ts = trust_slot(p)
+                    t = rec["trust"][i]
+                    ts["trajectory"].append([rec.get("step"), t])
+                    ts["final"] = t
+                    if t is not None:
+                        ts["min"] = (
+                            t if ts["min"] is None else min(ts["min"], t)
+                        )
+                    ts["damped"] = rec.get(
+                        "trust_damped", [None] * (i + 1)
+                    )[i]
+                    ts["rejected"] = rec.get(
+                        "trust_rejected", [None] * (i + 1)
+                    )[i]
+                ts = trust["peers"].get(int(p))
+                if (
+                    ts is not None
+                    and rec["peer_state"][i] == "quarantined"
+                    and ts["quarantined_step"] is None
+                    and ts["first_untrusted_step"] is not None
+                ):
+                    ts["quarantined_step"] = rec.get("step")
             continue
         if "outcome" not in rec and "sched_partner" not in rec:
             continue  # not an exchange record (loss-only, etc.)
@@ -199,6 +264,16 @@ def summarize(
             s["outcomes"][out] = s["outcomes"].get(out, 0) + 1
         if rec.get("outcome") == "poisoned":
             poisoned += 1
+        if rec.get("outcome") == "untrusted":
+            trust["seen"] = True
+            trust["untrusted_fetches"] += 1
+            if actual is not None:
+                ts = trust_slot(actual)
+                if ts["first_untrusted_step"] is None:
+                    ts["first_untrusted_step"] = rec.get("step")
+        if rec.get("trust_verdict") == "suspect":
+            trust["seen"] = True
+            trust["damped_exchanges"] += 1
         if rec.get("remapped") and sched is not None:
             slot(sched)["remapped_away"] += 1
             if actual is not None and actual != sched:
@@ -207,6 +282,18 @@ def summarize(
     for p, h in last_health.items():
         slot(p)["health"] = h
     events["poisoned_fetches"] = poisoned
+    for ts in trust["peers"].values():
+        # Quarantine latency: first untrusted payload -> first health
+        # record showing the peer quarantined.  An upper bound (health
+        # records are sampled every health_every steps), which is the
+        # honest figure a soak can assert against.
+        if (
+            ts["first_untrusted_step"] is not None
+            and ts["quarantined_step"] is not None
+        ):
+            ts["rounds_to_quarantine"] = (
+                ts["quarantined_step"] - ts["first_untrusted_step"]
+            )
     return {
         "records": {
             "exchange": n_exchange,
@@ -217,7 +304,51 @@ def summarize(
         "peers": {p: peers[p] for p in sorted(peers)},
         "recovery": events,
         "membership": membership,
+        "trust": trust,
     }
+
+
+def _print_trust(summary: Dict[str, Any]) -> None:
+    tr = summary.get("trust", {})
+    print()
+    print("# trust")
+    if not tr.get("seen"):
+        print("  no trust records in input (trust plane disabled?)")
+        return
+    print(
+        f"  untrusted fetches rejected: {tr['untrusted_fetches']}; "
+        f"damped (suspect) exchanges: {tr['damped_exchanges']}"
+    )
+    if tr.get("collapses") or tr.get("recoveries") or tr.get("clock_resets"):
+        print(
+            f"  trust collapses: {tr['collapses']}, recoveries: "
+            f"{tr['recoveries']}, clock resets: {tr['clock_resets']}"
+        )
+    for p, ts in sorted(tr.get("peers", {}).items()):
+        traj = ts.get("trajectory", [])
+        first = traj[0][1] if traj else None
+        arc = (
+            f"trust {first} -> min {ts['min']} -> final {ts['final']}"
+            if traj
+            else "no trajectory samples"
+        )
+        line = (
+            f"  peer {p}: {arc}; damped={ts['damped']}, "
+            f"rejected={ts['rejected']}"
+        )
+        if ts.get("first_untrusted_step") is not None:
+            q = (
+                f"quarantined by step {ts['quarantined_step']} "
+                f"({ts['rounds_to_quarantine']} rounds after first "
+                f"byzantine payload)"
+                if ts.get("quarantined_step") is not None
+                else "never seen quarantined"
+            )
+            line += (
+                f"; first byzantine payload at step "
+                f"{ts['first_untrusted_step']}, {q}"
+            )
+        print(line)
 
 
 def _print_table(summary: Dict[str, Any]) -> None:
@@ -351,6 +482,13 @@ def main(argv=None) -> int:
         help="round a known injected partition began (e.g. the chaos "
         "partition_windows start); enables per-episode time-to-detect",
     )
+    ap.add_argument(
+        "--trust",
+        action="store_true",
+        help="print the content-trust digest (per-peer trust trajectory, "
+        "damped/rejected counts, time from first byzantine payload to "
+        "quarantine)",
+    )
     args = ap.parse_args(argv)
     summary = summarize(args.paths, split_step=args.split_step)
     if args.json:
@@ -358,6 +496,8 @@ def main(argv=None) -> int:
         print()
     else:
         _print_table(summary)
+        if args.trust:
+            _print_trust(summary)
     return 0
 
 
