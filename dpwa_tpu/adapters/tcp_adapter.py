@@ -248,6 +248,11 @@ class DpwaTcpAdapter:
                 # plane is off, keeping pre-trust records identical).
                 extra["trust_verdict"] = info["trust"].get("verdict")
                 extra["trust_scale"] = info["trust"].get("alpha_scale")
+            if info.get("hedged"):
+                # Flowctl hedge accounting rides the exchange record
+                # (absent when no hedge fired, keeping records identical).
+                extra["hedged"] = True
+                extra["hedge_winner"] = info.get("hedge_winner")
             self.metrics.log(
                 step,
                 loss=loss,

@@ -74,6 +74,14 @@ Schema::
       byzantine_replay_probability: 0.0 # re-serve an old own snapshot
       byzantine_replay_age: 8   # how many rounds stale the replay is
       byzantine_zero_probability: 0.0   # serve an all-zero replica
+      trickle_windows: []       # [{peer, start, stop}]: serve at
+                                #   trickle_bytes_per_s (straggler shaping)
+      trickle_bytes_per_s: 2048.0
+      stall_probability: 0.0    # jittered mid-payload serving stall
+      stall_ms_max: 200.0       # drawn stall length in [0, stall_ms_max]
+      accept_delay_windows: []  # [{peer, start, stop}]: sleep before
+                                #   reading the request (accept-path lag)
+      accept_delay_ms: 100.0
     recovery:                   # crash recovery & divergence guard
       enabled: true             # peer bootstrap serving + payload guard
       max_param_norm: 1.0e12    # reject/roll back when ||vec||_2 exceeds
@@ -128,6 +136,30 @@ Schema::
                                 #   (n_peers - 1) rounds is re-acquainted
       amnesty_rounds: 8         # ...leniently for this many rounds
                                 #   (rejects downgrade to damped suspects)
+    flowctl:                    # flow control plane (docs/flowctl.md)
+      enabled: true             # adaptive deadlines + serving admission
+                                #   (forces the Python Rx server)
+      quantile: 0.95            # per-peer latency quantile the budget
+                                #   tracks (also the hedge launch point)
+      margin: 1.5               # deadline = quantile latency * margin
+      min_ms: 50.0              # adaptive-deadline clamp (floor)
+      max_ms: 5000.0            # adaptive-deadline clamp (ceiling)
+      window: 32                # success-latency samples kept per peer
+      warmup: 5                 # cold below this many samples: fall back
+                                #   to protocol.timeout_ms, never hedge
+      hedge: true               # one hedged retry to the schedule's
+                                #   fallback partner once the p95 lapses
+      degrade_shed_fraction: 0.5  # fraction of rounds deterministically
+                                #   remapped away from a DEGRADED partner
+      max_connections: 32       # serving: global concurrent-conn cap
+      token_rate: 100.0         # serving: requests/s refill per remote
+      token_burst: 200.0        # serving: token bucket depth per remote
+      max_inflight_bytes: 268435456  # serving: payload bytes in flight
+      min_ingest_bytes_per_s: 4096.0 # slow-loris eviction floor on
+                                #   request reads
+      request_timeout_ms: 5000  # per-connection handler budget (was the
+                                #   hard-coded 5 s accept-path timeout)
+      busy_retry_ms: 50         # retry hint carried in the DPWB reply
 """
 
 from __future__ import annotations
@@ -340,6 +372,20 @@ class ChaosConfig:
     byzantine_replay_probability: float = 0.0
     byzantine_replay_age: int = 8
     byzantine_zero_probability: float = 0.0
+    # Latency/bandwidth shaping (straggler injection, docs/flowctl.md).
+    # ``trickle_windows`` serves a peer's frames at trickle_bytes_per_s
+    # during [start, stop) — bytes FLOW but far below any useful rate, the
+    # honest-but-overloaded shape the flowctl plane must soft-degrade
+    # rather than quarantine.  ``stall_probability`` draws a jittered
+    # mid-payload stall up to stall_ms_max; ``accept_delay_windows``
+    # sleeps before the request read (accept-path lag).  All draws are
+    # per (seed, round, peer) threefry streams like every other fault.
+    trickle_windows: tuple[tuple[int, int, int], ...] = ()
+    trickle_bytes_per_s: float = 2048.0
+    stall_probability: float = 0.0
+    stall_ms_max: float = 200.0
+    accept_delay_windows: tuple[tuple[int, int, int], ...] = ()
+    accept_delay_ms: float = 100.0
 
     def __post_init__(self) -> None:
         for name in (
@@ -353,6 +399,7 @@ class ChaosConfig:
             "byzantine_scale_probability",
             "byzantine_replay_probability",
             "byzantine_zero_probability",
+            "stall_probability",
         ):
             v = getattr(self, name)
             if not 0.0 <= v <= 1.0:
@@ -388,15 +435,30 @@ class ChaosConfig:
                 f"partition_len_rounds must be >= 1, "
                 f"got {self.partition_len_rounds}"
             )
-        windows = []
-        for w in self.down_windows:
-            if isinstance(w, Mapping):
-                w = (w["peer"], w["start"], w["stop"])
-            w = tuple(int(x) for x in w)
-            if len(w) != 3 or w[0] < 0 or w[1] < 0 or w[2] < w[1]:
-                raise ValueError(f"bad down_windows entry {w!r}")
-            windows.append(w)
-        object.__setattr__(self, "down_windows", tuple(windows))
+        if self.trickle_bytes_per_s <= 0:
+            raise ValueError(
+                f"trickle_bytes_per_s must be > 0, "
+                f"got {self.trickle_bytes_per_s}"
+            )
+        if self.stall_ms_max < 0:
+            raise ValueError(
+                f"stall_ms_max must be >= 0, got {self.stall_ms_max}"
+            )
+        if self.accept_delay_ms < 0:
+            raise ValueError(
+                f"accept_delay_ms must be >= 0, got {self.accept_delay_ms}"
+            )
+        for field in ("down_windows", "trickle_windows",
+                      "accept_delay_windows"):
+            windows = []
+            for w in getattr(self, field):
+                if isinstance(w, Mapping):
+                    w = (w["peer"], w["start"], w["stop"])
+                w = tuple(int(x) for x in w)
+                if len(w) != 3 or w[0] < 0 or w[1] < 0 or w[2] < w[1]:
+                    raise ValueError(f"bad {field} entry {w!r}")
+                windows.append(w)
+            object.__setattr__(self, field, tuple(windows))
         parts = []
         for w in self.partition_windows:
             if isinstance(w, Mapping):
@@ -680,6 +742,112 @@ class TrustConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class FlowctlConfig:
+    """``flowctl:`` block — flow control plane knobs (docs/flowctl.md).
+
+    Fetcher side: every classified fetch outcome feeds a per-peer
+    latency/throughput estimator whose quantile sets the next fetch's
+    cumulative deadline (clamped to ``[min_ms, max_ms]``; cold peers fall
+    back to ``protocol.timeout_ms``), and once the un-margined quantile
+    budget lapses a single hedged retry races the schedule's fallback
+    partner.  Serving side: admission control in the Python Rx server —
+    connection cap, per-remote token bucket, in-flight-bytes ceiling,
+    slow-loris eviction — sheds excess load with an explicit ``DPWB``
+    busy frame instead of queueing unboundedly.  Busy/slow evidence is
+    low-weight (detector outcomes ``busy``/``slow``) and soft-degrades a
+    peer (scoreboard ``degraded``, never quarantined on that evidence
+    alone).  Like chaos/recovery/membership, enabling this forces the
+    Python Rx server — the native C++ loop does not speak DPWB."""
+
+    enabled: bool = True
+    # Adaptive deadline: the tracked success-latency quantile, times
+    # ``margin``, clamped to [min_ms, max_ms].  The un-margined quantile
+    # is the hedge launch point, so the margin IS the hedge's headroom.
+    quantile: float = 0.95
+    margin: float = 1.5
+    min_ms: float = 50.0
+    max_ms: float = 5000.0
+    # Per-peer success-latency samples kept (ring window); below
+    # ``warmup`` samples the estimator is cold: deadlines fall back to
+    # protocol.timeout_ms and hedging stays off.
+    window: int = 32
+    warmup: int = 5
+    hedge: bool = True
+    # Fraction of scheduled rounds deterministically remapped away from a
+    # DEGRADED partner (threefry control draw, tag 8).  The rest still
+    # fetch it — under its adaptive (short) budget — so recovery evidence
+    # keeps flowing.  0 disables shedding, 1 starves the peer of direct
+    # observations (readmission then rides on other peers' digests).
+    degrade_shed_fraction: float = 0.5
+    # Serving-side admission.
+    max_connections: int = 32
+    token_rate: float = 100.0
+    token_burst: float = 200.0
+    max_inflight_bytes: int = 1 << 28
+    min_ingest_bytes_per_s: float = 4096.0
+    # Per-connection handler budget; replaces the hard-coded 5 s
+    # conn.settimeout in the accept path, so the request-read eviction
+    # deadline and the handler recv timeout agree by construction.
+    request_timeout_ms: int = 5000
+    busy_retry_ms: int = 50
+
+    def __post_init__(self) -> None:
+        if not 0.0 < self.quantile <= 1.0:
+            raise ValueError(
+                f"quantile must be in (0, 1], got {self.quantile}"
+            )
+        if self.margin < 1.0:
+            raise ValueError(f"margin must be >= 1, got {self.margin}")
+        if not 0.0 < self.min_ms <= self.max_ms:
+            raise ValueError(
+                f"need 0 < min_ms <= max_ms, "
+                f"got min_ms={self.min_ms} max_ms={self.max_ms}"
+            )
+        if self.window < 2:
+            raise ValueError(f"window must be >= 2, got {self.window}")
+        if not 1 <= self.warmup <= self.window:
+            raise ValueError(
+                f"warmup must be in [1, window], got {self.warmup}"
+            )
+        if not 0.0 <= self.degrade_shed_fraction <= 1.0:
+            raise ValueError(
+                f"degrade_shed_fraction must be in [0, 1], "
+                f"got {self.degrade_shed_fraction}"
+            )
+        if self.max_connections < 1:
+            raise ValueError(
+                f"max_connections must be >= 1, got {self.max_connections}"
+            )
+        if self.token_rate <= 0:
+            raise ValueError(
+                f"token_rate must be > 0, got {self.token_rate}"
+            )
+        if self.token_burst < 1:
+            raise ValueError(
+                f"token_burst must be >= 1, got {self.token_burst}"
+            )
+        if self.max_inflight_bytes < 1:
+            raise ValueError(
+                f"max_inflight_bytes must be >= 1, "
+                f"got {self.max_inflight_bytes}"
+            )
+        if self.min_ingest_bytes_per_s <= 0:
+            raise ValueError(
+                f"min_ingest_bytes_per_s must be > 0, "
+                f"got {self.min_ingest_bytes_per_s}"
+            )
+        if self.request_timeout_ms < 1:
+            raise ValueError(
+                f"request_timeout_ms must be >= 1, "
+                f"got {self.request_timeout_ms}"
+            )
+        if self.busy_retry_ms < 0:
+            raise ValueError(
+                f"busy_retry_ms must be >= 0, got {self.busy_retry_ms}"
+            )
+
+
+@dataclasses.dataclass(frozen=True)
 class InterpolationConfig:
     type: str = "constant"
     factor: float = 0.5
@@ -701,6 +869,7 @@ class DpwaConfig:
     recovery: RecoveryConfig = RecoveryConfig()
     membership: MembershipConfig = MembershipConfig()
     trust: TrustConfig = TrustConfig()
+    flowctl: FlowctlConfig = FlowctlConfig()
 
     @property
     def n_peers(self) -> int:
@@ -759,9 +928,10 @@ def config_from_dict(raw: Mapping[str, Any]) -> DpwaConfig:
     recovery = dict(raw.get("recovery") or {})
     membership = dict(raw.get("membership") or {})
     trust = dict(raw.get("trust") or {})
+    flowctl = dict(raw.get("flowctl") or {})
     for key in (
         "down_windows", "partition_windows", "link_windows",
-        "byzantine_peers",
+        "byzantine_peers", "trickle_windows", "accept_delay_windows",
     ):
         if chaos.get(key) is not None:
             chaos[key] = tuple(chaos[key])
@@ -774,6 +944,7 @@ def config_from_dict(raw: Mapping[str, Any]) -> DpwaConfig:
         recovery=RecoveryConfig(**recovery),
         membership=MembershipConfig(**membership),
         trust=TrustConfig(**trust),
+        flowctl=FlowctlConfig(**flowctl),
     )
 
 
@@ -800,12 +971,14 @@ def make_local_config(
     recovery: "RecoveryConfig | Mapping[str, Any] | None" = None,
     membership: "MembershipConfig | Mapping[str, Any] | None" = None,
     trust: "TrustConfig | Mapping[str, Any] | None" = None,
+    flowctl: "FlowctlConfig | Mapping[str, Any] | None" = None,
     **protocol_kwargs: Any,
 ) -> DpwaConfig:
     """Programmatic config for tests/benchmarks: n local peers on 127.0.0.1.
 
-    ``health`` / ``chaos`` / ``recovery`` / ``membership`` / ``trust``
-    accept a config object or a plain dict (the YAML-block shorthand)."""
+    ``health`` / ``chaos`` / ``recovery`` / ``membership`` / ``trust`` /
+    ``flowctl`` accept a config object or a plain dict (the YAML-block
+    shorthand)."""
     if isinstance(health, Mapping):
         health = HealthConfig(**health)
     if isinstance(chaos, Mapping):
@@ -816,6 +989,8 @@ def make_local_config(
         membership = MembershipConfig(**membership)
     if isinstance(trust, Mapping):
         trust = TrustConfig(**trust)
+    if isinstance(flowctl, Mapping):
+        flowctl = FlowctlConfig(**flowctl)
     return DpwaConfig(
         nodes=tuple(
             NodeSpec(name=f"node{i}", host="127.0.0.1", port=base_port + i)
@@ -833,4 +1008,5 @@ def make_local_config(
         recovery=recovery if recovery is not None else RecoveryConfig(),
         membership=membership if membership is not None else MembershipConfig(),
         trust=trust if trust is not None else TrustConfig(),
+        flowctl=flowctl if flowctl is not None else FlowctlConfig(),
     )

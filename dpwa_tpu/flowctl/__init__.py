@@ -1,0 +1,36 @@
+"""Flow control plane: adaptive deadlines, hedging, serving admission.
+
+The plane between the gossip scheduler and the TCP wire (docs/flowctl.md).
+Two halves, both configured by the ``flowctl:`` block
+(:class:`dpwa_tpu.config.FlowctlConfig`):
+
+- **Fetcher side** (:class:`DeadlineEstimator`): every outcome-classified
+  fetch feeds a per-peer latency window; the tracked quantile (times a
+  margin, clamped to ``[min_ms, max_ms]``) becomes the next fetch's
+  cumulative deadline, so a straggler costs its own observed latency — not
+  the static ``protocol.timeout_ms`` — per scheduled round.  Once the
+  un-margined quantile budget lapses, the transport launches one hedged
+  retry against the schedule's deterministic fallback partner and the
+  first success wins.
+
+- **Serving side** (:class:`AdmissionController`): the Python Rx server
+  sheds excess load *explicitly* — a global concurrent-connection cap,
+  per-remote token-bucket pacing, an in-flight-bytes ceiling, and
+  slow-loris eviction on request reads — by answering with a tiny
+  ``DPWB`` busy frame instead of queueing unboundedly.  New readers
+  classify it as the low-weight ``busy`` outcome (soft-degrade, never
+  quarantine); old readers see EOF short of a full header and fall into
+  their existing ``short_read`` handling.
+
+Neither half holds references into the transport: the estimator is fed by
+``TcpTransport.fetch`` and the controller by ``PeerServer``, keeping this
+package importable without the wire (config is its only dependency).
+"""
+
+from dpwa_tpu.flowctl.admission import AdmissionController
+from dpwa_tpu.flowctl.estimator import DeadlineEstimator
+
+__all__ = [
+    "AdmissionController",
+    "DeadlineEstimator",
+]

@@ -1,0 +1,154 @@
+"""Serving-side admission control for the Python Rx server.
+
+Four independent gates, each shedding load *explicitly* (the server
+answers a ``DPWB`` busy frame and closes) instead of queueing work it
+cannot finish:
+
+- a **global concurrent-connection cap** (``max_connections``): the
+  thread-per-connection server never holds more live handlers than this;
+- **per-remote token buckets** (``token_rate``/``token_burst`` keyed on
+  the remote address): one aggressive fetcher cannot starve the rest of
+  the ring of serving capacity;
+- an **in-flight-bytes ceiling** (``max_inflight_bytes``): payload bytes
+  reserved for the duration of each blob send, bounding serving memory
+  under fan-in;
+- **slow-loris eviction**: the request read runs under a cumulative
+  deadline extended per byte at ``min_ingest_bytes_per_s`` — a client
+  trickling its request is cut off and counted, not waited on.
+
+Unlike every health-plane decision, admission reads the wall clock
+(token refill is a rate, rates are wall time) — that is sound because
+admission never feeds the deterministic state machines directly: a shed
+request becomes a ``busy`` outcome on the *fetcher*, whose low weight
+soft-degrades, and soft evidence never quarantines.  The clock is
+injectable for tests.
+
+Thread safety: gates are consulted from the accept loop and per-connection
+handler threads concurrently; all public methods take the internal lock.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from typing import Callable, Dict, Optional, Tuple
+
+from dpwa_tpu.config import FlowctlConfig
+
+
+class AdmissionController:
+    """The Rx server's shed-or-serve gatekeeper."""
+
+    def __init__(
+        self,
+        config: Optional[FlowctlConfig] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.config = config if config is not None else FlowctlConfig()
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._active = 0
+        self._peak_active = 0
+        self._inflight_bytes = 0
+        # host -> (tokens, last_refill_time)
+        self._buckets: Dict[str, Tuple[float, float]] = {}
+        self._admitted = 0
+        self._sheds: Dict[str, int] = {
+            "connections": 0, "tokens": 0, "bytes": 0,
+        }
+        self._evictions = 0
+
+    # ------------------------------------------------------------------
+    # Connection admission (accept path)
+    # ------------------------------------------------------------------
+
+    def _refill(self, host: str, now: float) -> float:
+        tokens, last = self._buckets.get(
+            host, (float(self.config.token_burst), now)
+        )
+        tokens = min(
+            float(self.config.token_burst),
+            tokens + (now - last) * self.config.token_rate,
+        )
+        return tokens
+
+    def admit(self, host: str) -> Tuple[bool, int]:
+        """Try to admit one connection from ``host``.
+
+        Returns ``(True, 0)`` and counts the connection active, or
+        ``(False, retry_ms)`` with the hint to embed in the busy frame.
+        Every admit must be paired with exactly one :meth:`release`."""
+        with self._lock:
+            now = self._clock()
+            if self._active >= self.config.max_connections:
+                self._sheds["connections"] += 1
+                return False, self.config.busy_retry_ms
+            tokens = self._refill(host, now)
+            if tokens < 1.0:
+                self._sheds["tokens"] += 1
+                self._buckets[host] = (tokens, now)
+                retry_ms = int(
+                    math.ceil((1.0 - tokens) / self.config.token_rate * 1e3)
+                )
+                return False, max(retry_ms, self.config.busy_retry_ms)
+            self._buckets[host] = (tokens - 1.0, now)
+            self._active += 1
+            self._peak_active = max(self._peak_active, self._active)
+            self._admitted += 1
+            return True, 0
+
+    def release(self, host: str) -> None:
+        with self._lock:
+            self._active = max(0, self._active - 1)
+
+    # ------------------------------------------------------------------
+    # In-flight payload bytes (blob send path)
+    # ------------------------------------------------------------------
+
+    def reserve_bytes(self, nbytes: int) -> bool:
+        """Reserve ``nbytes`` of serving budget for one blob send; False
+        (counted as a ``bytes`` shed) when the ceiling would be crossed."""
+        with self._lock:
+            if self._inflight_bytes + nbytes > self.config.max_inflight_bytes:
+                self._sheds["bytes"] += 1
+                return False
+            self._inflight_bytes += nbytes
+            return True
+
+    def release_bytes(self, nbytes: int) -> None:
+        with self._lock:
+            self._inflight_bytes = max(0, self._inflight_bytes - nbytes)
+
+    # ------------------------------------------------------------------
+    # Slow-loris accounting (request-read path)
+    # ------------------------------------------------------------------
+
+    def note_eviction(self) -> None:
+        """A request read missed its minimum-ingest deadline and the
+        connection was cut (counted; the client never gets a busy frame —
+        it was not speaking the protocol fast enough to receive one)."""
+        with self._lock:
+            self._evictions += 1
+
+    # ------------------------------------------------------------------
+    # Observability
+    # ------------------------------------------------------------------
+
+    @property
+    def shed_total(self) -> int:
+        with self._lock:
+            return sum(self._sheds.values())
+
+    def snapshot(self) -> dict:
+        """JSON-ready admission counters for /healthz and log_health."""
+        with self._lock:
+            return {
+                "active": self._active,
+                "peak_active": self._peak_active,
+                "inflight_bytes": self._inflight_bytes,
+                "admitted": self._admitted,
+                "sheds": dict(self._sheds),
+                "shed_total": sum(self._sheds.values()),
+                "evictions": self._evictions,
+            }

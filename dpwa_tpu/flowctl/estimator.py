@@ -1,0 +1,177 @@
+"""Per-peer adaptive fetch deadlines from observed success latencies.
+
+The estimator keeps, per peer, a bounded window of SUCCESS latencies (the
+only samples that measure the peer's actual service time — failures
+measure our own budget) and derives from it:
+
+- the **adaptive deadline**: ``quantile(window) * margin`` clamped to
+  ``[min_ms, max_ms]``.  Cold (fewer than ``warmup`` samples) it falls
+  back to the configured static ``timeout_ms``, so behavior before any
+  evidence exists is exactly the pre-flowctl transport.
+- the **hedge launch point**: the un-margined quantile — the moment the
+  fetch has statistically already failed; the margin above it is the
+  headroom in which the hedge races the original.
+
+Failures still feed the window's *counters* (busy/slow/hedge
+accounting for observability) but never its latencies: a run of timeouts
+must not teach the estimator that the peer is "slow but fine", it must
+leave the deadline resting on the last known-good behavior.
+
+Thread safety: fetches run on the overlapped-exchange thread (and hedge
+threads) while the training thread reads snapshots, so all public methods
+take the internal lock.  Nothing here reads the wall clock — latencies
+come in as arguments — so the estimator itself adds no nondeterminism to
+outcome classification.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from collections import deque
+from typing import Deque, Dict, Optional
+
+from dpwa_tpu.config import FlowctlConfig
+from dpwa_tpu.health.detector import Outcome
+
+
+class DeadlineEstimator:
+    """Latency-quantile deadlines + hedge/busy accounting, per peer."""
+
+    def __init__(
+        self,
+        config: Optional[FlowctlConfig] = None,
+        timeout_ms: float = 500.0,
+    ):
+        self.config = config if config is not None else FlowctlConfig()
+        self.timeout_ms = float(timeout_ms)
+        self._lock = threading.Lock()
+        self._window: Dict[int, Deque[float]] = {}
+        self._counts: Dict[int, Dict[str, int]] = {}
+        self._hedges = 0
+        self._hedge_wins = 0
+
+    # ------------------------------------------------------------------
+    # Ingestion
+    # ------------------------------------------------------------------
+
+    def _peer_counts(self, peer: int) -> Dict[str, int]:
+        c = self._counts.get(peer)
+        if c is None:
+            c = self._counts[peer] = {
+                "busy": 0, "slow": 0, "hedges": 0, "hedge_wins": 0,
+            }
+        return c
+
+    def observe(
+        self,
+        peer: int,
+        outcome: str,
+        latency_s: Optional[float] = None,
+        nbytes: int = 0,
+    ) -> None:
+        """Feed one classified fetch outcome (same shape as the detector)."""
+        with self._lock:
+            counts = self._peer_counts(peer)
+            if outcome == Outcome.SUCCESS:
+                if latency_s is not None and latency_s >= 0.0:
+                    win = self._window.get(peer)
+                    if win is None:
+                        win = self._window[peer] = deque(
+                            maxlen=self.config.window
+                        )
+                    win.append(float(latency_s) * 1e3)
+            elif outcome == Outcome.BUSY:
+                counts["busy"] += 1
+            elif outcome == Outcome.SLOW:
+                counts["slow"] += 1
+
+    def note_hedge(self, peer: int) -> None:
+        """A hedged retry was launched because ``peer`` lapsed its budget."""
+        with self._lock:
+            self._hedges += 1
+            self._peer_counts(peer)["hedges"] += 1
+
+    def note_hedge_win(self, peer: int) -> None:
+        """The hedge against ``peer`` won the race (fallback's payload
+        merged; ``peer``'s fetch was cancelled and classified slow)."""
+        with self._lock:
+            self._hedge_wins += 1
+            self._peer_counts(peer)["hedge_wins"] += 1
+
+    # ------------------------------------------------------------------
+    # Queries
+    # ------------------------------------------------------------------
+
+    def _quantile_ms(self, peer: int, q: float) -> Optional[float]:
+        win = self._window.get(peer)
+        if win is None or len(win) < self.config.warmup:
+            return None
+        samples = sorted(win)
+        idx = min(len(samples) - 1, max(0, math.ceil(q * len(samples)) - 1))
+        return samples[idx]
+
+    def warm(self, peer: int) -> bool:
+        """True once ``peer`` has ``warmup`` success samples."""
+        with self._lock:
+            win = self._window.get(peer)
+            return win is not None and len(win) >= self.config.warmup
+
+    def deadline_ms(self, peer: int) -> float:
+        """The cumulative fetch budget for ``peer``'s next fetch."""
+        with self._lock:
+            q = self._quantile_ms(peer, self.config.quantile)
+            if q is None:
+                return self.timeout_ms
+            return min(
+                self.config.max_ms,
+                max(self.config.min_ms, q * self.config.margin),
+            )
+
+    def hedge_launch_ms(self, peer: int) -> Optional[float]:
+        """When (ms into the fetch) the hedge should launch, or None while
+        cold — a cold estimator never hedges (there is no budget whose
+        lapse means anything yet)."""
+        with self._lock:
+            q = self._quantile_ms(peer, self.config.quantile)
+            if q is None:
+                return None
+            return min(self.config.max_ms, max(self.config.min_ms, q))
+
+    # ------------------------------------------------------------------
+    # Observability
+    # ------------------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """JSON-ready: per-peer deadline/quantiles + hedge/busy counters."""
+        with self._lock:
+            peers = {}
+            for peer in sorted(set(self._window) | set(self._counts)):
+                win = self._window.get(peer)
+                counts = self._peer_counts(peer)
+                p50 = self._quantile_ms(peer, 0.5)
+                q = self._quantile_ms(peer, self.config.quantile)
+                deadline = (
+                    self.timeout_ms
+                    if q is None
+                    else min(
+                        self.config.max_ms,
+                        max(self.config.min_ms, q * self.config.margin),
+                    )
+                )
+                peers[peer] = {
+                    "samples": len(win) if win is not None else 0,
+                    "p50_ms": round(p50, 3) if p50 is not None else None,
+                    "q_ms": round(q, 3) if q is not None else None,
+                    "deadline_ms": round(deadline, 3),
+                    "hedges": counts["hedges"],
+                    "hedge_wins": counts["hedge_wins"],
+                    "busy": counts["busy"],
+                    "slow": counts["slow"],
+                }
+            return {
+                "quantile": self.config.quantile,
+                "hedges": self._hedges,
+                "hedge_wins": self._hedge_wins,
+                "peers": peers,
+            }

@@ -68,6 +68,12 @@ _KIND_BYZ_SIGN = 7
 _KIND_BYZ_SCALE = 8
 _KIND_BYZ_REPLAY = 9
 _KIND_BYZ_ZERO = 10
+# Flowctl shaping (slow-peer chaos): kind 11 decides whether this
+# (round, peer) stalls mid-frame, kind 12 draws the stall length as a
+# fraction of ``stall_ms_max`` — both independent of the wire-fault
+# draws, so a trickled peer can ALSO stall, like a real overloaded box.
+_KIND_STALL = 11
+_KIND_STALL_LEN = 12
 # Priority order when several draws fire in one round: exactly one fault
 # kind applies per (round, peer) so injected behavior stays analyzable.
 _PRIORITY = (
@@ -101,10 +107,24 @@ class FaultPlan:
     byzantine: str = "none"
     byz_scale: float = 0.0
     byz_replay_age: int = 0
+    # Flowctl shaping, composable with every wire/byzantine fault:
+    # ``trickle_bps`` > 0 serves THE WHOLE FRAME at that rate (a
+    # config-windowed straggler, vs. throttle's drawn per-round slow
+    # serve), ``stall_s`` > 0 inserts one jittered mid-frame stall, and
+    # ``accept_delay_s`` > 0 sleeps before the request is even read.
+    trickle_bps: float = 0.0
+    stall_s: float = 0.0
+    accept_delay_s: float = 0.0
 
     @property
     def faulty(self) -> bool:
-        return self.kind != "none" or self.byzantine != "none"
+        return (
+            self.kind != "none"
+            or self.byzantine != "none"
+            or self.trickle_bps > 0.0
+            or self.stall_s > 0.0
+            or self.accept_delay_s > 0.0
+        )
 
 
 class ChaosEngine:
@@ -163,6 +183,28 @@ class ChaosEngine:
             return True
         return False
 
+    def trickle_bps(self, round: int) -> float:
+        """Serving-side trickle rate at ``round`` (0.0 outside every
+        configured ``trickle_windows`` entry for this peer)."""
+        cfg = self.config
+        if any(
+            p == self.peer and start <= round < stop
+            for p, start, stop in cfg.trickle_windows
+        ):
+            return float(cfg.trickle_bytes_per_s)
+        return 0.0
+
+    def accept_delay_s(self, round: int) -> float:
+        """Pre-request accept stall at ``round`` (0.0 outside every
+        configured ``accept_delay_windows`` entry for this peer)."""
+        cfg = self.config
+        if any(
+            p == self.peer and start <= round < stop
+            for p, start, stop in cfg.accept_delay_windows
+        ):
+            return cfg.accept_delay_ms / 1000.0
+        return 0.0
+
     def plan(self, round: int) -> FaultPlan:
         if self.down(round):
             return FaultPlan(kind="down")
@@ -190,6 +232,18 @@ class ChaosEngine:
                 if chaos_draw(cfg.seed, round, self.peer, tag) < prob:
                     byz = kind
                     break
+        stall_s = 0.0
+        if cfg.stall_probability > 0.0 and (
+            chaos_draw(cfg.seed, round, self.peer, _KIND_STALL)
+            < cfg.stall_probability
+        ):
+            # Jittered stall: the length is its own threefry draw, so a
+            # fixed seed replays the identical stall schedule.
+            stall_s = (
+                chaos_draw(cfg.seed, round, self.peer, _KIND_STALL_LEN)
+                * cfg.stall_ms_max
+                / 1000.0
+            )
         plan = FaultPlan(
             kind=wire_kind,
             delay_s=cfg.delay_ms / 1000.0,
@@ -197,6 +251,9 @@ class ChaosEngine:
             byzantine=byz,
             byz_scale=cfg.byzantine_scale_factor,
             byz_replay_age=cfg.byzantine_replay_age,
+            trickle_bps=self.trickle_bps(round),
+            stall_s=stall_s,
+            accept_delay_s=self.accept_delay_s(round),
         )
         with self._lock:
             if len(self._cache) > 64:  # bound memory on long soaks
@@ -270,6 +327,37 @@ def byzantine_frame(
     return payload[: _HDR.size] + body + trailer
 
 
+def _send_paced(conn, data: bytes, bps: float) -> None:
+    """Serve ``data`` at ``bps`` bytes/second: small chunks, fixed
+    pauses.  The chunk is sized to ~50 ms of budget (floored at 1 byte,
+    capped at 4 KiB) so even tiny frames actually experience the rate
+    instead of leaving in one burst."""
+    step = max(1, min(4096, int(bps * 0.05)))
+    pause = step / bps
+    for off in range(0, len(data), step):
+        conn.sendall(data[off : off + step])
+        time.sleep(pause)
+
+
+def _send_shaped(
+    conn, data: bytes, trickle_bps: float, stall_s: float
+) -> None:
+    """The flowctl-chaos serving shape: optional jittered mid-frame
+    stall (bytes flow, then freeze, then flow — precisely the pattern
+    the fetcher must classify ``slow``, never ``timeout``), then the
+    remainder at the trickle rate (or in one burst when no trickle
+    window is active)."""
+    if stall_s > 0.0 and len(data) > 1:
+        cut = max(1, len(data) // 3)
+        conn.sendall(data[:cut])
+        time.sleep(stall_s)
+        data = data[cut:]
+    if trickle_bps > 0.0:
+        _send_paced(conn, data, trickle_bps)
+    else:
+        conn.sendall(data)
+
+
 class ChaosPeerServer:
     """A :class:`~dpwa_tpu.parallel.tcp.PeerServer` that injects the
     engine's fault plan into every served connection.
@@ -278,7 +366,9 @@ class ChaosPeerServer:
     fault injection needs per-connection control of the serve loop.
     ``TcpTransport`` selects this wrapper when ``chaos.enabled``."""
 
-    def __init__(self, host: str, port: int, engine: ChaosEngine):
+    def __init__(
+        self, host: str, port: int, engine: ChaosEngine, flowctl=None
+    ):
         from dpwa_tpu.parallel import tcp as _tcp
 
         self.engine = engine
@@ -293,7 +383,7 @@ class ChaosPeerServer:
             def _handle(self, conn):
                 outer._serve_with_faults(self, conn)
 
-        self._srv = _Server(host, port)
+        self._srv = _Server(host, port, flowctl=flowctl)
         self.port = self._srv.port
         # Relay probes from this node honor the injected partition too:
         # a relayer inside our component cannot reach a suspect across
@@ -317,12 +407,24 @@ class ChaosPeerServer:
     def publish_state(self, blob: bytes) -> None:
         self._srv.publish_state(blob)
 
+    @property
+    def admission(self):
+        """The wrapped server's admission controller (flowctl snapshot
+        hook — the transport reads counters through this)."""
+        return self._srv.admission
+
     def _serve_with_faults(self, srv, conn) -> None:
         from dpwa_tpu.parallel.tcp import (
             _RELAY_REQ, _REQ, _STATE_REQ, _STATE_REQ_BODY, _recv_exact,
         )
 
         plan = self.engine.plan(self._round)
+        if plan.accept_delay_s > 0.0:
+            # Accept-delay window: the handler sits on the accepted
+            # connection before even reading the request — the fetcher's
+            # cumulative deadline ticks with NOTHING received (the
+            # pure-timeout classification, vs. trickle's slow).
+            time.sleep(plan.accept_delay_s)
         if plan.kind in ("down", "drop"):
             return  # caller closes: the fetcher sees a reset/short read
         req = _recv_exact(conn, len(_REQ))
@@ -362,18 +464,18 @@ class ChaosPeerServer:
             )
         if plan.kind == "delay":
             time.sleep(plan.delay_s)
-            conn.sendall(payload)
+            _send_shaped(conn, payload, plan.trickle_bps, plan.stall_s)
             return
         if plan.kind == "throttle":
-            step = 4096
-            pause = step / plan.throttle_bps
-            for off in range(0, len(payload), step):
-                conn.sendall(payload[off : off + step])
-                time.sleep(pause)
+            # A trickle window outranks the drawn throttle rate: the
+            # window models a persistently-overloaded box, the draw a
+            # transient slow serve.
+            bps = plan.trickle_bps or plan.throttle_bps
+            _send_shaped(conn, payload, bps, plan.stall_s)
             return
         mutated = mutate_frame(payload, plan.kind)
         if mutated is not None:
-            conn.sendall(mutated)
+            _send_shaped(conn, mutated, plan.trickle_bps, plan.stall_s)
 
     def _replay_frame(self, current: bytes, age: int) -> bytes:
         """The newest banked frame at least ``age`` rounds stale (falling

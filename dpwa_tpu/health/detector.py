@@ -52,9 +52,22 @@ class Outcome:
     #   guard, but failed trust screening (dpwa_tpu.trust): statistically
     #   anomalous vs. the accepted-exchange baseline, anti-aligned, or a
     #   stale replay — finite byzantine content the guard cannot see
+    BUSY = "busy"  # peer explicitly shed the request with a DPWB busy
+    #   frame (dpwa_tpu.flowctl admission control) — alive and honest,
+    #   just loaded; old readers see the short frame as a SHORT_READ
+    SLOW = "slow"  # the adaptive deadline lapsed while payload bytes
+    #   were STILL FLOWING — a straggling-but-alive peer, distinct from
+    #   TIMEOUT (zero bytes: the peer or path is plain dead/hung)
 
-    FAILURES = (TIMEOUT, REFUSED, SHORT_READ, CORRUPT, POISONED, UNTRUSTED)
+    FAILURES = (
+        TIMEOUT, REFUSED, SHORT_READ, CORRUPT, POISONED, UNTRUSTED,
+        BUSY, SLOW,
+    )
     ALL = (SUCCESS,) + FAILURES
+    # Load signals, not death signals: evidence of these soft outcomes
+    # DEGRADES a peer (scheduler soft-deprioritization) but never
+    # quarantines it — see dpwa_tpu.health.scoreboard.
+    SOFT = (BUSY, SLOW)
 
 
 # Evidence added to the suspicion score per failure, by kind.  A refused
@@ -66,7 +79,11 @@ class Outcome:
 # poisoned payload (clean frame, sick contents) is as damning as a
 # corrupt one: merging it would actively damage the local replica; an
 # untrusted payload (finite but byzantine content) is the same class of
-# harm, caught one layer later.
+# harm, caught one layer later.  Busy/slow are LOAD evidence, not death
+# evidence — weight 0.25 so a loaded-but-honest peer is deprioritized
+# slowly (8 soft failures to cross the default 2.0 threshold) and, per
+# the scoreboard's soft-degrade rule, lands in DEGRADED rather than
+# QUARANTINED when it does.
 DEFAULT_FAILURE_WEIGHTS: Mapping[str, float] = {
     Outcome.TIMEOUT: 1.0,
     Outcome.REFUSED: 1.0,
@@ -74,6 +91,8 @@ DEFAULT_FAILURE_WEIGHTS: Mapping[str, float] = {
     Outcome.CORRUPT: 1.5,
     Outcome.POISONED: 1.5,
     Outcome.UNTRUSTED: 1.5,
+    Outcome.BUSY: 0.25,
+    Outcome.SLOW: 0.25,
 }
 
 

@@ -13,11 +13,13 @@ peers).  Enabled via ``health.healthz_port`` in the YAML config
 
 ``/membership`` serves just the snapshot's membership sub-document
 (incarnation, component, partition state — present when the epidemic
-membership plane is enabled) and ``/trust`` the trust sub-document
+membership plane is enabled), ``/trust`` the trust sub-document
 (per-peer trust scores, verdicts, baseline fill — present when the
-content-trust plane is enabled); every other path gets the full
-snapshot — the endpoint is a liveness/introspection hook, not a
-general router."""
+content-trust plane is enabled), and ``/flowctl`` the flow-control
+sub-document (per-peer adaptive deadlines, hedge/busy counters, serving
+admission sheds — present when the flowctl plane is enabled); every
+other path gets the full snapshot — the endpoint is a
+liveness/introspection hook, not a general router."""
 
 from __future__ import annotations
 
@@ -79,6 +81,10 @@ class HealthzServer:
                     elif b" /trust" in request_line:
                         doc = doc.get("trust") or {
                             "error": "trust disabled"
+                        }
+                    elif b" /flowctl" in request_line:
+                        doc = doc.get("flowctl") or {
+                            "error": "flowctl disabled"
                         }
                     body = json.dumps(doc).encode()
                 except Exception:  # snapshot must never kill the endpoint

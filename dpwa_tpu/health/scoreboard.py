@@ -45,6 +45,14 @@ class PeerState:
     HEALTHY = "healthy"
     SUSPECT = "suspect"  # nonzero suspicion, below the quarantine threshold
     QUARANTINED = "quarantined"
+    # Soft-degraded: suspicion crossed the threshold on LOAD evidence
+    # alone (busy/slow outcomes, dpwa_tpu.flowctl).  The peer is alive
+    # and honest, just overloaded — it is deprioritized (excluded from
+    # fallback remaps, fractionally shed as a scheduled partner) but
+    # KEEPS receiving direct fetches under its short adaptive budget, so
+    # success evidence can decay it back out.  Soft evidence never
+    # promotes to QUARANTINED; a hard failure while degraded still does.
+    DEGRADED = "degraded"
 
 
 class Scoreboard:
@@ -74,6 +82,9 @@ class Scoreboard:
         self._quarantines: Dict[int, int] = {}  # lifetime count
         self._quarantined_rounds: Dict[int, int] = {}  # lifetime total
         self._quarantined_at: Dict[int, int] = {}
+        self._degrades: Dict[int, int] = {}  # lifetime soft-degrade count
+        self._degraded_rounds: Dict[int, int] = {}  # lifetime total
+        self._degraded_at: Dict[int, int] = {}
         self._probe_attempts: Dict[int, int] = {}
         self._probe_successes: Dict[int, int] = {}
         self._round = 0  # highest round observed (fallback clock)
@@ -98,15 +109,33 @@ class Scoreboard:
         with self._lock:
             r = self._clock(round)
             suspicion = self.detector.observe(peer, outcome, latency_s, nbytes)
-            state = self._state.get(peer, PeerState.HEALTHY)
-            if state != PeerState.QUARANTINED:
-                if suspicion >= self.config.suspicion_threshold:
-                    self._enter_quarantine(peer, r)
-                elif suspicion > 0.0:
-                    self._state[peer] = PeerState.SUSPECT
-                else:
-                    self._state[peer] = PeerState.HEALTHY
+            if self._state.get(peer) != PeerState.QUARANTINED:
+                self._apply_suspicion(peer, outcome, suspicion, r)
             return self._state.get(peer, PeerState.HEALTHY)
+
+    def _apply_suspicion(
+        self, peer: int, outcome: str, suspicion: float, r: int
+    ) -> None:
+        """State transition for a non-quarantined peer (lock held).
+
+        Soft outcomes (busy/slow — load evidence) crossing the threshold
+        DEGRADE the peer instead of quarantining it; so does a success
+        still draining a large soft-suspicion backlog (a degraded peer is
+        the only non-quarantined state whose suspicion can sit above the
+        threshold, so a single success may not clear it).  A hard failure
+        crossing the threshold quarantines as before — degraded or not."""
+        if suspicion >= self.config.suspicion_threshold:
+            if outcome == Outcome.SUCCESS or outcome in Outcome.SOFT:
+                self._enter_degraded(peer, r)
+            else:
+                self._exit_degraded(peer, r)
+                self._enter_quarantine(peer, r)
+        elif suspicion > 0.0:
+            self._exit_degraded(peer, r)
+            self._state[peer] = PeerState.SUSPECT
+        else:
+            self._exit_degraded(peer, r)
+            self._state[peer] = PeerState.HEALTHY
 
     def record_probe(
         self,
@@ -140,12 +169,7 @@ class Scoreboard:
                         self._probe_successes.get(peer, 0) + 1
                     )
                 suspicion = self.detector.observe(peer, outcome)
-                if suspicion >= self.config.suspicion_threshold:
-                    self._enter_quarantine(peer, r)
-                elif suspicion > 0.0:
-                    self._state[peer] = PeerState.SUSPECT
-                else:
-                    self._state[peer] = PeerState.HEALTHY
+                self._apply_suspicion(peer, outcome, suspicion, r)
                 return
             self._settle_quarantined_rounds(peer, r)
             if success:
@@ -165,6 +189,9 @@ class Scoreboard:
         """True when recording ``outcome`` against ``peer`` NOW would
         cross the quarantine threshold — the transport's trigger for
         indirect probing: ask relays *before* the promoting record."""
+        if outcome in Outcome.SOFT:
+            # Load evidence degrades, never quarantines.
+            return False
         weight = DEFAULT_FAILURE_WEIGHTS.get(outcome)
         if weight is None:
             return False
@@ -184,6 +211,7 @@ class Scoreboard:
             if state == PeerState.HEALTHY:
                 return False
             self._settle_quarantined_rounds(peer, r)
+            self._exit_degraded(peer, r)
             self._state[peer] = PeerState.HEALTHY
             self._quarantine_streak[peer] = 0
             rec = self.detector.record(peer)
@@ -199,6 +227,7 @@ class Scoreboard:
             r = self._clock(round)
             if self._state.get(peer) == PeerState.QUARANTINED:
                 return False
+            self._exit_degraded(peer, r)
             self._enter_quarantine(peer, r)
             return True
 
@@ -227,6 +256,14 @@ class Scoreboard:
             self._clock(round)
             return self._state.get(peer) == PeerState.QUARANTINED
 
+    def is_degraded(self, peer: int, round: Optional[int] = None) -> bool:
+        """True while the peer is soft-degraded (load, not death): the
+        flowctl plane fractionally sheds scheduled rounds away from it
+        but keeps fetching it on the rest."""
+        with self._lock:
+            self._clock(round)
+            return self._state.get(peer) == PeerState.DEGRADED
+
     def probe_due(self, peer: int, round: Optional[int] = None) -> bool:
         """True when the backoff has elapsed and a cheap header-only
         probe should decide re-admission."""
@@ -240,13 +277,17 @@ class Scoreboard:
     def healthy_mask(self, round: Optional[int] = None) -> List[bool]:
         """Per-peer eligibility as a fallback fetch target.
 
-        Quarantined peers are excluded until a probe re-admits them; the
-        local node itself is trivially 'healthy' but the remap never
-        selects it anyway."""
+        Quarantined peers are excluded until a probe re-admits them;
+        DEGRADED peers are excluded too — rerouting a failed round's
+        traffic onto an already-overloaded peer would deepen the overload
+        (they still get their own scheduled rounds, minus the shed
+        fraction).  The local node itself is trivially 'healthy' but the
+        remap never selects it anyway."""
         with self._lock:
             self._clock(round)
             return [
-                self._state.get(p) != PeerState.QUARANTINED
+                self._state.get(p)
+                not in (PeerState.QUARANTINED, PeerState.DEGRADED)
                 for p in range(self.n_peers)
             ]
 
@@ -274,6 +315,23 @@ class Scoreboard:
         self._quarantined_at[peer] = r
         self._release_round[peer] = r + backoff
         self.detector.record(peer)  # materialize stats for the snapshot
+
+    def _enter_degraded(self, peer: int, r: int) -> None:
+        """Soft-degrade ``peer`` (lock held); idempotent while degraded."""
+        if self._state.get(peer) != PeerState.DEGRADED:
+            self._degrades[peer] = self._degrades.get(peer, 0) + 1
+            self._degraded_at[peer] = r
+            self._state[peer] = PeerState.DEGRADED
+
+    def _exit_degraded(self, peer: int, r: int) -> None:
+        """Fold a finished degraded window into the lifetime total
+        (lock held; no-op when the peer is not degraded)."""
+        if self._state.get(peer) == PeerState.DEGRADED:
+            start = self._degraded_at.get(peer, r)
+            self._degraded_rounds[peer] = self._degraded_rounds.get(
+                peer, 0
+            ) + max(0, r - start)
+            self._degraded_at[peer] = r
 
     def _settle_quarantined_rounds(self, peer: int, r: int) -> None:
         """Fold the just-finished quarantine window into the lifetime
@@ -314,11 +372,18 @@ class Scoreboard:
                     quarantined_rounds += max(
                         0, r - self._quarantined_at.get(p, r)
                     )
+                degraded_rounds = self._degraded_rounds.get(p, 0)
+                if state == PeerState.DEGRADED:
+                    degraded_rounds += max(
+                        0, r - self._degraded_at.get(p, r)
+                    )
                 info = self.detector.snapshot(p)
                 info.update(
                     state=state,
                     quarantined_rounds=quarantined_rounds,
                     quarantines=self._quarantines.get(p, 0),
+                    degraded_rounds=degraded_rounds,
+                    degrades=self._degrades.get(p, 0),
                     release_round=(
                         self._release_round.get(p)
                         if state == PeerState.QUARANTINED

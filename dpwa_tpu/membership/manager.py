@@ -103,7 +103,11 @@ class MembershipManager:
                 if streak >= self.config.dead_after_quarantines
                 else QUARANTINED
             )
-        if sb_state == PeerState.SUSPECT:
+        if sb_state in (PeerState.SUSPECT, PeerState.DEGRADED):
+            # DEGRADED (load, not death) disseminates as SUSPECT: the
+            # digest carries the suspicion but receivers only ever adopt
+            # QUARANTINED-or-worse claims, so a slow-but-honest peer can
+            # never be quarantined by gossip about its slowness.
             return SUSPECT
         return ALIVE
 

@@ -146,6 +146,10 @@ class MetricsLogger:
         - ``trust`` / ``trust_damped`` / ``trust_rejected`` — the trust
           plane's per-peer EWMA and verdict counters (present only when
           the content-trust plane contributed to the snapshot);
+        - ``deadline_ms`` / ``hedges`` / ``hedge_wins`` / ``busy`` /
+          ``slow`` — the flowctl plane's per-peer adaptive deadline and
+          hedge/soft-outcome counters, plus top-level ``hedge_rate`` and
+          ``shed_total`` (present only when flowctl contributed);
 
         plus attempt/success/quarantine counters.  Obeys ``every`` like
         every other record; written immediately (health snapshots are
@@ -178,6 +182,26 @@ class MetricsLogger:
                 trust_verdict=cols("trust_verdict"),
                 trust_damped=cols("trust_damped"),
                 trust_rejected=cols("trust_rejected"),
+            )
+        flowctl = snapshot.get("flowctl")
+        if flowctl is not None and order:
+            # Flowctl columns ride the same record (absent without the
+            # flow-control plane, keeping earlier records byte-identical).
+            hedges = flowctl.get("hedges", 0)
+            admission = flowctl.get("admission") or {}
+            extra = dict(
+                extra,
+                deadline_ms=cols("deadline_ms"),
+                hedges=cols("hedges"),
+                hedge_wins=cols("hedge_wins"),
+                busy=cols("busy"),
+                slow=cols("slow"),
+                hedge_rate=(
+                    round(flowctl.get("hedge_wins", 0) / hedges, 4)
+                    if hedges
+                    else 0.0
+                ),
+                shed_total=admission.get("shed_total", 0),
             )
         self.log(
             step,

@@ -131,6 +131,18 @@ def relay_draw(seed, step, me, probe_slot: int, n_candidates: int):
     )
 
 
+def degrade_shed_draw(seed, step, me):
+    """Uniform [0, 1) deciding whether THIS round's exchange with a
+    soft-DEGRADED scheduled partner is shed to a fallback (tag 8).
+
+    Compared against ``flowctl.degrade_shed_fraction``: below it, the
+    round remaps away from the overloaded peer; at or above it, the
+    fetch proceeds under the peer's (short) adaptive deadline so recovery
+    evidence keeps flowing.  Keyed on ``(seed, step, me)`` like
+    :func:`fallback_draw`, so shed decisions replay bit-identically."""
+    return float(jax.random.uniform(_pair_key(seed, step, me, 8)))
+
+
 def heal_draw(seed, step, me, n_candidates: int):
     """Index of the reconciliation donor drawn from a returning
     partition component at heal time (tag 7).
@@ -170,14 +182,15 @@ def warm_control_draws(seed: int = 0, me: int = 0) -> None:
     int(donor_draw(seed, 0, me, 2))
     int(relay_draw(seed, 0, me, 0, 2))
     int(heal_draw(seed, 0, me, 2))
+    float(degrade_shed_draw(seed, 0, me))
     float(chaos_draw(seed, 0, me, 0))
     _CONTROL_DRAWS_WARM = True
 
 
 # Chaos fault-kind tags start at 16: far clear of the control-plane tags
 # (0 participation, 1 fault, 2 pool, 3 fallback, 4 backoff jitter,
-# 5 bootstrap donor, 6 relay probe, 7 heal donor), so new control draws
-# can claim 8..15 without colliding with fault kinds.
+# 5 bootstrap donor, 6 relay probe, 7 heal donor, 8 degrade shed), so
+# new control draws can claim 9..15 without colliding with fault kinds.
 CHAOS_TAG_BASE = 16
 
 

@@ -39,7 +39,13 @@ from typing import Optional, Tuple
 import numpy as np
 
 from dpwa_tpu import native
-from dpwa_tpu.config import DEFAULT_MIN_WIRE_MB_PER_S, DpwaConfig
+from dpwa_tpu.config import (
+    DEFAULT_MIN_WIRE_MB_PER_S,
+    DpwaConfig,
+    FlowctlConfig,
+)
+# flowctl imports config + detector only — no cycle with this module.
+from dpwa_tpu.flowctl import AdmissionController, DeadlineEstimator
 # detector/scoreboard import config + schedules only — no cycle; chaos
 # (which imports THIS module) is loaded lazily inside TcpTransport.
 from dpwa_tpu.health.detector import Outcome
@@ -106,10 +112,34 @@ _RELAY_OUTCOMES = (
     Outcome.REFUSED,
     Outcome.SHORT_READ,
     Outcome.CORRUPT,
+    # Appended (code 5) by the flowctl plane: a relay may find the target
+    # alive but shedding.  Old readers reject code 5 as corrupt, which is
+    # the safe direction — they never vouch for a shedding peer.
+    Outcome.BUSY,
 )
 # Server-side clamp on the relayed probe budget: a malicious requester
 # must not be able to pin a relay's Rx thread with a huge timeout.
 _MAX_RELAY_TIMEOUT_MS = 500
+
+# BUSY shed frame (flowctl admission, dpwa_tpu/flowctl/): when the Rx
+# server refuses work — connection cap, token bucket, in-flight-bytes
+# ceiling — it answers this tiny frame instead of silently dropping:
+#   magic(4s)="DPWB" version(B) retry_hint_ms(H)
+# 7 bytes, deliberately SHORTER than the 30-byte _HDR: an old fetcher
+# blocked in its header read hits EOF when the server closes and lands
+# in its existing short_read classification (wire compatible both
+# directions), while a flowctl-aware fetcher peeks the 4-byte magic,
+# reads the remaining 3, and records the low-weight ``busy`` outcome
+# that soft-degrades the peer instead of quarantining it.
+_BUSY_MAGIC = b"DPWB"
+_BUSY_HDR = struct.Struct("<4sBH")
+
+
+def _busy_frame(retry_hint_ms: int = 0) -> bytes:
+    """The DPWB shed reply: explicit 'loaded, come back later'."""
+    return _BUSY_HDR.pack(
+        _BUSY_MAGIC, 1, min(max(int(retry_hint_ms), 0), 0xFFFF)
+    )
 # Default deadline floor for the payload read (bytes/s): the fetch
 # budget grows at this rate per byte RECEIVED, so a healthy peer
 # streaming a large replica is never killed by a fixed timeout_ms sized
@@ -127,6 +157,7 @@ def _recv_exact(
     n: int,
     deadline: Optional[float] = None,
     per_byte_s: float = 0.0,
+    progress: Optional[list] = None,
 ) -> bytes:
     """Read exactly ``n`` bytes.
 
@@ -141,7 +172,13 @@ def _recv_exact(
     the advertised size): a healthy stream earns budget as it flows,
     while a peer that advertised a huge payload and then stalls is still
     dropped at the base deadline — trusting the advertisement up front
-    would let a malicious 16 GiB header pin the fetch for minutes."""
+    would let a malicious 16 GiB header pin the fetch for minutes.
+
+    ``progress`` (a single-cell ``[int]`` list) accumulates the bytes
+    received across a SEQUENCE of reads, surviving the timeout this
+    function raises — the caller's classifier uses it to tell a peer
+    that streamed something and lapsed (``slow``) from one that never
+    answered at all (``timeout``)."""
     buf = bytearray()
     while len(buf) < n:
         if deadline is not None:
@@ -155,6 +192,8 @@ def _recv_exact(
         if not chunk:
             raise ConnectionError("peer closed mid-message")
         buf += chunk
+        if progress is not None:
+            progress[0] += len(chunk)
     return bytes(buf)
 
 
@@ -213,11 +252,26 @@ class PeerServer:
     # partitions constrain relays exactly like real ones).
     relay_guard = None
 
-    def __init__(self, host: str, port: int):
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        flowctl: Optional[FlowctlConfig] = None,
+    ):
         self._lock = threading.Lock()
         self._payload: Optional[bytes] = None  # pre-framed header+data
         self._state: Optional[bytes] = None  # serialized bootstrap state
         self._state_gen = 0
+        # Serving-side flow control (dpwa_tpu/flowctl/): connection cap,
+        # per-remote token pacing, in-flight-bytes ceiling, slow-loris
+        # eviction.  Defaults apply when no config is passed; admission
+        # is skipped entirely when the block is disabled.
+        self.flowctl = flowctl if flowctl is not None else FlowctlConfig()
+        self.admission = (
+            AdmissionController(self.flowctl)
+            if self.flowctl.enabled
+            else None
+        )
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
@@ -262,26 +316,94 @@ class PeerServer:
             return
         while not self._stop.is_set():
             try:
-                conn, _ = self._sock.accept()
+                conn, addr = self._sock.accept()
             except socket.timeout:
                 continue
             except OSError:
                 break
+            host = addr[0] if addr else ""
+            if self.admission is not None:
+                ok, retry_ms = self.admission.admit(host)
+                if not ok:
+                    # Shed EXPLICITLY: the tiny DPWB frame tells a
+                    # flowctl-aware fetcher "loaded, retry later" (low-
+                    # weight busy outcome); an old fetcher sees EOF short
+                    # of a full header and classifies its existing reset
+                    # path.  Either way the accept loop stays free.
+                    self._shed(conn, retry_ms)
+                    continue
+            worker = threading.Thread(
+                target=self._conn_worker,
+                args=(conn, host),
+                name=f"dpwa-rx-conn:{self.port}",
+                daemon=True,
+            )
+            worker.start()
+
+    def _shed(self, conn: socket.socket, retry_ms: int) -> None:
+        """Best-effort busy reply + close (never blocks the accept loop)."""
+        try:
+            conn.settimeout(0.5)
+            conn.sendall(_busy_frame(retry_ms))
+        except OSError:
+            pass
+        finally:
             try:
-                conn.settimeout(5.0)
-                self._handle(conn)
+                conn.close()
             except OSError:
                 pass
-            finally:
+
+    def _conn_worker(self, conn: socket.socket, host: str) -> None:
+        """One admitted connection, on its own thread: under admission
+        the handler count is bounded by ``max_connections``, so thread-
+        per-connection cannot run away — and a relay probe serving
+        synchronously no longer pins every other fetcher behind it."""
+        try:
+            # Handler budget derived from the flowctl block (one source
+            # of truth with the request-read eviction deadline) instead
+            # of the old hard-coded 5.0 s.
+            conn.settimeout(self.flowctl.request_timeout_ms / 1000.0)
+            self._handle(conn)
+        except OSError:
+            pass
+        finally:
+            if self.admission is not None:
+                self.admission.release(host)
+            try:
                 conn.close()
+            except OSError:
+                pass
 
     def _handle(self, conn: socket.socket) -> None:
         """Serve one accepted connection.  Split out of the accept loop
         so the chaos harness (health/chaos.py) can wrap per-connection
         behavior without duplicating the listener."""
-        req = _recv_exact(conn, len(_REQ))
+        fc = self.flowctl
+        deadline = per_byte = None
+        if fc.enabled:
+            # Slow-loris discipline on the REQUEST read: cumulative
+            # deadline, extended per byte received at the minimum ingest
+            # rate — a client trickling its request is evicted, not
+            # waited on (the same _recv_exact mechanics the fetch side
+            # uses against trickling servers).
+            deadline = time.monotonic() + fc.request_timeout_ms / 1000.0
+            per_byte = (
+                1.0 / fc.min_ingest_bytes_per_s
+                if fc.min_ingest_bytes_per_s > 0
+                else 0.0
+            )
+        body = None
+        try:
+            req = _recv_exact(conn, len(_REQ), deadline, per_byte or 0.0)
+            if req == _STATE_REQ:
+                body = _recv_exact(
+                    conn, _STATE_REQ_BODY.size, deadline, per_byte or 0.0
+                )
+        except socket.timeout:
+            if self.admission is not None:
+                self.admission.note_eviction()
+            return
         if req == _STATE_REQ:
-            body = _recv_exact(conn, _STATE_REQ_BODY.size)
             offset, max_chunk = _STATE_REQ_BODY.unpack(body)
             self._handle_state(conn, offset, max_chunk)
             return
@@ -290,10 +412,28 @@ class PeerServer:
             return
         if req != _REQ:
             return
+        self._serve_blob(conn)
+
+    def _serve_blob(self, conn: socket.socket) -> None:
+        """Send the published frame under the in-flight-bytes ceiling."""
         with self._lock:
             payload = self._payload
-        if payload is not None:
+        if payload is None:
+            return
+        adm = self.admission
+        if adm is not None and not adm.reserve_bytes(len(payload)):
+            # Ceiling crossed: shed this send explicitly rather than
+            # queue unbounded payload bytes behind slow readers.
+            try:
+                conn.sendall(_busy_frame(self.flowctl.busy_retry_ms))
+            except OSError:
+                pass
+            return
+        try:
             conn.sendall(payload)
+        finally:
+            if adm is not None:
+                adm.release_bytes(len(payload))
 
     def _handle_relay(self, conn: socket.socket) -> None:
         """Serve one relayed header probe: probe the requested target
@@ -390,11 +530,16 @@ class NativePeerServer:
         self._srv.close()
 
 
-def make_peer_server(host: str, port: int):
+def make_peer_server(
+    host: str, port: int, flowctl: Optional[FlowctlConfig] = None
+):
     """Native Rx server when the toolchain allows, Python thread otherwise.
 
     ``DPWA_NATIVE_RX=0`` forces the Python server (debugging / parity
-    tests)."""
+    tests).  ``flowctl`` configures the Python server's admission plane;
+    the native C++ loop speaks only the blob protocol and ignores it
+    (``TcpTransport`` forces the Python server when ``flowctl.enabled``
+    so admission is actually in force)."""
     import os
 
     if os.environ.get("DPWA_NATIVE_RX", "1") != "0":
@@ -402,7 +547,7 @@ def make_peer_server(host: str, port: int):
             return NativePeerServer(host, port)
         except (RuntimeError, OSError):
             pass  # no toolchain / bind raced: identical Python fallback
-    return PeerServer(host, port)
+    return PeerServer(host, port, flowctl=flowctl)
 
 
 def _recv_trailing(
@@ -455,6 +600,7 @@ def fetch_blob_full(
     timeout_ms: int,
     min_bandwidth_bps: float = _MIN_WIRE_BANDWIDTH,
     want_digest: bool = False,
+    sock_box: Optional[list] = None,
 ) -> Tuple[
     Optional[Tuple[np.ndarray, float, float]], str, float, int,
     Optional[bytes],
@@ -470,12 +616,23 @@ def fetch_blob_full(
     is one of :class:`dpwa_tpu.health.detector.Outcome`:
 
     - ``refused`` — the connect itself failed (peer process gone);
-    - ``timeout`` — the cumulative deadline expired (connect, request,
-      header, or a payload stream below the bandwidth floor);
+    - ``timeout`` — the cumulative deadline expired with NOTHING received
+      (connect, request, or a header that never started);
+    - ``slow`` — the cumulative deadline expired with bytes already
+      flowing: the peer is alive and serving, just not fast enough for
+      the budget (low detector weight — soft-degrades, never
+      quarantines);
+    - ``busy`` — the peer answered the tiny ``DPWB`` shed frame: loaded
+      but honest (same low weight as ``slow``);
     - ``short_read`` — the peer closed or reset mid-frame;
     - ``corrupt`` — bad magic/version/dtype, oversize advertisement, or
       an int8 payload that failed to decode;
     - ``success`` — a full, valid frame.
+
+    ``sock_box`` (a plain list) receives the connected socket as soon as
+    it exists: a hedging caller running this fetch on a thread closes it
+    to cancel the losing leg promptly instead of waiting out its
+    deadline.
 
     ``timeout_ms`` is a CUMULATIVE wall-clock budget enforced via a
     monotonic deadline threaded through :func:`_recv_exact` — not a
@@ -490,6 +647,10 @@ def fetch_blob_full(
     t0 = time.monotonic()
     deadline = t0 + timeout_ms / 1000.0
     nbytes_rx = 0
+    # Total bytes received across header + payload, surviving a raised
+    # timeout: >0 at deadline lapse means the peer was STREAMING, which
+    # classifies as ``slow`` (soft evidence) rather than ``timeout``.
+    rx = [0]
     try:
         sock = socket.create_connection(
             (host, port), timeout=timeout_ms / 1000.0
@@ -500,6 +661,8 @@ def fetch_blob_full(
         # Refused, unreachable, reset during handshake: no peer process
         # is answering on that port.
         return None, Outcome.REFUSED, time.monotonic() - t0, 0, None
+    if sock_box is not None:
+        sock_box.append(sock)
     try:
         with sock:
             # The request send draws from the SAME cumulative budget as
@@ -514,7 +677,24 @@ def fetch_blob_full(
                 )
             sock.settimeout(remaining)
             sock.sendall(_REQ)
-            raw = _recv_exact(sock, _HDR.size, deadline)
+            # Magic peek: 4 bytes decide DPWB (busy shed) vs DPWA (blob
+            # header).  An old server never sends DPWB, so the peek is
+            # just the header's first read split in two.
+            peek = _recv_exact(sock, 4, deadline, progress=rx)
+            if peek == _BUSY_MAGIC:
+                rest = _recv_exact(
+                    sock, _BUSY_HDR.size - 4, deadline, progress=rx
+                )
+                _m, bversion, _retry_ms = _BUSY_HDR.unpack(peek + rest)
+                if bversion != 1:
+                    return (
+                        None, Outcome.CORRUPT, time.monotonic() - t0, 0,
+                        None,
+                    )
+                return None, Outcome.BUSY, time.monotonic() - t0, 0, None
+            raw = peek + _recv_exact(
+                sock, _HDR.size - 4, deadline, progress=rx
+            )
             magic, version, code, clock, loss, nbytes = _HDR.unpack(raw)
             if magic != _MAGIC or version != 1 or (
                 code not in _DTYPES and code != _INT8_CHUNKED
@@ -523,7 +703,8 @@ def fetch_blob_full(
             if nbytes > _MAX_BLOB:
                 return None, Outcome.CORRUPT, time.monotonic() - t0, 0, None
             data = _recv_exact(
-                sock, nbytes, deadline, 1.0 / min_bandwidth_bps
+                sock, nbytes, deadline, 1.0 / min_bandwidth_bps,
+                progress=rx,
             )
             nbytes_rx = len(data)
             if code == _INT8_CHUNKED:
@@ -542,7 +723,15 @@ def fetch_blob_full(
                         time.monotonic() - t0, nbytes_rx, None,
                     )
             else:
-                vec = np.frombuffer(data, dtype=_DTYPES[code]).copy()
+                try:
+                    vec = np.frombuffer(data, dtype=_DTYPES[code]).copy()
+                except ValueError:
+                    # Payload length not a multiple of the advertised
+                    # dtype's itemsize: malformed frame.
+                    return (
+                        None, Outcome.CORRUPT,
+                        time.monotonic() - t0, nbytes_rx, None,
+                    )
             # Optional epidemic-membership trailer: attempted only after
             # a fully valid payload (a frame that failed above carries
             # no trustworthy trailer), tolerant of its absence.
@@ -552,7 +741,10 @@ def fetch_blob_full(
                 time.monotonic() - t0, nbytes_rx, digest,
             )
     except socket.timeout:
-        return None, Outcome.TIMEOUT, time.monotonic() - t0, nbytes_rx, None
+        # Bytes flowed and the budget still lapsed: a live-but-slow peer
+        # (trickle, overload) — soft evidence, not a death mark.
+        outcome = Outcome.SLOW if rx[0] > 0 else Outcome.TIMEOUT
+        return None, outcome, time.monotonic() - t0, nbytes_rx, None
     except (ConnectionError, OSError):
         # Accepted, then closed/reset mid-frame: the peer process is
         # alive enough to accept but served a broken stream.
@@ -750,7 +942,17 @@ def probe_header_classified(
                 return Outcome.TIMEOUT, None
             sock.settimeout(remaining)
             sock.sendall(_REQ)
-            raw = _recv_exact(sock, _HDR.size, deadline)
+            peek = _recv_exact(sock, 4, deadline)
+            if peek == _BUSY_MAGIC:
+                # A shedding server answers probes with DPWB too: the
+                # peer is ALIVE but loaded — the caller records the
+                # low-weight busy outcome, never a hard failure.
+                rest = _recv_exact(sock, _BUSY_HDR.size - 4, deadline)
+                _m, bversion, _retry = _BUSY_HDR.unpack(peek + rest)
+                if bversion != 1:
+                    return Outcome.CORRUPT, None
+                return Outcome.BUSY, None
+            raw = peek + _recv_exact(sock, _HDR.size - 4, deadline)
             magic, version, code, clock, _loss, nbytes = _HDR.unpack(raw)
             if (
                 magic != _MAGIC
@@ -938,10 +1140,17 @@ class _OverlappedExchange:
             # exactly once: the deadline already folds it in, so the
             # slack term is a fixed 1 s for thread scheduling, not a
             # second copy of the timeout.  A timed-out join skips the
-            # round like any other failed fetch.
+            # round like any other failed fetch.  With flowctl enabled
+            # the fetch may run TWO sequential budgets (primary deadline
+            # up to flowctl.max_ms, then a hedge leg with its own), so
+            # the backstop doubles the larger of the two ceilings.
+            fc = self._t.config.flowctl
+            base_s = self._t.config.protocol.timeout_ms / 1000.0
+            if fc.enabled:
+                base_s = 2.0 * max(base_s, fc.max_ms / 1000.0)
             self._thread.join(
                 timeout=1.0
-                + self._t.config.protocol.timeout_ms / 1000.0
+                + base_s
                 + self._expected_nbytes
                 / (self._t.config.protocol.min_wire_mb_per_s * 1e6)
             )
@@ -1029,6 +1238,17 @@ class TcpTransport:
         if self._wire_bf16 and ml_dtypes is None:  # pragma: no cover
             raise RuntimeError("wire_dtype bf16 requires ml_dtypes")
         spec = config.nodes[self.me]
+        # Fetcher-side flow control: the per-peer latency estimator that
+        # derives adaptive cumulative deadlines and hedge launch points.
+        # None when the flowctl block is disabled — every fetch then
+        # runs on the static protocol.timeout_ms exactly as before.
+        self._estimator: Optional[DeadlineEstimator] = (
+            DeadlineEstimator(
+                config.flowctl, timeout_ms=config.protocol.timeout_ms
+            )
+            if config.flowctl.enabled
+            else None
+        )
         # Kept when chaos is on so the FETCHING side can honor injected
         # partitions (the serving side cannot know who is connecting).
         self._chaos_engine = None
@@ -1040,18 +1260,26 @@ class TcpTransport:
 
             self._chaos_engine = ChaosEngine(config.chaos, self.me)
             self.server = ChaosPeerServer(
-                spec.host, spec.port, self._chaos_engine
+                spec.host, spec.port, self._chaos_engine,
+                flowctl=config.flowctl,
             )
-        elif config.recovery.enabled or (
-            config.health.enabled and config.membership.enabled
+        elif (
+            config.recovery.enabled
+            or config.flowctl.enabled
+            or (config.health.enabled and config.membership.enabled)
         ):
-            # STATE serving (peer-assisted bootstrap) and the RELAY
-            # probe verb (indirect membership probing) live in the
+            # STATE serving (peer-assisted bootstrap), the RELAY probe
+            # verb (indirect membership probing), and flowctl admission
+            # (DPWB shedding, token pacing, loris eviction) live in the
             # Python Rx server only — the native C++ loop speaks just
             # the blob protocol.  Same forcing rationale as chaos.
-            self.server = PeerServer(spec.host, spec.port)
+            self.server = PeerServer(
+                spec.host, spec.port, flowctl=config.flowctl
+            )
         else:
-            self.server = make_peer_server(spec.host, spec.port)
+            self.server = make_peer_server(
+                spec.host, spec.port, flowctl=config.flowctl
+            )
         self._ports = {
             i: (n.host, n.port) for i, n in enumerate(config.nodes)
         }
@@ -1172,9 +1400,10 @@ class TcpTransport:
         timeout_ms: Optional[int] = None,
         step: Optional[int] = None,
     ) -> Optional[Tuple[np.ndarray, float, float]]:
-        host, port = self._ports[peer_index]
         if timeout_ms is None:
             timeout_ms = self.config.protocol.timeout_ms
+        est = self._estimator
+        hedged, hedge_winner = False, None
         if self._link_blocked(peer_index):
             # Injected partition, fetcher side: the chaos harness blocks
             # this directed link, so no socket is even opened — the
@@ -1183,7 +1412,21 @@ class TcpTransport:
             got, outcome, latency_s, nbytes, digest = (
                 None, Outcome.REFUSED, 0.0, 0, None,
             )
+        elif est is not None:
+            # Flowctl path: the estimator's adaptive cumulative deadline
+            # (falling back to timeout_ms while cold) plus at most one
+            # hedged retry to the schedule's fallback partner once the
+            # quantile budget lapses.  ``peer_index`` may come back as
+            # the FALLBACK peer — everything recorded below (trust,
+            # guard, scoreboard, estimator) is then charged to the peer
+            # whose payload actually merges; the losing leg was already
+            # recorded inside _hedged_fetch.
+            (
+                peer_index, got, outcome, latency_s, nbytes, digest,
+                hedged, hedge_winner,
+            ) = self._hedged_fetch(peer_index, step, timeout_ms)
         else:
+            host, port = self._ports[peer_index]
             got, outcome, latency_s, nbytes, digest = fetch_blob_full(
                 host, port, timeout_ms,
                 min_bandwidth_bps=(
@@ -1238,6 +1481,9 @@ class TcpTransport:
             "peer": peer_index, "outcome": outcome,
             "latency_s": latency_s, "nbytes": nbytes,
         }
+        if hedged:
+            self.last_fetch["hedged"] = True
+            self.last_fetch["hedge_winner"] = hedge_winner
         if reason is not None:
             self.last_fetch["poison_reason"] = reason
         if trust_info is not None:
@@ -1273,7 +1519,214 @@ class TcpTransport:
                 peer_index, outcome,
                 latency_s=latency_s, nbytes=nbytes, round=step,
             )
+        if est is not None:
+            # The estimator feeds on the FINAL classified outcome (after
+            # guard/trust screening): a poisoned success must not teach
+            # the deadline that the peer is healthy-fast.
+            est.observe(
+                peer_index, outcome, latency_s=latency_s, nbytes=nbytes
+            )
         return got
+
+    def _fetch_leg(
+        self, peer: int, deadline_ms: float, box: list, sock_box: list
+    ) -> None:
+        """One fetch leg of a (possibly hedged) flowctl fetch, run on a
+        thread: appends the full 5-tuple to ``box``; ``sock_box`` lets
+        the racing side cancel this leg by closing its socket."""
+        host, port = self._ports[peer]
+        box.append(
+            fetch_blob_full(
+                host, port, int(deadline_ms),
+                min_bandwidth_bps=(
+                    self.config.protocol.min_wire_mb_per_s * 1e6
+                ),
+                want_digest=self.membership is not None,
+                sock_box=sock_box,
+            )
+        )
+
+    def _hedge_fallback(self, peer: int, step: int) -> Optional[int]:
+        """The deterministic hedge target: the schedule's fallback draw
+        over currently-healthy peers (the SAME draw a quarantine remap
+        would make this round), or None when no distinct healthy
+        candidate exists."""
+        n = len(self.config.nodes)
+        if self.scoreboard is not None:
+            mask = self.scoreboard.healthy_mask(step)
+        else:
+            mask = [True] * n
+        fallback = self.schedule.remap_partner(step, self.me, peer, mask)
+        if (
+            fallback == self.me
+            or fallback == peer
+            or self._link_blocked(fallback)
+        ):
+            return None
+        return int(fallback)
+
+    @staticmethod
+    def _close_leg(sock_box: list) -> None:
+        for s in sock_box:
+            try:
+                s.close()
+            except OSError:
+                pass
+
+    @staticmethod
+    def _leg_result(box: list, elapsed: float) -> tuple:
+        """A leg's 5-tuple result; a leg that died without reporting
+        (should not happen — fetch_blob_full classifies every failure)
+        degrades to a short_read instead of crashing the round."""
+        if box:
+            return box[0]
+        return None, Outcome.SHORT_READ, elapsed, 0, None
+
+    def _record_loser(
+        self,
+        peer: int,
+        result: Optional[tuple],
+        cancelled: bool,
+        latency_s: float,
+        step: Optional[int],
+    ) -> None:
+        """Feed the LOSING leg of a hedge race to the scoreboard and
+        estimator.  A leg we cancelled by closing its socket surfaces a
+        short_read/timeout ARTIFACT of our own close — recording that
+        hard evidence would walk an honest slow peer into quarantine, so
+        a cancelled leg records the low-weight ``slow`` outcome instead.
+        A leg that genuinely finished records its real outcome."""
+        if cancelled or result is None:
+            outcome, lat, nbytes = Outcome.SLOW, latency_s, 0
+        else:
+            _got, outcome, lat, nbytes, _digest = result
+        if self.scoreboard is not None:
+            self.scoreboard.record(
+                peer, outcome, latency_s=lat, nbytes=nbytes, round=step
+            )
+        if self._estimator is not None:
+            self._estimator.observe(
+                peer, outcome, latency_s=lat, nbytes=nbytes
+            )
+
+    def _hedged_fetch(
+        self, peer: int, step: Optional[int], timeout_ms: float
+    ) -> tuple:
+        """Adaptive-deadline fetch with a single hedged retry.
+
+        Runs the primary fetch under the estimator's cumulative deadline
+        for ``peer`` (``timeout_ms`` while the estimator is cold); if the
+        un-margined quantile budget lapses with the primary still in
+        flight and a healthy fallback partner exists, launches ONE hedge
+        leg and returns the first success (closing the loser's socket
+        promptly).  Returns ``(winner_peer, got, outcome, latency_s,
+        nbytes, digest, hedged, hedge_winner)`` — the winner's outcome
+        flows through fetch()'s normal screening tail; only the LOSER is
+        recorded here."""
+        est = self._estimator
+        r = int(step) if step is not None else 0
+        deadline_ms = (
+            est.deadline_ms(peer) if est.warm(peer) else float(timeout_ms)
+        )
+        t0 = time.monotonic()
+        p_box: list = []
+        p_sock: list = []
+        p_thread = threading.Thread(
+            target=self._fetch_leg,
+            args=(peer, deadline_ms, p_box, p_sock),
+            daemon=True,
+        )
+        p_thread.start()
+        launch_ms = (
+            est.hedge_launch_ms(peer) if self.config.flowctl.hedge else None
+        )
+        fallback = None
+        if launch_ms is not None:
+            p_thread.join(launch_ms / 1000.0)
+            if p_thread.is_alive():
+                fallback = self._hedge_fallback(peer, r)
+        if fallback is None:
+            # No hedge: cold estimator, fast primary, or no healthy
+            # fallback.  The leg's own cumulative deadline bounds the
+            # join (budget extends only while bytes actually flow).
+            p_thread.join()
+            got, outcome, latency_s, nbytes, digest = self._leg_result(
+                p_box, time.monotonic() - t0
+            )
+            return peer, got, outcome, latency_s, nbytes, digest, False, None
+        est.note_hedge(peer)
+        f_box: list = []
+        f_sock: list = []
+        f_thread = threading.Thread(
+            target=self._fetch_leg,
+            args=(fallback, est.deadline_ms(fallback), f_box, f_sock),
+            daemon=True,
+        )
+        f_thread.start()
+        # Race: first SUCCESS wins; ties (both done) prefer the
+        # scheduled primary.  Both legs self-terminate on their own
+        # cumulative deadlines, so the poll loop is bounded.
+        while True:
+            p_done = not p_thread.is_alive()
+            f_done = not f_thread.is_alive()
+            if p_done and p_box and p_box[0][1] == Outcome.SUCCESS:
+                break
+            if f_done and f_box and f_box[0][1] == Outcome.SUCCESS:
+                break
+            if p_done and f_done:
+                break
+            time.sleep(0.002)
+        p_done = not p_thread.is_alive()
+        f_done = not f_thread.is_alive()
+        p_ok = p_done and p_box and p_box[0][1] == Outcome.SUCCESS
+        primary_wins = p_ok or (p_done and f_done and not (
+            f_box and f_box[0][1] == Outcome.SUCCESS
+        ))
+        elapsed = time.monotonic() - t0
+        if primary_wins:
+            # Cancel the hedge leg.  A leg that never got a fair budget
+            # (cancelled mid-flight) is not evidence against the
+            # fallback peer — only a genuinely finished leg records.
+            self._close_leg(f_sock)
+            f_thread.join(0.5)
+            if f_done and f_box:
+                self._record_loser(
+                    fallback, f_box[0], cancelled=False,
+                    latency_s=f_box[0][2], step=step,
+                )
+            got, outcome, latency_s, nbytes, digest = self._leg_result(
+                p_box, elapsed
+            )
+            return peer, got, outcome, latency_s, nbytes, digest, True, peer
+        # Fallback wins (or both failed — prefer the fallback's result
+        # only on success; otherwise report the primary's real failure).
+        if f_done and f_box and f_box[0][1] == Outcome.SUCCESS:
+            est.note_hedge_win(peer)
+            self._close_leg(p_sock)
+            p_thread.join(0.5)
+            self._record_loser(
+                peer,
+                p_box[0] if p_box else None,
+                cancelled=not (p_done and p_box),
+                latency_s=elapsed,
+                step=step,
+            )
+            got, outcome, latency_s, nbytes, digest = f_box[0]
+            return (
+                fallback, got, outcome, latency_s, nbytes, digest,
+                True, fallback,
+            )
+        # Both legs finished without a success: record the fallback's
+        # genuine failure here, report the primary's through the tail.
+        if f_box:
+            self._record_loser(
+                fallback, f_box[0], cancelled=False,
+                latency_s=f_box[0][2], step=step,
+            )
+        got, outcome, latency_s, nbytes, digest = self._leg_result(
+            p_box, elapsed
+        )
+        return peer, got, outcome, latency_s, nbytes, digest, True, None
 
     def _link_blocked(self, peer_index: int) -> bool:
         """Fetcher-side view of an injected partition (False without
@@ -1382,6 +1835,27 @@ class TcpTransport:
                     step, self.me, sched, sb.healthy_mask(step)
                 )
                 remapped = True
+            elif (
+                self.config.flowctl.enabled
+                and self.config.flowctl.degrade_shed_fraction > 0.0
+                and sb.is_degraded(sched, step)
+            ):
+                # Scoreboard soft-degrade: a DEGRADED partner (load, not
+                # death) keeps a deterministic fraction of its scheduled
+                # pairings — full shedding would starve it of the very
+                # successes that drain its suspicion — and the rest remap
+                # to a healthy fallback.  The draw is threefry-keyed on
+                # (seed, step, me): bit-identical across reruns.
+                from dpwa_tpu.parallel.schedules import degrade_shed_draw
+
+                if (
+                    degrade_shed_draw(self.schedule.seed, step, self.me)
+                    < self.config.flowctl.degrade_shed_fraction
+                ):
+                    partner = self.schedule.remap_partner(
+                        step, self.me, sched, sb.healthy_mask(step)
+                    )
+                    remapped = True
         return sched, partner, remapped
 
     def publish_state(self, blob: bytes) -> None:
@@ -1427,6 +1901,22 @@ class TcpTransport:
             for p, info in tsnap["peers"].items():
                 snap["peers"].setdefault(p, {}).update(info)
             snap["trust"] = tsnap
+        if self._estimator is not None:
+            fsnap = self._estimator.snapshot()
+            admission = getattr(self.server, "admission", None)
+            if admission is not None:
+                fsnap["admission"] = admission.snapshot()
+            for p, info in fsnap["peers"].items():
+                snap["peers"].setdefault(p, {}).update(
+                    {
+                        "deadline_ms": info["deadline_ms"],
+                        "hedges": info["hedges"],
+                        "hedge_wins": info["hedge_wins"],
+                        "busy": info["busy"],
+                        "slow": info["slow"],
+                    }
+                )
+            snap["flowctl"] = fsnap
         return snap
 
     def _trust_alpha_scale(self) -> float:
@@ -1495,6 +1985,11 @@ class TcpTransport:
             self.last_round["outcome"] = self.last_fetch.get("outcome")
             if "trust" in self.last_fetch:
                 self.last_round["trust"] = self.last_fetch["trust"]
+            if self.last_fetch.get("hedged"):
+                self.last_round["hedged"] = True
+                self.last_round["hedge_winner"] = self.last_fetch.get(
+                    "hedge_winner"
+                )
             if got is None:
                 # dead/slow peer: skip, keep training
                 return None, 0.0, partner

@@ -50,6 +50,7 @@ _SOCKET_TEST_MODULES = (
     "test_wire_dtype",
     "test_wire_int8",
     "test_async_freerun",
+    "test_flowctl",
 )
 _SOCKET_DEFAULT_TIMEOUT_S = 30.0
 _SOCKET_TEST_DEADLINE_S = 120.0

@@ -198,6 +198,100 @@ def test_chaos_byzantine_block_roundtrip_and_validation():
             config_from_dict({"nodes": ["a", "b"], "chaos": bad})
 
 
+def test_flowctl_block_roundtrip_and_defaults():
+    cfg = config_from_dict({"nodes": ["a", "b"]})
+    assert cfg.flowctl.enabled
+    assert cfg.flowctl.quantile == 0.95 and cfg.flowctl.margin == 1.5
+    assert cfg.flowctl.min_ms <= cfg.flowctl.max_ms
+    assert 1 <= cfg.flowctl.warmup <= cfg.flowctl.window
+    cfg = config_from_dict(
+        {
+            "nodes": ["a", "b"],
+            "flowctl": {
+                "enabled": False,
+                "quantile": 0.9,
+                "margin": 2.0,
+                "min_ms": 10.0,
+                "max_ms": 1000.0,
+                "window": 16,
+                "warmup": 3,
+                "hedge": False,
+                "degrade_shed_fraction": 1.0,
+                "max_connections": 4,
+                "token_rate": 10.0,
+                "token_burst": 20.0,
+                "max_inflight_bytes": 1 << 20,
+                "min_ingest_bytes_per_s": 1024.0,
+                "request_timeout_ms": 2000,
+                "busy_retry_ms": 100,
+            },
+        }
+    )
+    assert not cfg.flowctl.enabled
+    assert cfg.flowctl.window == 16 and cfg.flowctl.warmup == 3
+    assert not cfg.flowctl.hedge
+    assert cfg.flowctl.degrade_shed_fraction == 1.0
+    assert cfg.flowctl.max_connections == 4
+    # make_local_config takes the same dict shorthand.
+    local = make_local_config(2, flowctl={"quantile": 0.5})
+    assert local.flowctl.quantile == 0.5
+
+
+@pytest.mark.parametrize(
+    "bad_flowctl",
+    [
+        {"quantile": 0.0},
+        {"quantile": 1.5},
+        {"margin": 0.5},
+        {"min_ms": 0.0},
+        {"min_ms": 100.0, "max_ms": 50.0},
+        {"window": 1},
+        {"warmup": 0},
+        {"warmup": 64},  # > default window 32
+        {"degrade_shed_fraction": 1.5},
+        {"max_connections": 0},
+        {"token_rate": 0.0},
+        {"max_inflight_bytes": 0},
+        {"min_ingest_bytes_per_s": -1.0},
+        {"request_timeout_ms": 0},
+        {"busy_retry_ms": -1},
+    ],
+)
+def test_flowctl_block_validation(bad_flowctl):
+    with pytest.raises((ValueError, TypeError)):
+        config_from_dict({"nodes": ["a", "b"], "flowctl": bad_flowctl})
+
+
+def test_chaos_shaping_block_roundtrip_and_validation():
+    cfg = config_from_dict(
+        {
+            "nodes": ["a", "b", "c"],
+            "chaos": {
+                "enabled": True,
+                "trickle_windows": [{"peer": 1, "start": 2, "stop": 8}],
+                "trickle_bytes_per_s": 4096.0,
+                "stall_probability": 0.25,
+                "stall_ms_max": 50.0,
+                "accept_delay_windows": [(2, 0, 4)],
+                "accept_delay_ms": 25.0,
+            },
+        }
+    )
+    # Mapping and tuple window forms both normalize to int 3-tuples.
+    assert cfg.chaos.trickle_windows == ((1, 2, 8),)
+    assert cfg.chaos.accept_delay_windows == ((2, 0, 4),)
+    assert cfg.chaos.stall_probability == 0.25
+    for bad in (
+        {"trickle_bytes_per_s": 0.0},
+        {"stall_probability": 1.5},
+        {"stall_ms_max": -1.0},
+        {"accept_delay_ms": -1.0},
+        {"trickle_windows": [(0, 5, 2)]},  # stop < start
+    ):
+        with pytest.raises(ValueError):
+            config_from_dict({"nodes": ["a", "b"], "chaos": bad})
+
+
 def test_recovery_min_param_norm_ratio_validation():
     cfg = config_from_dict({"nodes": ["a", "b"]})
     assert 0.0 < cfg.recovery.min_param_norm_ratio < 1.0

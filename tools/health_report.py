@@ -29,12 +29,18 @@ counts, trust collapse/recovery events, and — per peer that ever served
 an ``untrusted`` payload — the rounds from the first byzantine payload
 to quarantine.
 
+``--flowctl`` prints the flow-control digest (docs/flowctl.md): the
+per-peer adaptive-deadline trajectory (first/min/max/final ms), hedge
+launches and wins (with the overall hedge win rate), busy/slow soft
+outcomes, and the serving side's shed totals.
+
 Usage::
 
     python tools/health_report.py metrics.jsonl [more.jsonl ...]
     python tools/health_report.py --json metrics.jsonl   # machine-readable
     python tools/health_report.py --split-step 20 metrics.jsonl
     python tools/health_report.py --trust metrics.jsonl
+    python tools/health_report.py --flowctl metrics.jsonl
 """
 
 from __future__ import annotations
@@ -103,6 +109,31 @@ def summarize(
                 "first_untrusted_step": None,
                 "quarantined_step": None,
                 "rounds_to_quarantine": None,
+            },
+        )
+
+    flowctl: Dict[str, Any] = {
+        "seen": False,  # any flowctl column/outcome in the records
+        "peers": {},  # p -> deadline trajectory + hedge/soft counters
+        "hedged_exchanges": 0,
+        "hedge_rate": None,  # final hedge-win rate from health records
+        "shed_total": None,  # final serving-side shed count
+        "busy_fetches": 0,
+        "slow_fetches": 0,
+    }
+
+    def flowctl_slot(p: int) -> Dict[str, Any]:
+        return flowctl["peers"].setdefault(
+            int(p),
+            {
+                "deadline_first": None,
+                "deadline_min": None,
+                "deadline_max": None,
+                "deadline_final": None,
+                "hedges": None,
+                "hedge_wins": None,
+                "busy": None,
+                "slow": None,
             },
         )
 
@@ -252,6 +283,33 @@ def summarize(
                     and ts["first_untrusted_step"] is not None
                 ):
                     ts["quarantined_step"] = rec.get("step")
+                if "deadline_ms" in rec:
+                    flowctl["seen"] = True
+                    fs = flowctl_slot(p)
+                    d = rec["deadline_ms"][i]
+                    if d is not None:
+                        if fs["deadline_first"] is None:
+                            fs["deadline_first"] = d
+                        fs["deadline_min"] = (
+                            d
+                            if fs["deadline_min"] is None
+                            else min(fs["deadline_min"], d)
+                        )
+                        fs["deadline_max"] = (
+                            d
+                            if fs["deadline_max"] is None
+                            else max(fs["deadline_max"], d)
+                        )
+                        fs["deadline_final"] = d
+                    for key in ("hedges", "hedge_wins", "busy", "slow"):
+                        col = rec.get(key)
+                        if col is not None:
+                            fs[key] = col[i]
+            if rec.get("hedge_rate") is not None:
+                flowctl["seen"] = True
+                flowctl["hedge_rate"] = rec["hedge_rate"]
+            if rec.get("shed_total") is not None:
+                flowctl["shed_total"] = rec["shed_total"]
             continue
         if "outcome" not in rec and "sched_partner" not in rec:
             continue  # not an exchange record (loss-only, etc.)
@@ -264,6 +322,15 @@ def summarize(
             s["outcomes"][out] = s["outcomes"].get(out, 0) + 1
         if rec.get("outcome") == "poisoned":
             poisoned += 1
+        if rec.get("outcome") == "busy":
+            flowctl["seen"] = True
+            flowctl["busy_fetches"] += 1
+        if rec.get("outcome") == "slow":
+            flowctl["seen"] = True
+            flowctl["slow_fetches"] += 1
+        if rec.get("hedged"):
+            flowctl["seen"] = True
+            flowctl["hedged_exchanges"] += 1
         if rec.get("outcome") == "untrusted":
             trust["seen"] = True
             trust["untrusted_fetches"] += 1
@@ -305,6 +372,7 @@ def summarize(
         "recovery": events,
         "membership": membership,
         "trust": trust,
+        "flowctl": flowctl,
     }
 
 
@@ -349,6 +417,37 @@ def _print_trust(summary: Dict[str, Any]) -> None:
                 f"{ts['first_untrusted_step']}, {q}"
             )
         print(line)
+
+
+def _print_flowctl(summary: Dict[str, Any]) -> None:
+    fc = summary.get("flowctl", {})
+    print()
+    print("# flowctl")
+    if not fc.get("seen"):
+        print("  no flowctl records in input (flowctl plane disabled?)")
+        return
+    rate = fc.get("hedge_rate")
+    print(
+        f"  hedged exchanges: {fc['hedged_exchanges']} "
+        f"(win rate: {rate if rate is not None else 'n/a'}); "
+        f"busy fetches: {fc['busy_fetches']}, slow fetches: "
+        f"{fc['slow_fetches']}, serving sheds: "
+        f"{fc.get('shed_total') if fc.get('shed_total') is not None else 0}"
+    )
+    for p, fs in sorted(fc.get("peers", {}).items()):
+        if fs.get("deadline_first") is None:
+            arc = "no deadline samples (cold estimator)"
+        else:
+            arc = (
+                f"deadline {fs['deadline_first']} -> "
+                f"[{fs['deadline_min']}, {fs['deadline_max']}] -> "
+                f"final {fs['deadline_final']} ms"
+            )
+        print(
+            f"  peer {p}: {arc}; hedges={fs['hedges']}, "
+            f"hedge_wins={fs['hedge_wins']}, busy={fs['busy']}, "
+            f"slow={fs['slow']}"
+        )
 
 
 def _print_table(summary: Dict[str, Any]) -> None:
@@ -489,6 +588,13 @@ def main(argv=None) -> int:
         "damped/rejected counts, time from first byzantine payload to "
         "quarantine)",
     )
+    ap.add_argument(
+        "--flowctl",
+        action="store_true",
+        help="print the flow-control digest (per-peer adaptive deadline "
+        "trajectory, hedge rate, busy/slow fetch counts, serving-side "
+        "admission sheds)",
+    )
     args = ap.parse_args(argv)
     summary = summarize(args.paths, split_step=args.split_step)
     if args.json:
@@ -498,6 +604,8 @@ def main(argv=None) -> int:
         _print_table(summary)
         if args.trust:
             _print_trust(summary)
+        if args.flowctl:
+            _print_flowctl(summary)
     return 0
 
 
