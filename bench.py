@@ -57,6 +57,21 @@ CAPTURE_MAX_AGE_H = 14.0
 # died).  DPWA_BENCH_REPROBE=1 ignores the cache.
 VERDICT_MAX_AGE_H = 6.0
 
+# Rounds run ~12h apart, so the freshness window above expires BETWEEN
+# rounds and every round used to re-burn the full probe budget (240 s
+# probe + 60 s sleep + retry) against the same dead tunnel — 87 probes,
+# 0 alive, across round 5.  The verdict therefore also carries a
+# ``dead_streak`` counter that SURVIVES staleness: once the backend has
+# been found dead this many times in a row, later rounds confirm with a
+# single short probe (no retry, no sleep) instead of the full budget.
+# Recovery detection is preserved — every round still probes once, and
+# any success resets the streak to zero.
+DEAD_STREAK_FAST_PROBE = 2
+# The capped confirmation-probe timeout once the streak has tripped: a
+# recovered tunnel inits in seconds, so a dead tunnel is re-confirmed
+# two orders of magnitude cheaper than the full probe budget.
+DEAD_CONFIRM_TIMEOUT_S = 30.0
+
 
 def _verdict_path() -> str:
     return os.path.join(
@@ -97,7 +112,31 @@ def load_backend_verdict() -> dict | None:
     return v
 
 
-def save_backend_verdict(platform: str | None, probe_s: float) -> None:
+def load_dead_streak() -> int:
+    """Consecutive dead-probe count from the verdict file, IGNORING the
+    freshness window: staleness invalidates a platform verdict (the
+    tunnel may have come back), but 'this backend has been dead N rounds
+    running' is exactly the cross-round memory the probe-budget cap
+    needs.  0 when the file is absent, unreadable, or records a live
+    platform.  DPWA_BENCH_REPROBE=1 zeroes it (full probe forced)."""
+    if os.environ.get("DPWA_BENCH_REPROBE") == "1":
+        return 0
+    try:
+        with open(_verdict_path()) as f:
+            v = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return 0
+    if not isinstance(v, dict) or v.get("platform") is not None:
+        return 0
+    try:
+        return max(0, int(v.get("dead_streak", 1)))
+    except (TypeError, ValueError):
+        return 1  # a pre-streak dead verdict still counts as one miss
+
+
+def save_backend_verdict(
+    platform: str | None, probe_s: float, dead_streak: int = 0
+) -> None:
     path = _verdict_path()
     try:
         os.makedirs(os.path.dirname(path), exist_ok=True)
@@ -108,6 +147,11 @@ def save_backend_verdict(platform: str | None, probe_s: float) -> None:
                     "platform": platform,  # null = probe failed/hung
                     "probed_at_utc": _utc_now_str(),
                     "probe_wall_s": round(probe_s, 1),
+                    # Consecutive dead probes across rounds (0 for a
+                    # live platform); read by load_dead_streak.
+                    "dead_streak": (
+                        0 if platform is not None else int(dead_streak)
+                    ),
                 },
                 f,
             )
@@ -329,6 +373,109 @@ def bench_tcp(d: int, iters: int, timeout_ms: int = 10000) -> float:
             t.close()
 
 
+WIRE_SWEEP_CODECS = (
+    ("f32", {"wire_dtype": "f32"}),
+    ("bf16", {"wire_dtype": "bf16"}),
+    ("int8", {"wire_dtype": "int8"}),
+    ("topk_0.1", {"wire_codec": "topk", "topk_fraction": 0.1}),
+    ("topk_0.05", {"wire_codec": "topk", "topk_fraction": 0.05}),
+)
+
+
+def bench_wire(d: int, iters: int, timeout_ms: int = 10000) -> dict:
+    """BENCH_r06 sweep: on-wire bytes + exchange wall per codec, plus an
+    overlap leg measuring how much fetch wall hides under a compute
+    stand-in.
+
+    2 peers on localhost, driven sequentially (node0 then node1 per
+    round) so timings measure codec work, not thread scheduling.  Bytes
+    come from each transport's ``wire_snapshot()`` — a tally of the
+    frames actually published — not from layout arithmetic, so the
+    reported reduction ratios are measured, never assumed.
+    """
+    from dpwa_tpu.config import make_local_config
+    from dpwa_tpu.parallel.tcp import TcpTransport
+
+    def ring(**kw):
+        cfg = make_local_config(
+            2, base_port=0, schedule="ring", timeout_ms=timeout_ms, **kw
+        )
+        ts = [TcpTransport(cfg, f"node{i}") for i in range(2)]
+        for t in ts:
+            for i, other in enumerate(ts):
+                t.set_peer_port(i, other.port)
+        return ts
+
+    rng = np.random.default_rng(0)
+    base = [rng.standard_normal(d).astype(np.float32) for _ in range(2)]
+
+    def drive(ts, sleep_s=0.0):
+        vecs = [b.copy() for b in base]
+        durs = []
+        for it in range(iters):
+            for i, t in enumerate(ts):
+                t.publish(vecs[i], it, 0.0)
+            t0 = time.perf_counter()
+            for i, t in enumerate(ts):
+                merged, alpha, _ = t.exchange(vecs[i], it, 0.0, it)
+                if alpha != 0.0:
+                    vecs[i] = np.asarray(merged, np.float32)
+            durs.append(time.perf_counter() - t0)
+            if sleep_s:
+                # Compute stand-in: the window the prefetch pipeline is
+                # supposed to hide the NEXT round's fetch under.
+                time.sleep(sleep_s)
+        return durs
+
+    legs = {}
+    for name, kw in WIRE_SWEEP_CODECS:
+        ts = ring(**kw)
+        try:
+            durs = drive(ts)
+            snap = ts[0].wire_snapshot()
+            legs[name] = {
+                "wire_bytes_per_frame": round(
+                    snap["wire_bytes"] / max(snap["frames"], 1), 1
+                ),
+                "compression_ratio": snap["compression_ratio"],
+                # Median wall of one node0+node1 exchange pair, halved to
+                # a per-exchange figure.
+                "exchange_ms": round(float(np.median(durs)) * 1e3 / 2, 3),
+            }
+        finally:
+            for t in ts:
+                t.close()
+    f32_b = legs["f32"]["wire_bytes_per_frame"]
+    int8_b = legs["int8"]["wire_bytes_per_frame"]
+    for leg in legs.values():
+        leg["reduction_vs_f32"] = round(f32_b / leg["wire_bytes_per_frame"], 2)
+        leg["reduction_vs_int8"] = round(
+            int8_b / leg["wire_bytes_per_frame"], 2
+        )
+
+    out = {"d": d, "iters": iters, "legs": legs}
+
+    # Overlap leg: dense f32 with the prefetch pipeline on, compute
+    # stand-in sized from the dense exchange median so there is a real
+    # window for the background fetch to hide under.
+    compute_s = max(legs["f32"]["exchange_ms"] / 1e3, 0.002)
+    ts = ring(overlap_prefetch=True)
+    try:
+        drive(ts, sleep_s=compute_s)
+        ov = ts[0].wire_snapshot().get("overlap") or {}
+        out["overlap"] = {
+            "compute_stand_in_ms": round(compute_s * 1e3, 3),
+            "hidden_frac": ov.get("hidden_frac"),
+            "occupancy": ov.get("occupancy"),
+            "prefetched": ov.get("prefetched"),
+            "straddled": ov.get("straddled"),
+        }
+    finally:
+        for t in ts:
+            t.close()
+    return out
+
+
 # ---------------------------------------------------------------------------
 # Watchdog'd subprocess orchestration (main process never imports JAX).
 # ---------------------------------------------------------------------------
@@ -436,6 +583,22 @@ def main() -> None:
         "--tcp-leg", action="store_true",
         help="(internal) run only the TCP baseline in this process",
     )
+    ap.add_argument(
+        "--wire-size", type=int, default=4 * 1024 * 1024,
+        help="vector length for the wire-codec sweep (floats)",
+    )
+    ap.add_argument(
+        "--wire-iters", type=int, default=8,
+        help="exchange rounds per codec in the wire sweep",
+    )
+    ap.add_argument(
+        "--wire-leg", action="store_true",
+        help="(internal) run only the wire-codec sweep in this process",
+    )
+    ap.add_argument(
+        "--skip-wire", action="store_true",
+        help="skip the wire-codec sweep leg",
+    )
     args = ap.parse_args()
 
     if args.device_leg:
@@ -445,6 +608,10 @@ def main() -> None:
     if args.tcp_leg:
         gbps = bench_tcp(args.tcp_size or args.size, args.tcp_iters)
         print(f"TCP_GBPS {gbps:.6f}", flush=True)
+        return
+    if args.wire_leg:
+        sweep = bench_wire(args.wire_size, args.wire_iters)
+        print("WIRE_SWEEP " + json.dumps(sweep), flush=True)
         return
 
     # --- TCP baseline.  Subprocess pinned to the CPU backend: the transport
@@ -469,6 +636,43 @@ def main() -> None:
     if tcp_gbps is not None:
         log(f"TCP baseline: {tcp_gbps:.3f} GB/s/peer")
 
+    # --- Wire-codec sweep (BENCH_r06): bytes/frame + compression ratio per
+    # codec and a prefetch-overlap leg, in the same scrubbed CPU subprocess
+    # as the TCP baseline (the transport imports touch jax).
+    wire_sweep = None
+    if not args.skip_wire:
+        log(f"wire sweep: d={args.wire_size} x{args.wire_iters} ...")
+        wire_cmd = [
+            sys.executable, os.path.abspath(__file__), "--wire-leg",
+            "--wire-size", str(args.wire_size),
+            "--wire-iters", str(args.wire_iters),
+        ]
+        try:
+            proc = subprocess.run(
+                wire_cmd, capture_output=True, text=True,
+                timeout=args.device_timeout, env=cpu_env,
+            )
+            sys.stderr.write(proc.stderr or "")
+            if proc.returncode != 0:
+                log(f"wire leg failed rc={proc.returncode}")
+            else:
+                for line in proc.stdout.splitlines():
+                    if line.startswith("WIRE_SWEEP "):
+                        wire_sweep = json.loads(line.split(None, 1)[1])
+        except subprocess.TimeoutExpired:
+            log(f"wire leg HUNG past {args.device_timeout:.0f}s — killed")
+        except json.JSONDecodeError:
+            log("wire leg produced an unparseable WIRE_SWEEP line")
+        if wire_sweep is not None:
+            tk = wire_sweep["legs"].get("topk_0.05", {})
+            ov = wire_sweep.get("overlap", {})
+            log(
+                "wire sweep: topk@0.05 "
+                f"{tk.get('reduction_vs_f32')}x vs f32, "
+                f"{tk.get('reduction_vs_int8')}x vs int8; overlap "
+                f"hidden_frac={ov.get('hidden_frac')}"
+            )
+
     # --- Backend probe, then the watchdog'd device leg with CPU fallback.
     # A fresh cached verdict (artifacts/backend_verdict.json) skips the
     # probe entirely — reruns inside the freshness window go straight to
@@ -485,34 +689,61 @@ def main() -> None:
             "(DPWA_BENCH_REPROBE=1 to force)"
         )
     else:
+        streak = load_dead_streak()
         probe_t0 = time.perf_counter()
-        platform, hung = probe_backend(
-            min(args.probe_timeout, args.probe_budget)
-        )
-        if platform is None and hung:
-            # Only the HANG case is worth retrying: the tunnel's wedges
-            # are sometimes transient, while a fast deterministic failure
-            # (rc!=0, missing plugin) will fail again identically.  The
-            # retry runs at a quarter of the probe timeout — a recovered
-            # tunnel inits in seconds — and only if the TOTAL probe wall
-            # budget (--probe-budget) has room for sleep + retry; round 5
-            # burned ~300 s on a dead tunnel without this cap.
-            remaining = args.probe_budget - (time.perf_counter() - probe_t0)
-            if remaining > 90.0:
-                log("backend probe hung; retrying once after 60s")
-                time.sleep(60)
+        if streak >= DEAD_STREAK_FAST_PROBE:
+            # The backend has been dead ``streak`` rounds running: spend
+            # ONE short confirmation probe (a recovered tunnel inits in
+            # seconds) instead of the full budget + sleep + retry the
+            # stale-verdict path used to re-burn every ~12h round.
+            log(
+                f"backend dead {streak} consecutive probe(s) — single "
+                f"{DEAD_CONFIRM_TIMEOUT_S:.0f}s confirmation probe, "
+                "no retry (DPWA_BENCH_REPROBE=1 for a full probe)"
+            )
+            platform, _hung = probe_backend(
+                min(
+                    DEAD_CONFIRM_TIMEOUT_S,
+                    args.probe_timeout,
+                    args.probe_budget,
+                )
+            )
+        else:
+            platform, hung = probe_backend(
+                min(args.probe_timeout, args.probe_budget)
+            )
+            if platform is None and hung:
+                # Only the HANG case is worth retrying: the tunnel's
+                # wedges are sometimes transient, while a fast
+                # deterministic failure (rc!=0, missing plugin) will fail
+                # again identically.  The retry runs at a quarter of the
+                # probe timeout — a recovered tunnel inits in seconds —
+                # and only if the TOTAL probe wall budget
+                # (--probe-budget) has room for sleep + retry; round 5
+                # burned ~300 s on a dead tunnel without this cap.
                 remaining = args.probe_budget - (
                     time.perf_counter() - probe_t0
                 )
-                platform, _ = probe_backend(
-                    max(30.0, min(remaining, args.probe_timeout / 4))
-                )
-            else:
-                log(
-                    f"probe budget ({args.probe_budget:.0f}s) exhausted — "
-                    "skipping retry, treating backend as dead"
-                )
-        save_backend_verdict(platform, time.perf_counter() - probe_t0)
+                if remaining > 90.0:
+                    log("backend probe hung; retrying once after 60s")
+                    time.sleep(60)
+                    remaining = args.probe_budget - (
+                        time.perf_counter() - probe_t0
+                    )
+                    platform, _ = probe_backend(
+                        max(30.0, min(remaining, args.probe_timeout / 4))
+                    )
+                else:
+                    log(
+                        f"probe budget ({args.probe_budget:.0f}s) "
+                        "exhausted — skipping retry, treating backend "
+                        "as dead"
+                    )
+        save_backend_verdict(
+            platform,
+            time.perf_counter() - probe_t0,
+            dead_streak=0 if platform is not None else streak + 1,
+        )
     cpu_leg_args = [
         "--size", str(args.cpu_size),
         "--peers", str(args.peers),
@@ -563,6 +794,8 @@ def main() -> None:
             round(tcp_gbps, 3) if tcp_gbps is not None else None
         ),
     }
+    if wire_sweep is not None:
+        out["wire_sweep"] = wire_sweep
 
     # A live run that could only reach CPU does not erase a chip number the
     # round DID capture: experiments/chip_watch.py re-probes the wedge-prone

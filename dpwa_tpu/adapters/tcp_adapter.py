@@ -253,6 +253,10 @@ class DpwaTcpAdapter:
                 # (absent when no hedge fired, keeping records identical).
                 extra["hedged"] = True
                 extra["hedge_winner"] = info.get("hedge_winner")
+            if info.get("codec"):
+                # Sparse-wire column (absent under the dense codec,
+                # keeping pre-codec records identical).
+                extra["codec"] = info["codec"]
             self.metrics.log(
                 step,
                 loss=loss,

@@ -30,6 +30,18 @@ Schema::
       inter_period: 4           # hierarchical: cross-group exchange cadence
       drop_probability: 0.0     # fault injection: drop pairs at this rate
       wire_dtype: f32           # f32 | bf16 | int8 (shipped replica compressed)
+      wire_codec: dense         # dense | topk (TCP only: topk ships only the
+                                #   k largest-magnitude changed coordinates
+                                #   against an error-feedback residual; see
+                                #   docs/wire.md)
+      topk_fraction: 0.05       # topk codec: k = round(fraction * n),
+                                #   clamped to [1, n]
+      topk_values: int8         # topk value block: int8 (chunk-scaled SR,
+                                #   ~5 B/coord) | f32 (exact, 8 B/coord)
+      overlap_prefetch: false   # TCP only: double-buffered pipeline — round
+                                #   t+1's partner fetch streams while round
+                                #   t's decode/screen/merge runs; payloads
+                                #   that straddle a local publish re-screen
     interpolation:
       type: constant            # constant | clock | loss
       factor: 0.5               # constant alpha (0.5 == (local+remote)/2)
@@ -222,6 +234,23 @@ class ProtocolConfig:
     # this well: quantization error enters scaled by alpha and is averaged
     # away across rounds.
     wire_dtype: str = "f32"
+    # Wire CODEC of the shipped replica (TCP transport only).  "dense"
+    # ships every coordinate at wire_dtype precision; "topk" ships only
+    # the k = round(topk_fraction * n) largest-magnitude coordinates that
+    # changed since the last publish (error-feedback residual scoring, so
+    # dropped coordinates accumulate and ship later), as absolute values
+    # the receiver splices into its OWN replica.  Orthogonal to
+    # wire_dtype: topk_values picks the value-block precision.
+    wire_codec: str = "dense"
+    topk_fraction: float = 0.05
+    topk_values: str = "int8"
+    # TCP transport: double-buffered exchange pipeline.  When on, round
+    # t+1's partner fetch (deadline-hedged as usual) streams on a
+    # background slot while round t's decode -> trust-screen -> merge
+    # runs; a prefetched payload that straddles a local publish is
+    # re-screened against the fresh replica before merging.  Off by
+    # default: the sequential path is the bit-identity reference.
+    overlap_prefetch: bool = False
 
     def __post_init__(self) -> None:
         if not 0.0 <= self.fetch_probability <= 1.0:
@@ -240,6 +269,14 @@ class ProtocolConfig:
             raise ValueError(f"unknown protocol mode {self.mode!r}")
         if self.wire_dtype not in ("f32", "bf16", "int8"):
             raise ValueError(f"unknown wire_dtype {self.wire_dtype!r}")
+        if self.wire_codec not in ("dense", "topk"):
+            raise ValueError(f"unknown wire_codec {self.wire_codec!r}")
+        if not 0.0 < self.topk_fraction <= 1.0:
+            raise ValueError(
+                f"topk_fraction must be in (0, 1], got {self.topk_fraction}"
+            )
+        if self.topk_values not in ("int8", "f32"):
+            raise ValueError(f"unknown topk_values {self.topk_values!r}")
         if self.min_wire_mb_per_s <= 0:
             raise ValueError(
                 f"min_wire_mb_per_s must be > 0, got {self.min_wire_mb_per_s}"

@@ -299,17 +299,48 @@ def byzantine_frame(
     guard's ``max_param_norm`` explosion bound — the attack the guard
     canNOT see).  The int8-chunked payload is mutated via its per-chunk
     f32 scales — multiplying the scales exactly multiplies the DECODED
-    vector, proving screening runs after dequantization.  u2 (raw-bits)
+    vector, proving screening runs after dequantization.  The top-k
+    delta payload is mutated in its VALUE block only (f32 values
+    directly, int8 values via their scales) — indices, k, and the header
+    stay valid, so the frame decodes cleanly and only the support-space
+    trust screen can catch the lie.  u2 (raw-bits)
     payloads are served unchanged (no meaningful linear mutation of a
     bit pattern)."""
     from dpwa_tpu.ops.quantize import _n_chunks
-    from dpwa_tpu.parallel.tcp import _DTYPES, _HDR, _INT8_CHUNKED
+    from dpwa_tpu.parallel.tcp import (
+        _DTYPES,
+        _HDR,
+        _INT8_CHUNKED,
+        _TOPK_DELTA,
+    )
 
     factor = {"sign": -1.0, "zero": 0.0}.get(kind, float(scale))
     magic, version, code, clock, loss, nbytes = _HDR.unpack_from(payload, 0)
     body = payload[_HDR.size : _HDR.size + nbytes]
     trailer = payload[_HDR.size + nbytes :]
-    if code == _INT8_CHUNKED:
+    if code == _TOPK_DELTA:
+        # u64 n | u32 k | u8 value_code | u32 idx[k] | values
+        if len(body) < 13:
+            return payload
+        k = int(np.frombuffer(body[8:12], "<u4")[0])
+        value_code = body[12]
+        off = 13 + 4 * k  # value block starts after the index list
+        if value_code == 0:  # f32 values
+            vals = np.frombuffer(
+                body[off : off + 4 * k], "<f4"
+            ) * np.float32(factor)
+            body = body[:off] + vals.astype("<f4").tobytes() + body[
+                off + 4 * k :
+            ]
+        else:  # int8 values: lie through the per-chunk scales
+            c = _n_chunks(k)
+            scales = np.frombuffer(
+                body[off : off + 4 * c], "<f4"
+            ) * np.float32(factor)
+            body = body[:off] + scales.astype("<f4").tobytes() + body[
+                off + 4 * c :
+            ]
+    elif code == _INT8_CHUNKED:
         if len(body) < 8:
             return payload
         n = int(np.frombuffer(body[:8], "<u8")[0])

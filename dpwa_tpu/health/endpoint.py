@@ -17,7 +17,10 @@ membership plane is enabled), ``/trust`` the trust sub-document
 (per-peer trust scores, verdicts, baseline fill — present when the
 content-trust plane is enabled), and ``/flowctl`` the flow-control
 sub-document (per-peer adaptive deadlines, hedge/busy counters, serving
-admission sheds — present when the flowctl plane is enabled); every
+admission sheds — present when the flowctl plane is enabled), and
+``/wire`` the wire-plane sub-document (publishing codec, on-wire byte
+tallies, compression ratio, prefetch-overlap occupancy — present when
+the topk codec or the prefetch pipeline is enabled); every
 other path gets the full snapshot — the endpoint is a
 liveness/introspection hook, not a general router."""
 
@@ -85,6 +88,10 @@ class HealthzServer:
                     elif b" /flowctl" in request_line:
                         doc = doc.get("flowctl") or {
                             "error": "flowctl disabled"
+                        }
+                    elif b" /wire" in request_line:
+                        doc = doc.get("wire") or {
+                            "error": "wire plane disabled"
                         }
                     body = json.dumps(doc).encode()
                 except Exception:  # snapshot must never kill the endpoint

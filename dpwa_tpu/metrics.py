@@ -150,6 +150,11 @@ class MetricsLogger:
           ``slow`` — the flowctl plane's per-peer adaptive deadline and
           hedge/soft-outcome counters, plus top-level ``hedge_rate`` and
           ``shed_total`` (present only when flowctl contributed);
+        - ``wire_codec`` / ``wire_bytes`` / ``compression_ratio`` and
+          ``overlap_occupancy`` / ``overlap_hidden_frac`` /
+          ``overlap_prefetched`` / ``overlap_straddled`` — the wire
+          plane's codec accounting and prefetch-overlap view (present
+          only when the topk codec or the prefetch pipeline is on);
 
         plus attempt/success/quarantine counters.  Obeys ``every`` like
         every other record; written immediately (health snapshots are
@@ -203,6 +208,28 @@ class MetricsLogger:
                 ),
                 shed_total=admission.get("shed_total", 0),
             )
+        wire = snapshot.get("wire")
+        if wire is not None:
+            # Wire-plane columns (absent without the topk codec or the
+            # prefetch pipeline, keeping dense sequential records
+            # byte-identical): which codec published, the honest
+            # wire-vs-dense byte ratio, and — under prefetch — how much
+            # of the fetch wall-time the pipeline hid under compute.
+            extra = dict(
+                extra,
+                wire_codec=wire.get("codec"),
+                wire_bytes=wire.get("wire_bytes"),
+                compression_ratio=wire.get("compression_ratio"),
+            )
+            overlap = wire.get("overlap")
+            if overlap is not None:
+                extra = dict(
+                    extra,
+                    overlap_occupancy=overlap.get("occupancy"),
+                    overlap_hidden_frac=overlap.get("hidden_frac"),
+                    overlap_prefetched=overlap.get("prefetched"),
+                    overlap_straddled=overlap.get("straddled"),
+                )
         self.log(
             step,
             record="health",

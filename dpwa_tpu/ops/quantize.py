@@ -46,6 +46,11 @@ CHUNK = 256  # f32 scale per 256 int8 elements: 1.6 % metadata overhead
 # fault_draw fold different data but share the schedule seed).
 _WIRE_SALT = 0x51A7
 
+# Separate salt for the top-k selection's tie-break stream: selection and
+# value quantization run at the same (seed, clock, sender) and must not
+# share a dither sequence.
+_TOPK_SALT = 0x70CC
+
 
 def _n_chunks(n: int) -> int:
     return max(1, math.ceil(n / CHUNK))
@@ -255,3 +260,220 @@ def decode_int8_payload(buf: np.ndarray) -> np.ndarray:
     )
     q = raw[8 + 4 * k:].view(np.int8)
     return dequantize_np(q, scale)
+
+
+# --------------------------------------------------------------------------
+# Top-k delta codec (TCP wire payload code 5)
+# --------------------------------------------------------------------------
+#
+# Ships only the k largest-magnitude *changed* coordinates since the last
+# publish, against an error-feedback accumulator, so a coordinate whose
+# delta missed the cut this round keeps its full residual score and wins a
+# later round — nothing is ever silently dropped (Stich et al.-style
+# memory/error feedback, adapted to gossip's averaging merge).
+#
+# Payload layout (code 5):
+#   u64 n | u32 k | u8 value_code | u32 idx[k] (strictly increasing) | values
+# where value_code 0 ships f32 values (4k bytes) and value_code 1 ships the
+# int8-chunked block f32 scales[ceil(k/CHUNK)] + int8 q[k].
+#
+# The shipped values are ABSOLUTE coordinates ``vec[idx]``, not deltas: the
+# receiver rebuilds its estimate of the sender by overwriting its OWN
+# replica at idx (``est = local.copy(); est[idx] = values``) and merges
+# that densified estimate exactly like a dense payload.  Absolute values
+# make the codec stateless on the receive side (no mirror to keep in sync
+# across skipped fetches, restarts, or partner remaps) and make honest
+# payloads look like the local replica to the trust plane (cosine ≈ +1 on
+# the selected coordinates), so the PR 4 hard bounds screen sparse frames
+# with no new thresholds.
+
+TOPK_VALUE_F32 = 0
+TOPK_VALUE_INT8 = 1
+
+
+def topk_nbytes(n: int, k: int, value_dtype: str = "int8") -> int:
+    """Exact on-wire payload bytes for a top-k frame (header + indices +
+    value block) — used by ``_wire_nbytes`` / ``tree_wire_bytes`` so
+    logged GB/s reflects the compressed traffic."""
+    k = max(1, min(int(k), int(n))) if n else 0
+    vals = 4 * k if value_dtype == "f32" else 4 * _n_chunks(k) + k
+    return 13 + 4 * k + vals
+
+
+def topk_k(n: int, fraction: float) -> int:
+    """k for a given vector length and ``protocol.topk_fraction`` —
+    clamped to [1, n] so degenerate fractions still make progress."""
+    return max(1, min(int(n), int(round(float(fraction) * int(n)))))
+
+
+def topk_select(
+    delta: np.ndarray, k: int, seed: int, clock: float, sender: int
+) -> np.ndarray:
+    """Indices (sorted ascending) of the k largest-|delta| coordinates.
+
+    Ties at the selection boundary are broken by a Philox draw keyed on
+    (seed, clock, sender) — the host-path counterpart of the threefry
+    keying the JAX codec uses, same convention as :func:`quantize_np` —
+    then by index, so reruns are bit-identical and peers with identical
+    deltas still make independent, unbiased boundary choices."""
+    n = delta.shape[0]
+    k = max(1, min(int(k), n))
+    if k == n:
+        return np.arange(n, dtype=np.uint32)
+    score = np.abs(delta)
+    part = np.argpartition(score, n - k)
+    thresh = score[part[n - k]]
+    above = np.nonzero(score > thresh)[0]
+    need = k - above.shape[0]
+    if need <= 0:
+        # More strictly-above entries than k can't happen (partition
+        # invariant), but guard the == 0 edge exactly.
+        idx = above[:k]
+    else:
+        at = np.nonzero(score == thresh)[0]
+        tie = np.random.Generator(
+            np.random.Philox(
+                key=list(_np_key_words(seed ^ _TOPK_SALT, clock, sender))
+            )
+        ).random(at.shape[0])
+        order = np.lexsort((at, tie))
+        idx = np.concatenate([above, at[order[:need]]])
+    return np.sort(idx).astype(np.uint32)
+
+
+class TopkPayload:
+    """A decoded sparse frame: ``n`` total coordinates, sorted ``indices``
+    (u32[k]) and f32 ``values`` — absolute sender coordinates, already
+    dequantized when the value block was int8.  ``value_dtype`` records
+    which block arrived (for per-codec accounting/baselines) and
+    ``nbytes`` the on-wire payload size."""
+
+    __slots__ = ("n", "indices", "values", "value_dtype", "nbytes")
+
+    def __init__(self, n, indices, values, value_dtype="f32", nbytes=0):
+        self.n = int(n)
+        self.indices = np.ascontiguousarray(indices, dtype=np.uint32)
+        self.values = np.ascontiguousarray(values, dtype=np.float32)
+        self.value_dtype = value_dtype
+        self.nbytes = int(nbytes)
+
+    @property
+    def k(self) -> int:
+        return self.indices.shape[0]
+
+    def densify(self, local: np.ndarray) -> np.ndarray:
+        """Rebuild the sender estimate against the receiver's own
+        replica: ``est = local.copy(); est[indices] = values``."""
+        local = np.ascontiguousarray(local, dtype=np.float32).reshape(-1)
+        if local.shape[0] != self.n:
+            raise ValueError(
+                f"top-k payload is for n={self.n} but local replica has "
+                f"{local.shape[0]} elements"
+            )
+        out = local.copy()
+        out[self.indices] = self.values
+        return out
+
+
+class TopkEncoder:
+    """Sender-side error-feedback state for the top-k wire.
+
+    ``base`` is this sender's record of what the ring has been told about
+    each coordinate.  Each publish scores coordinates by
+    ``|vec - base|`` (the residual: real movement PLUS anything previous
+    rounds dropped or rounded away), ships the top-k as absolute values,
+    and overwrites ``base`` only at the shipped indices with the values
+    as they decode on the wire — so quantization error also stays in the
+    score and un-shipped coordinates accumulate until they win."""
+
+    def __init__(self, fraction: float, value_dtype: str = "int8"):
+        self.fraction = float(fraction)
+        self.value_dtype = value_dtype
+        self.base: np.ndarray | None = None
+
+    def reset(self) -> None:
+        self.base = None
+
+    def encode(
+        self, vec: np.ndarray, seed: int, clock: float, sender: int
+    ) -> np.ndarray:
+        """f32[n] -> uint8 payload (code 5 body)."""
+        flat = np.ascontiguousarray(vec, dtype=np.float32).reshape(-1)
+        n = flat.shape[0]
+        if self.base is None or self.base.shape[0] != n:
+            self.base = np.zeros(n, np.float32)
+        k = topk_k(n, self.fraction)
+        idx = topk_select(flat - self.base, k, seed, clock, sender)
+        vals = flat[idx]
+        if self.value_dtype == "int8":
+            q, scale = quantize_np(vals, seed, clock, sender)
+            shipped = dequantize_np(q, scale)
+            code = TOPK_VALUE_INT8
+            vblock = np.concatenate([
+                np.frombuffer(scale.astype("<f4").tobytes(), np.uint8),
+                q.view(np.uint8),
+            ])
+        else:
+            shipped = vals
+            code = TOPK_VALUE_F32
+            vblock = np.frombuffer(vals.astype("<f4").tobytes(), np.uint8)
+        self.base[idx] = shipped
+        head = np.empty(13, np.uint8)
+        head[:8] = np.frombuffer(np.uint64(n).tobytes(), np.uint8)
+        head[8:12] = np.frombuffer(np.uint32(k).tobytes(), np.uint8)
+        head[12] = code
+        return np.concatenate([
+            head,
+            np.frombuffer(idx.astype("<u4").tobytes(), np.uint8),
+            vblock,
+        ])
+
+
+def decode_topk_payload(buf: np.ndarray) -> TopkPayload:
+    """uint8 payload -> :class:`TopkPayload`; raises ValueError on ANY
+    malformed input — truncated index list, k > n, out-of-range /
+    unsorted / duplicate indices, or a value block whose length lies —
+    so the transport classifies the frame CORRUPT instead of crashing."""
+    raw = np.ascontiguousarray(buf, dtype=np.uint8)
+    if raw.size < 13:
+        raise ValueError("top-k wire payload shorter than its header")
+    n = int(np.frombuffer(raw[:8].tobytes(), "<u8")[0])
+    k = int(np.frombuffer(raw[8:12].tobytes(), "<u4")[0])
+    code = int(raw[12])
+    if n < 1 or k < 1:
+        raise ValueError(f"top-k wire payload with n={n}, k={k}")
+    if k > n:
+        raise ValueError(f"top-k wire payload claims k={k} > n={n}")
+    if code not in (TOPK_VALUE_F32, TOPK_VALUE_INT8):
+        raise ValueError(f"top-k wire payload with value_code={code}")
+    vals_nbytes = 4 * k if code == TOPK_VALUE_F32 else 4 * _n_chunks(k) + k
+    expect = 13 + 4 * k + vals_nbytes
+    if raw.size != expect:
+        raise ValueError(
+            f"top-k wire payload size {raw.size} != {expect} expected "
+            f"for n={n}, k={k}, value_code={code}"
+        )
+    idx = np.frombuffer(raw[13:13 + 4 * k].tobytes(), "<u4").astype(
+        np.uint32
+    )
+    if int(idx[-1]) >= n:
+        raise ValueError(
+            f"top-k wire payload index {int(idx[-1])} out of range for "
+            f"n={n}"
+        )
+    if k > 1 and not np.all(idx[1:] > idx[:-1]):
+        raise ValueError(
+            "top-k wire payload indices not strictly increasing"
+        )
+    body = raw[13 + 4 * k:]
+    if code == TOPK_VALUE_F32:
+        vals = np.frombuffer(body.tobytes(), "<f4").astype(np.float32)
+        vdtype = "f32"
+    else:
+        kc = _n_chunks(k)
+        scale = np.frombuffer(body[:4 * kc].tobytes(), "<f4").astype(
+            np.float32
+        )
+        vals = dequantize_np(body[4 * kc:].view(np.int8), scale)
+        vdtype = "int8"
+    return TopkPayload(n, idx, vals, value_dtype=vdtype, nbytes=raw.size)

@@ -71,6 +71,14 @@ _DTYPE_CODES = {v: k for k, v in _DTYPES.items()}
 # (u64 n | f32 scales | int8 q — ops/quantize.py), decoded to f32 by
 # fetch_blob.  protocol.wire_dtype: int8.
 _INT8_CHUNKED = 4
+# Code 5: top-k delta payload (u64 n | u32 k | u8 value_code | sorted
+# u32 idx[k] | f32-or-int8 values — ops/quantize.py).  fetch_blob_full
+# returns it as a SPARSE TopkPayload object in the vector slot: only the
+# receiver holds the replica the frame splices into, so densification
+# happens in TcpTransport.fetch against the receiver's own published
+# view.  protocol.wire_codec: topk.
+_TOPK_DELTA = 5
+_PAYLOAD_CODES = (_INT8_CHUNKED, _TOPK_DELTA)
 _MAX_BLOB = 1 << 34  # 16 GiB sanity bound on advertised payload size
 
 # STATE transfer wire (crash recovery, dpwa_tpu/recovery/): a restarted
@@ -697,7 +705,7 @@ def fetch_blob_full(
             )
             magic, version, code, clock, loss, nbytes = _HDR.unpack(raw)
             if magic != _MAGIC or version != 1 or (
-                code not in _DTYPES and code != _INT8_CHUNKED
+                code not in _DTYPES and code not in _PAYLOAD_CODES
             ):
                 return None, Outcome.CORRUPT, time.monotonic() - t0, 0, None
             if nbytes > _MAX_BLOB:
@@ -707,7 +715,26 @@ def fetch_blob_full(
                 progress=rx,
             )
             nbytes_rx = len(data)
-            if code == _INT8_CHUNKED:
+            if code == _TOPK_DELTA:
+                # Sparse top-k frame: validated and decoded here (the
+                # full malformed-input taxonomy — truncated index list,
+                # k > n, unsorted/duplicate indices, lying value-block
+                # length — classifies as CORRUPT, never crashes), but
+                # NOT densified: only the transport holds the local
+                # replica the indices splice into, so the TopkPayload
+                # object rides the vector slot up to TcpTransport.fetch.
+                from dpwa_tpu.ops.quantize import decode_topk_payload
+
+                try:
+                    vec = decode_topk_payload(
+                        np.frombuffer(data, dtype=np.uint8)
+                    )
+                except ValueError:
+                    return (
+                        None, Outcome.CORRUPT,
+                        time.monotonic() - t0, nbytes_rx, None,
+                    )
+            elif code == _INT8_CHUNKED:
                 # Receiver-side dequantize: the wire moved 1 byte/elem
                 # (+ scales); the merge math runs on the f32 decode.
                 from dpwa_tpu.ops.quantize import decode_int8_payload
@@ -957,7 +984,7 @@ def probe_header_classified(
             if (
                 magic != _MAGIC
                 or version != 1
-                or (code not in _DTYPES and code != _INT8_CHUNKED)
+                or (code not in _DTYPES and code not in _PAYLOAD_CODES)
                 or nbytes > _MAX_BLOB
             ):
                 return Outcome.CORRUPT, None
@@ -1237,6 +1264,39 @@ class TcpTransport:
         self._wire_int8 = config.protocol.wire_dtype == "int8"
         if self._wire_bf16 and ml_dtypes is None:  # pragma: no cover
             raise RuntimeError("wire_dtype bf16 requires ml_dtypes")
+        # Top-k delta codec (protocol.wire_codec: topk): the published
+        # frame carries only the k largest-|residual| coordinates; the
+        # encoder's error-feedback base guarantees dropped coordinates
+        # accumulate and ship later.  Takes precedence over wire_dtype
+        # for the gossip frame (the value-block precision is
+        # protocol.topk_values); STATE/relay verbs are unaffected.
+        self._wire_topk = config.protocol.wire_codec == "topk"
+        self._topk_encoder = None
+        if self._wire_topk:
+            from dpwa_tpu.ops.quantize import TopkEncoder
+
+            self._topk_encoder = TopkEncoder(
+                config.protocol.topk_fraction,
+                config.protocol.topk_values,
+            )
+        # Per-publish wire accounting: actual on-wire payload bytes vs
+        # the dense f32 size, behind the ``compression_ratio`` health
+        # column and bench.py's codec sweep.
+        self._wire_tally = {"frames": 0, "wire_bytes": 0, "dense_bytes": 0}
+        # Double-buffered prefetch pipeline (protocol.overlap_prefetch):
+        # round t+1's partner fetch streams on a background slot while
+        # round t's decode -> trust-screen -> merge runs.  One slot:
+        # {step, sched, partner, remapped, expected_nbytes, thread, box,
+        #  t_end} — thread is None when the slot round does not
+        # participate (self-pair / masked).
+        self._prefetch_on = config.protocol.overlap_prefetch
+        self._prefetch_slot: Optional[dict] = None
+        self._pipe_last_entry: Optional[float] = None
+        self._overlap = {
+            "rounds": 0, "prefetched": 0, "straddled": 0,
+            "fetch_s": 0.0, "join_wait_s": 0.0,
+            "inflight_s": 0.0, "round_s": 0.0,
+        }
         spec = config.nodes[self.me]
         # Fetcher-side flow control: the per-peer latency estimator that
         # derives adaptive cumulative deadlines and hedge launch points.
@@ -1361,6 +1421,7 @@ class TcpTransport:
         self._last_clock = float(clock)
         if (
             self.trust is not None
+            or self._wire_topk
             or (
                 self.config.recovery.enabled
                 and self.config.recovery.min_param_norm_ratio > 0.0
@@ -1368,7 +1429,8 @@ class TcpTransport:
         ) and vec.dtype in (np.float32, np.float64):
             # Stash the f32 replica this round merges against: trust
             # screening and the zero-energy guard both compare the
-            # incoming payload to what we just published.
+            # incoming payload to what we just published — and a top-k
+            # frame can only densify against it.
             self._local_vec = np.ascontiguousarray(vec, dtype=np.float32)
             self._local_norm = float(
                 np.linalg.norm(self._local_vec.astype(np.float64))
@@ -1380,19 +1442,37 @@ class TcpTransport:
             if self.membership is not None
             else None
         )
+        if self._wire_topk and vec.dtype == np.float32:
+            payload = self._topk_encoder.encode(
+                np.ascontiguousarray(vec, dtype=np.float32).reshape(-1),
+                self.schedule.seed, clock, self.me,
+            )
+            self._note_published(int(payload.size), int(vec.size) * 4)
+            self.server.publish(
+                payload, clock, loss, code=_TOPK_DELTA, digest=digest
+            )
+            return
         if self._wire_int8 and vec.dtype == np.float32:
             from dpwa_tpu.ops.quantize import encode_int8_payload
 
             payload = encode_int8_payload(
                 vec, self.schedule.seed, clock, self.me
             )
+            self._note_published(int(payload.size), int(vec.size) * 4)
             self.server.publish(
                 payload, clock, loss, code=_INT8_CHUNKED, digest=digest
             )
             return
         if self._wire_bf16 and vec.dtype == np.float32:
             vec = vec.astype(_DTYPES[3])
+        self._note_published(int(vec.nbytes), int(vec.size) * 4)
         self.server.publish(vec, clock, loss, digest=digest)
+
+    def _note_published(self, wire_bytes: int, dense_bytes: int) -> None:
+        t = self._wire_tally
+        t["frames"] += 1
+        t["wire_bytes"] += wire_bytes
+        t["dense_bytes"] += dense_bytes
 
     def fetch(
         self,
@@ -1400,40 +1480,95 @@ class TcpTransport:
         timeout_ms: Optional[int] = None,
         step: Optional[int] = None,
     ) -> Optional[Tuple[np.ndarray, float, float]]:
+        return self._consume_fetch(
+            self._wire_fetch(peer_index, timeout_ms, step), step
+        )
+
+    def _wire_fetch(
+        self,
+        peer_index: int,
+        timeout_ms: Optional[int] = None,
+        step: Optional[int] = None,
+    ) -> tuple:
+        """The WIRE leg of a fetch — connect, stream, frame-validate —
+        with none of the consuming-side semantics (densify, guard, trust,
+        scoreboard, estimator).  Split from :meth:`_consume_fetch` so the
+        prefetch pipeline can stream round t+1's bytes on a background
+        thread while round t is still screening: only byte movement may
+        run ahead; every judgement about a payload happens at consume
+        time against the replica it would actually merge into.
+
+        Returns the 8-tuple ``(winner_peer, got, outcome, latency_s,
+        nbytes, digest, hedged, hedge_winner)``."""
         if timeout_ms is None:
             timeout_ms = self.config.protocol.timeout_ms
-        est = self._estimator
-        hedged, hedge_winner = False, None
         if self._link_blocked(peer_index):
             # Injected partition, fetcher side: the chaos harness blocks
             # this directed link, so no socket is even opened — the
             # round records a refused fetch, exactly what a firewalled
             # link produces.
-            got, outcome, latency_s, nbytes, digest = (
-                None, Outcome.REFUSED, 0.0, 0, None,
+            return (
+                peer_index, None, Outcome.REFUSED, 0.0, 0, None,
+                False, None,
             )
-        elif est is not None:
+        if self._estimator is not None:
             # Flowctl path: the estimator's adaptive cumulative deadline
             # (falling back to timeout_ms while cold) plus at most one
             # hedged retry to the schedule's fallback partner once the
-            # quantile budget lapses.  ``peer_index`` may come back as
-            # the FALLBACK peer — everything recorded below (trust,
-            # guard, scoreboard, estimator) is then charged to the peer
-            # whose payload actually merges; the losing leg was already
-            # recorded inside _hedged_fetch.
-            (
-                peer_index, got, outcome, latency_s, nbytes, digest,
-                hedged, hedge_winner,
-            ) = self._hedged_fetch(peer_index, step, timeout_ms)
-        else:
-            host, port = self._ports[peer_index]
-            got, outcome, latency_s, nbytes, digest = fetch_blob_full(
-                host, port, timeout_ms,
-                min_bandwidth_bps=(
-                    self.config.protocol.min_wire_mb_per_s * 1e6
-                ),
-                want_digest=self.membership is not None,
-            )
+            # quantile budget lapses.  The winner slot may come back as
+            # the FALLBACK peer — everything recorded by the consume
+            # half (trust, guard, scoreboard, estimator) is then charged
+            # to the peer whose payload actually merges; the losing leg
+            # was already recorded inside _hedged_fetch.
+            return self._hedged_fetch(peer_index, step, timeout_ms)
+        host, port = self._ports[peer_index]
+        got, outcome, latency_s, nbytes, digest = fetch_blob_full(
+            host, port, timeout_ms,
+            min_bandwidth_bps=(
+                self.config.protocol.min_wire_mb_per_s * 1e6
+            ),
+            want_digest=self.membership is not None,
+        )
+        return (
+            peer_index, got, outcome, latency_s, nbytes, digest,
+            False, None,
+        )
+
+    def _consume_fetch(
+        self, raw: tuple, step: Optional[int]
+    ) -> Optional[Tuple[np.ndarray, float, float]]:
+        """The CONSUME leg: densify a sparse frame against the CURRENT
+        local replica, then guard/trust/scoreboard/estimator — all
+        charged to the consuming round's ``step``.  Under the prefetch
+        pipeline the wire leg may have run a full round earlier; this is
+        the publish-clock guard in structural form — a prefetched payload
+        that straddled a local publish is screened against the replica
+        that exists NOW, never against the one that existed at launch."""
+        (
+            peer_index, got, outcome, latency_s, nbytes, digest,
+            hedged, hedge_winner,
+        ) = raw
+        est = self._estimator
+        codec = None
+        sparse_guard = None   # (values, local_selected) for the guard
+        sparse_trust = None   # (indices, values) for trust screening
+        if got is not None and not isinstance(got[0], np.ndarray):
+            # Top-k delta frame: fetch_blob_full returns the decoded
+            # TopkPayload in the vector slot; only this side holds the
+            # replica the indices splice into.  No stashed local replica
+            # (or a size mismatch after a reshard) means the frame
+            # cannot be interpreted — classified corrupt, never merged.
+            sp = got[0]
+            lv = self._local_vec
+            if lv is None or int(lv.size) != int(sp.n):
+                got = None
+                outcome = Outcome.CORRUPT
+            else:
+                codec = "topk"
+                local_sel = lv[sp.indices.astype(np.intp)]
+                got = (sp.densify(lv), got[1], got[2])
+                sparse_guard = (sp.values, local_sel)
+                sparse_trust = (sp.indices, sp.values)
         reason = None
         if got is not None and self.config.recovery.enabled:
             # Divergence/poison guard: a frame can be perfectly formed
@@ -1446,6 +1581,7 @@ class TcpTransport:
             reason = validate_payload(
                 got[0], got[2], self.config.recovery,
                 local_norm=self._local_norm,
+                sparse=sparse_guard,
             )
             if reason is not None:
                 got = None
@@ -1460,12 +1596,15 @@ class TcpTransport:
             # Trust screening runs on the DECODED f32 vector (the int8
             # wire path dequantized inside fetch_blob_full, bf16 casts
             # in payload_stats) — the payload is judged on what would
-            # actually merge.  A rejection is the ``untrusted`` outcome:
+            # actually merge.  A top-k frame is judged on its SUPPORT
+            # (payload_stats_sparse) under its own per-codec baselines.
+            # A rejection is the ``untrusted`` outcome:
             # recorded below exactly like ``poisoned``, and — also like
             # poisoned — never gated behind indirect probing, since a
             # byzantine peer answers header probes perfectly.
             verdict, scale, tstats = self.trust.screen(
-                peer_index, got[0], got[1], self._local_vec, round=step
+                peer_index, got[0], got[1], self._local_vec, round=step,
+                codec=codec or "dense", sparse=sparse_trust,
             )
             from dpwa_tpu.trust.manager import REJECTED
 
@@ -1481,6 +1620,8 @@ class TcpTransport:
             "peer": peer_index, "outcome": outcome,
             "latency_s": latency_s, "nbytes": nbytes,
         }
+        if codec is not None:
+            self.last_fetch["codec"] = codec
         if hedged:
             self.last_fetch["hedged"] = True
             self.last_fetch["hedge_winner"] = hedge_winner
@@ -1917,7 +2058,55 @@ class TcpTransport:
                     }
                 )
             snap["flowctl"] = fsnap
+        if self._wire_topk or self._prefetch_on:
+            # Gated on the new planes being ON: a dense sequential run
+            # keeps its health records byte-identical to PR 5.
+            snap["wire"] = self.wire_snapshot()
         return snap
+
+    def wire_snapshot(self) -> dict:
+        """JSON-ready wire-plane state: which codec is publishing, the
+        actual on-wire vs dense f32 byte tallies behind the
+        ``compression_ratio`` column, and — under the prefetch pipeline
+        — the overlap accounting (``occupancy`` = fetch in-flight time
+        over entry-to-entry round wall; ``hidden_frac`` = the fraction
+        of fetch wall-time the caller never waited on)."""
+        t = self._wire_tally
+        codec = "topk" if self._wire_topk else self.config.protocol.wire_dtype
+        out = {
+            "codec": codec,
+            "frames": t["frames"],
+            "wire_bytes": t["wire_bytes"],
+            "dense_bytes": t["dense_bytes"],
+            "compression_ratio": (
+                round(t["dense_bytes"] / t["wire_bytes"], 4)
+                if t["wire_bytes"]
+                else 0.0
+            ),
+        }
+        if self._wire_topk:
+            out["topk_fraction"] = self.config.protocol.topk_fraction
+            out["topk_values"] = self.config.protocol.topk_values
+        if self._prefetch_on:
+            o = self._overlap
+            out["overlap"] = {
+                "rounds": o["rounds"],
+                "prefetched": o["prefetched"],
+                "straddled": o["straddled"],
+                "fetch_s": round(o["fetch_s"], 6),
+                "join_wait_s": round(o["join_wait_s"], 6),
+                "occupancy": (
+                    round(o["inflight_s"] / o["round_s"], 4)
+                    if o["round_s"] > 0
+                    else 0.0
+                ),
+                "hidden_frac": (
+                    round(max(1.0 - o["join_wait_s"] / o["fetch_s"], 0.0), 4)
+                    if o["fetch_s"] > 0
+                    else 0.0
+                ),
+            }
+        return out
 
     def _trust_alpha_scale(self) -> float:
         """The CURRENT exchange's trust damping (interpolation hook)."""
@@ -1929,6 +2118,14 @@ class TcpTransport:
         size the overlapped-join backstop.  Mirrors :meth:`publish`'s
         encoding choice exactly."""
         n = int(vec.size)
+        if self._wire_topk and vec.dtype == np.float32:
+            from dpwa_tpu.ops.quantize import topk_k, topk_nbytes
+
+            return topk_nbytes(
+                n,
+                topk_k(n, self.config.protocol.topk_fraction),
+                self.config.protocol.topk_values,
+            )
         if self._wire_int8 and vec.dtype == np.float32:
             from dpwa_tpu.ops.quantize import _n_chunks
 
@@ -1983,6 +2180,8 @@ class TcpTransport:
                 return None, 0.0, partner
             got = self.fetch(partner, step=step)
             self.last_round["outcome"] = self.last_fetch.get("outcome")
+            if "codec" in self.last_fetch:
+                self.last_round["codec"] = self.last_fetch["codec"]
             if "trust" in self.last_fetch:
                 self.last_round["trust"] = self.last_fetch["trust"]
             if self.last_fetch.get("hedged"):
@@ -2039,11 +2238,175 @@ class TcpTransport:
         """One full gossip round: publish, pick partner, fetch, merge.
 
         Returns (merged_vector, alpha_applied, partner).  alpha == 0.0 means
-        the round was skipped (self-pair, masked, or fetch timeout)."""
+        the round was skipped (self-pair, masked, or fetch timeout).
+
+        With ``protocol.overlap_prefetch`` the wire leg of the NEXT
+        round's fetch is launched before this round returns, so the
+        caller's compute between exchanges hides the partner stream
+        (:meth:`_exchange_pipelined`); the sequential path below is the
+        bit-identity reference the pipeline is tested against."""
+        if self._prefetch_on:
+            return self._exchange_pipelined(vec, clock, loss, step)
         remote_vec, alpha, partner = self._round(vec, clock, loss, step)
         if remote_vec is None:
             return vec, alpha, partner
         return _host_merge(vec, remote_vec, alpha), alpha, partner
+
+    def _exchange_pipelined(
+        self, vec: np.ndarray, clock: float, loss: float, step: int
+    ) -> Tuple[np.ndarray, float, int]:
+        """One gossip round through the double-buffered prefetch slot.
+
+        Steady state per round ``t``: publish x_t, JOIN the slot that has
+        been streaming partner(t)'s frame since round t−1 (the caller's
+        compute between exchanges is what the stream hid under), LAUNCH
+        round t+1's wire fetch on a fresh background slot, then decode →
+        guard → trust-screen → merge round t's payload.  Everything
+        judgemental runs at consume time against the replica published
+        THIS round — the publish-clock guard: a payload whose fetch
+        straddled our publish is screened against the current local
+        view, never the one that existed at launch (``straddled`` counts
+        those rounds).  Failure semantics (busy, slow, hedge losers,
+        chaos partitions) are charged to the consuming round's step, and
+        a partition that opened after launch still refuses the payload
+        at consume (:meth:`_prefetch_take`)."""
+        t_entry = time.monotonic()
+        o = self._overlap
+        if self._pipe_last_entry is not None:
+            # Entry-to-entry wall clock — the denominator of the
+            # overlap-occupancy column (compute + exchange, everything).
+            o["round_s"] += t_entry - self._pipe_last_entry
+        self._pipe_last_entry = t_entry
+        o["rounds"] += 1
+        try:
+            self.publish(vec, clock, loss)
+            raw, sched, partner, remapped = self._prefetch_take(step)
+            self.last_round = {
+                "step": step, "sched_partner": sched, "partner": partner,
+                "remapped": remapped, "outcome": None,
+            }
+            # Launch round t+1's wire leg BEFORE consuming round t: the
+            # stream overlaps this round's decode/screen/merge and the
+            # caller's next compute interval.
+            self._prefetch_launch(step + 1, self._wire_nbytes(vec))
+            if raw is None:
+                return vec, 0.0, partner
+            got = self._consume_fetch(raw, step)
+            self.last_round["outcome"] = self.last_fetch.get("outcome")
+            if "codec" in self.last_fetch:
+                self.last_round["codec"] = self.last_fetch["codec"]
+            if "trust" in self.last_fetch:
+                self.last_round["trust"] = self.last_fetch["trust"]
+            if self.last_fetch.get("hedged"):
+                self.last_round["hedged"] = True
+                self.last_round["hedge_winner"] = self.last_fetch.get(
+                    "hedge_winner"
+                )
+            if got is None:
+                return vec, 0.0, partner
+            remote_vec, alpha = self._weigh_remote(got, clock, loss)
+            return _host_merge(vec, remote_vec, alpha), alpha, partner
+        finally:
+            self._membership_end_round(step)
+
+    def _prefetch_launch(self, step: int, expected_nbytes: int) -> None:
+        """Arm the slot for round ``step``: resolve its partner NOW (the
+        scoreboard view is one round younger than a sequential resolve
+        would see — acceptable prefetch skew, the pipeline is config-
+        gated) and start the wire leg on a daemon thread.  A slot whose
+        round does not participate (self-pair / masked) is armed with no
+        thread so the take side still returns its partner resolution."""
+        sched, partner, remapped = self._resolve_partner(step)
+        slot = {
+            "step": step, "sched": sched, "partner": partner,
+            "remapped": remapped, "expected_nbytes": int(expected_nbytes),
+            "thread": None, "box": [], "t_start": 0.0, "t_end": [0.0],
+        }
+        if partner != self.me and self.schedule.participates(step, self.me):
+            box, t_end = slot["box"], slot["t_end"]
+
+            def _run():
+                box.append(self._wire_fetch(partner, step=step))
+                t_end[0] = time.monotonic()
+
+            slot["t_start"] = time.monotonic()
+            th = threading.Thread(
+                target=_run, daemon=True,
+                name=f"dpwa-prefetch:{self.port}",
+            )
+            slot["thread"] = th
+            th.start()
+        self._prefetch_slot = slot
+
+    def _prefetch_take(self, step: int) -> tuple:
+        """Claim the slot for round ``step``: ``(raw_8tuple | None,
+        sched, partner, remapped)``.
+
+        A cold pipeline (first round) or a step discontinuity resolves
+        and fetches synchronously — correctness never depends on the
+        slot being warm.  The join backstop mirrors the overlapped
+        exchange's: the wire leg's own cumulative deadline (doubled
+        under flowctl for a hedge's two sequential budgets) plus the
+        per-byte allowance for the expected frame, so a healthy large
+        stream is never abandoned while a hung leg cannot wedge the
+        round — a lapsed join skips the merge like any failed fetch."""
+        slot, self._prefetch_slot = self._prefetch_slot, None
+        o = self._overlap
+        if slot is None or slot["step"] != step:
+            sched, partner, remapped = self._resolve_partner(step)
+            if partner == self.me or not self.schedule.participates(
+                step, self.me
+            ):
+                return None, sched, partner, remapped
+            t0 = time.monotonic()
+            raw = self._wire_fetch(partner, step=step)
+            dt = time.monotonic() - t0
+            # A synchronous fill is all join-wait: nothing was hidden.
+            o["fetch_s"] += dt
+            o["join_wait_s"] += dt
+            o["inflight_s"] += dt
+            return raw, sched, partner, remapped
+        sched, partner, remapped = (
+            slot["sched"], slot["partner"], slot["remapped"]
+        )
+        th = slot["thread"]
+        if th is None:
+            return None, sched, partner, remapped
+        o["prefetched"] += 1
+        if slot["t_end"][0] == 0.0:
+            # Still streaming as this round's publish landed: the
+            # payload straddled a local publish and the consume-time
+            # screen (not any launch-time state) is what judges it.
+            o["straddled"] += 1
+        fc = self.config.flowctl
+        base_s = self.config.protocol.timeout_ms / 1000.0
+        if fc.enabled:
+            base_s = 2.0 * max(base_s, fc.max_ms / 1000.0)
+        t_join = time.monotonic()
+        th.join(
+            1.0
+            + base_s
+            + slot["expected_nbytes"]
+            / (self.config.protocol.min_wire_mb_per_s * 1e6)
+        )
+        o["join_wait_s"] += time.monotonic() - t_join
+        t_end = slot["t_end"][0] or time.monotonic()
+        span = max(t_end - slot["t_start"], 0.0)
+        o["fetch_s"] += span
+        o["inflight_s"] += span
+        if not slot["box"]:
+            # Join backstop lapsed: the daemon leg keeps running but
+            # this round moves on without a merge.
+            return None, sched, partner, remapped
+        raw = slot["box"][0]
+        if self._link_blocked(partner):
+            # A chaos partition keyed on the CURRENT publish clock —
+            # the consuming round's — refuses the payload even though
+            # the launch-time check (one clock earlier) let the wire
+            # leg run: partition semantics charge the consuming round.
+            raw = (partner, None, Outcome.REFUSED, 0.0, 0, None,
+                   False, None)
+        return raw, sched, partner, remapped
 
     def exchange_overlapped_start(
         self, vec: np.ndarray, clock: float, loss: float, step: int

@@ -32,6 +32,7 @@ def validate_payload(
     loss: float,
     config: RecoveryConfig,
     local_norm: Optional[float] = None,
+    sparse: Optional[tuple] = None,
 ) -> Optional[str]:
     """None if ``(vec, loss)`` is a sane replica, else the violation.
 
@@ -48,7 +49,16 @@ def validate_payload(
     ``zero_energy``: an all-zero (or near-zero) payload from a
     half-bootstrapped or byzantine peer is finite and "sane" in
     isolation, but merging it drags healthy weights toward zero at
-    alpha-speed."""
+    alpha-speed.
+
+    ``sparse`` — for a top-k wire frame, the ``(values, local_selected)``
+    pair of the payload's support.  ``vec`` is then the DENSIFIED vector
+    (mostly the receiver's own replica), so the full-vector zero-energy
+    ratio would sit at ≈1 even for an all-zero value block; the ratio is
+    instead taken on the support — ``‖values‖`` against
+    ``‖local[idx]‖`` — where a zero-energy attack actually lives.  The
+    nonfinite and explosion checks stay on the densified vector (that is
+    what would merge)."""
     v = np.asarray(vec)
     if v.dtype != np.float32 and v.dtype != np.float64:
         v = v.astype(np.float32)
@@ -57,13 +67,23 @@ def validate_payload(
     norm = float(np.linalg.norm(v.astype(np.float64, copy=False)))
     if norm > config.max_param_norm:
         return "param_norm"
-    if (
-        local_norm is not None
-        and local_norm > 0.0
-        and config.min_param_norm_ratio > 0.0
-        and norm < config.min_param_norm_ratio * local_norm
-    ):
-        return "zero_energy"
+    if config.min_param_norm_ratio > 0.0:
+        if sparse is not None:
+            values, local_sel = sparse
+            ln = float(
+                np.linalg.norm(np.asarray(local_sel, dtype=np.float64))
+            )
+            rn = float(
+                np.linalg.norm(np.asarray(values, dtype=np.float64))
+            )
+            if ln > 0.0 and rn < config.min_param_norm_ratio * ln:
+                return "zero_energy"
+        elif (
+            local_norm is not None
+            and local_norm > 0.0
+            and norm < config.min_param_norm_ratio * local_norm
+        ):
+            return "zero_energy"
     l = float(loss)
     if math.isnan(l) or math.isinf(l):
         return "nonfinite_loss"

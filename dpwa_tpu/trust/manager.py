@@ -59,6 +59,7 @@ from dpwa_tpu.trust.screen import (
     RobustBaseline,
     leaf_starts_from_sizes,
     payload_stats,
+    payload_stats_sparse,
 )
 
 # Verdict strings (stable: they ride into metrics JSONL and /healthz).
@@ -90,6 +91,16 @@ class TrustManager:
         # time.
         self._baselines: Dict[str, RobustBaseline] = {
             s: RobustBaseline(self.config.window) for s in BASE_STATS
+        }
+        # Per-CODEC baseline windows: a sparse (top-k) payload's stats
+        # live in support space, where honest magnitudes differ from the
+        # dense ones — update_ratio concentrates on exactly the
+        # coordinates that moved — so sharing one window would let the
+        # codec mix poison both populations.  "dense" aliases the
+        # original dict, keeping the snapshot layout (and every pre-topk
+        # record) unchanged.
+        self._codec_baselines: Dict[str, Dict[str, RobustBaseline]] = {
+            "dense": self._baselines
         }
         self._trust: Dict[int, float] = {}
         self._collapsed: Dict[int, bool] = {}
@@ -129,6 +140,19 @@ class TrustManager:
     # Screening
     # ------------------------------------------------------------------
 
+    def _baselines_for(self, codec: str) -> Dict[str, RobustBaseline]:
+        """The baseline window set for ``codec`` (created on first use);
+        callers hold no lock — creation races are benign under ours."""
+        with self._lock:
+            b = self._codec_baselines.get(codec)
+            if b is None:
+                b = {
+                    s: RobustBaseline(self.config.window)
+                    for s in BASE_STATS
+                }
+                self._codec_baselines[codec] = b
+            return b
+
     def screen(
         self,
         peer: int,
@@ -136,11 +160,22 @@ class TrustManager:
         remote_clock: float,
         local_vec: np.ndarray,
         round: Optional[int] = None,
+        codec: str = "dense",
+        sparse: Optional[Tuple[np.ndarray, np.ndarray]] = None,
     ) -> Tuple[str, float, Dict[str, Any]]:
         """Classify one decoded payload; returns ``(verdict,
         alpha_scale, stats)``.  ``alpha_scale`` is the trust-scaled merge
         damping the transport routes into the interpolation (0.0 on a
-        rejection — rejected payloads never merge)."""
+        rejection — rejected payloads never merge).
+
+        ``sparse`` — for a top-k frame, the ``(indices, values)`` pair of
+        the payload's support: statistics are then computed on the
+        selected coordinates (:func:`payload_stats_sparse`) and screened
+        against the ``codec``'s OWN baseline windows, so sparse screening
+        is a real extension of the dense guarantees, not a bypass —
+        support-space magnitudes never poison the dense windows and vice
+        versa.  ``remote_vec`` stays the DENSIFIED vector (the shape
+        check guards what would actually merge)."""
         cfg = self.config
         lenient = self._observe_contact(peer, round)
         if remote_vec.size != local_vec.size:
@@ -150,12 +185,18 @@ class TrustManager:
             return self._finish(
                 peer, REJECTED, ["shape_mismatch"], {}, round
             )
-        stats = payload_stats(
-            local_vec, remote_vec, self._resolve_leaf_starts(local_vec.size)
-        )
+        if sparse is not None:
+            stats = payload_stats_sparse(local_vec, sparse[0], sparse[1])
+            stats["codec"] = codec
+        else:
+            stats = payload_stats(
+                local_vec, remote_vec,
+                self._resolve_leaf_starts(local_vec.size),
+            )
+        baselines = self._baselines_for(codec)
         with self._lock:
             armed = (
-                min(len(b) for b in self._baselines.values())
+                min(len(b) for b in baselines.values())
                 >= cfg.min_window
             )
         reasons: List[str] = []
@@ -175,7 +216,7 @@ class TrustManager:
                 zmax, zstat = 0.0, None
                 with self._lock:
                     for s in BASE_STATS:
-                        z = self._baselines[s].zscore(stats[s])
+                        z = baselines[s].zscore(stats[s])
                         if z > zmax:
                             zmax, zstat = z, s
                 stats["zmax"] = round_f(zmax)
@@ -206,7 +247,7 @@ class TrustManager:
         if verdict == TRUSTED:
             with self._lock:
                 for s in BASE_STATS:
-                    self._baselines[s].push(stats[s])
+                    baselines[s].push(stats[s])
         return self._finish(peer, verdict, reasons, stats, round)
 
     def _observe_contact(self, peer: int, round: Optional[int]) -> bool:
@@ -398,7 +439,7 @@ class TrustManager:
                     "trust_damped": c.get("suspect", 0),
                     "trust_rejected": c.get("rejected", 0),
                 }
-            return {
+            out = {
                 "enabled": True,
                 "armed": fill >= self.config.min_window,
                 "window_fill": fill,
@@ -407,6 +448,15 @@ class TrustManager:
                 },
                 "peers": peers,
             }
+            if len(self._codec_baselines) > 1:
+                # Non-dense codec windows ride a separate key so a
+                # dense-only run's snapshot stays byte-identical.
+                out["codec_baselines"] = {
+                    c: {s: b.snapshot() for s, b in bl.items()}
+                    for c, bl in self._codec_baselines.items()
+                    if c != "dense"
+                }
+            return out
 
 
 def round_f(x: float, digits: int = 4) -> float:

@@ -112,6 +112,30 @@ def payload_stats(
     }
 
 
+def payload_stats_sparse(
+    local: np.ndarray,
+    indices: np.ndarray,
+    values: np.ndarray,
+) -> Dict[str, float]:
+    """Screening statistics for a SPARSE (top-k) payload: computed on
+    the densified delta's support — the coordinates the payload actually
+    touches — against the same coordinates of the local replica.
+
+    Off the support the densified vector IS the local replica by
+    construction, so full-vector cosine/norm would sit at ≈1 no matter
+    what the k shipped values contain — a sign-flip or zero-out of 5 %
+    of coordinates would drown in the 95 % of self-agreement.  Restricted
+    to the support the existing hard bounds regain their teeth: an
+    honest top-k frame lands at cosine ≈ +1 / norm_ratio ≈ 1 (absolute
+    values near consensus), a sign-flip at cosine ≈ −1, a scale attack
+    above ``norm_ratio_max``.  The per-codec baselines
+    (:class:`~dpwa_tpu.trust.manager.TrustManager`) keep these
+    support-space magnitudes out of the dense windows."""
+    local = np.ascontiguousarray(local, dtype=np.float32)
+    sel = local[np.ascontiguousarray(indices, dtype=np.intp)]
+    return payload_stats(sel, values, leaf_starts=None)
+
+
 def leaf_starts_from_sizes(
     sizes: Sequence[int], total: int
 ) -> Optional[np.ndarray]:

@@ -127,7 +127,12 @@ def tree_size_bytes(tree: PyTree) -> int:
 
 
 def tree_wire_bytes(
-    tree: PyTree, wire_dtype: str = "f32", padded: bool = True
+    tree: PyTree,
+    wire_dtype: str = "f32",
+    padded: bool = True,
+    wire_codec: str = "dense",
+    topk_fraction: float = 0.05,
+    topk_values: str = "int8",
 ) -> int:
     """Per-exchange bytes actually SHIPPED at a wire format.
 
@@ -154,9 +159,35 @@ def tree_wire_bytes(
       per chunk of the total + UNPADDED codes), exact to the byte for
       the payload ``TcpTransport.publish`` frames under
       ``wire_dtype: int8`` (the fixed 30-byte frame header is not
-      included).  Non-f32 leaves still ship as-is."""
+      included).  Non-f32 leaves still ship as-is.
+
+    ``wire_codec="topk"`` (``protocol.wire_codec``, TCP only) overrides
+    the f32-leaf accounting entirely: the flattened concatenation of all
+    f32 leaves ships as ONE sparse top-k frame —
+    ``topk_nbytes(n, topk_k(n, topk_fraction), topk_values)``, exact to
+    the byte for ``TcpTransport.publish`` under the codec (frame header
+    again excluded); non-f32 leaves ship as-is and ``wire_dtype`` is
+    ignored for f32 leaves (the codec's value-block precision is
+    ``topk_values``)."""
     if wire_dtype not in ("f32", "bf16", "int8"):
         raise ValueError(f"unknown wire_dtype {wire_dtype!r}")
+    if wire_codec not in ("dense", "topk"):
+        raise ValueError(f"unknown wire_codec {wire_codec!r}")
+    if wire_codec == "topk":
+        from dpwa_tpu.ops.quantize import topk_k, topk_nbytes
+
+        total = 0
+        f32_elems = 0
+        for leaf in jax.tree_util.tree_leaves(tree):
+            if leaf.dtype == jnp.float32:
+                f32_elems += leaf.size
+            else:
+                total += leaf.size * leaf.dtype.itemsize
+        if f32_elems:
+            total += topk_nbytes(
+                f32_elems, topk_k(f32_elems, topk_fraction), topk_values
+            )
+        return total
     if wire_dtype == "f32":
         return tree_size_bytes(tree)
     from dpwa_tpu.ops.quantize import CHUNK, _n_chunks

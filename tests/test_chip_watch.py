@@ -292,3 +292,54 @@ def test_bench_capture_freshness_gate():
     assert not bench._capture_is_fresh({"captured_at_utc": stamp(+1 * h)})
     assert not bench._capture_is_fresh({})
     assert not bench._capture_is_fresh({"captured_at_utc": "yesterday"})
+
+
+def test_bench_dead_streak_survives_stale_verdict(monkeypatch, tmp_path):
+    """The dead-tunnel memory (satellite of the probe-budget fix): a
+    verdict too old to trust as a PLATFORM answer still carries the
+    consecutive-dead-probe count, so a round starting after the ~12h gap
+    confirms a dead backend with one short probe instead of re-burning
+    the full probe budget; any live probe resets the streak."""
+    import datetime
+    import json as _json
+
+    bench = load_module("bench_streak", "bench.py")
+    path = str(tmp_path / "backend_verdict.json")
+    monkeypatch.setattr(bench, "_verdict_path", lambda: path)
+    monkeypatch.delenv("DPWA_BENCH_REPROBE", raising=False)
+
+    assert bench.load_dead_streak() == 0  # no file, no memory
+
+    bench.save_backend_verdict(None, 12.0, dead_streak=1)
+    assert bench.load_backend_verdict() is not None  # fresh: cache hit
+    assert bench.load_dead_streak() == 1
+
+    # Age the verdict past the freshness window: the platform answer is
+    # invalidated, the streak is NOT.
+    with open(path) as f:
+        v = _json.load(f)
+    v["probed_at_utc"] = (
+        datetime.datetime.now(datetime.timezone.utc)
+        - datetime.timedelta(hours=bench.VERDICT_MAX_AGE_H + 1)
+    ).strftime("%Y-%m-%dT%H:%M:%SZ")
+    with open(path, "w") as f:
+        _json.dump(v, f)
+    assert bench.load_backend_verdict() is None
+    assert bench.load_dead_streak() == 1
+    assert bench.load_dead_streak() >= bench.DEAD_STREAK_FAST_PROBE - 1
+
+    # A pre-streak dead verdict (older bench wrote no counter) counts
+    # as one miss; a live verdict always zeroes the memory.
+    del v["dead_streak"]
+    with open(path, "w") as f:
+        _json.dump(v, f)
+    assert bench.load_dead_streak() == 1
+    bench.save_backend_verdict("tpu", 3.0, dead_streak=99)  # live: reset
+    assert bench.load_dead_streak() == 0
+    with open(path) as f:
+        assert _json.load(f)["dead_streak"] == 0
+
+    # The override forces the full probe path.
+    bench.save_backend_verdict(None, 12.0, dead_streak=5)
+    monkeypatch.setenv("DPWA_BENCH_REPROBE", "1")
+    assert bench.load_dead_streak() == 0

@@ -34,6 +34,12 @@ per-peer adaptive-deadline trajectory (first/min/max/final ms), hedge
 launches and wins (with the overall hedge win rate), busy/slow soft
 outcomes, and the serving side's shed totals.
 
+``--wire`` prints the wire-plane digest (docs/wire.md): the publishing
+codec, cumulative on-wire bytes and the final wire-vs-dense compression
+ratio, the number of sparse (top-k) fetches consumed, and — when the
+prefetch pipeline contributed — the overlap occupancy and
+hidden-fetch-fraction trajectory.
+
 Usage::
 
     python tools/health_report.py metrics.jsonl [more.jsonl ...]
@@ -41,6 +47,7 @@ Usage::
     python tools/health_report.py --split-step 20 metrics.jsonl
     python tools/health_report.py --trust metrics.jsonl
     python tools/health_report.py --flowctl metrics.jsonl
+    python tools/health_report.py --wire metrics.jsonl
 """
 
 from __future__ import annotations
@@ -136,6 +143,20 @@ def summarize(
                 "slow": None,
             },
         )
+
+    wire: Dict[str, Any] = {
+        "seen": False,  # any wire column in the records
+        "codec": None,
+        "wire_bytes": None,  # final cumulative on-wire payload bytes
+        "compression_first": None,
+        "compression_final": None,
+        "topk_fetches": 0,  # exchange records consumed as sparse frames
+        "overlap_seen": False,
+        "occupancy_final": None,
+        "hidden_frac_final": None,
+        "prefetched": None,
+        "straddled": None,
+    }
 
     membership: Dict[str, Any] = {
         "partitions_entered": 0,
@@ -310,6 +331,23 @@ def summarize(
                 flowctl["hedge_rate"] = rec["hedge_rate"]
             if rec.get("shed_total") is not None:
                 flowctl["shed_total"] = rec["shed_total"]
+            if rec.get("wire_codec") is not None:
+                wire["seen"] = True
+                wire["codec"] = rec["wire_codec"]
+                wire["wire_bytes"] = rec.get("wire_bytes")
+                cr = rec.get("compression_ratio")
+                if cr is not None:
+                    if wire["compression_first"] is None:
+                        wire["compression_first"] = cr
+                    wire["compression_final"] = cr
+                if rec.get("overlap_occupancy") is not None:
+                    wire["overlap_seen"] = True
+                    wire["occupancy_final"] = rec["overlap_occupancy"]
+                    wire["hidden_frac_final"] = rec.get(
+                        "overlap_hidden_frac"
+                    )
+                    wire["prefetched"] = rec.get("overlap_prefetched")
+                    wire["straddled"] = rec.get("overlap_straddled")
             continue
         if "outcome" not in rec and "sched_partner" not in rec:
             continue  # not an exchange record (loss-only, etc.)
@@ -331,6 +369,9 @@ def summarize(
         if rec.get("hedged"):
             flowctl["seen"] = True
             flowctl["hedged_exchanges"] += 1
+        if rec.get("codec") == "topk":
+            wire["seen"] = True
+            wire["topk_fetches"] += 1
         if rec.get("outcome") == "untrusted":
             trust["seen"] = True
             trust["untrusted_fetches"] += 1
@@ -373,6 +414,7 @@ def summarize(
         "membership": membership,
         "trust": trust,
         "flowctl": flowctl,
+        "wire": wire,
     }
 
 
@@ -447,6 +489,30 @@ def _print_flowctl(summary: Dict[str, Any]) -> None:
             f"  peer {p}: {arc}; hedges={fs['hedges']}, "
             f"hedge_wins={fs['hedge_wins']}, busy={fs['busy']}, "
             f"slow={fs['slow']}"
+        )
+
+
+def _print_wire(summary: Dict[str, Any]) -> None:
+    w = summary.get("wire", {})
+    print()
+    print("# wire")
+    if not w.get("seen"):
+        print("  no wire records in input (dense sequential wire?)")
+        return
+    print(
+        f"  codec: {w.get('codec')}; on-wire payload bytes: "
+        f"{w.get('wire_bytes')}; compression ratio "
+        f"{w.get('compression_first')} -> {w.get('compression_final')} "
+        f"(dense f32 / wire)"
+    )
+    if w.get("topk_fetches"):
+        print(f"  sparse (top-k) fetches consumed: {w['topk_fetches']}")
+    if w.get("overlap_seen"):
+        print(
+            f"  prefetch overlap: occupancy {w.get('occupancy_final')}, "
+            f"hidden fetch fraction {w.get('hidden_frac_final')}; "
+            f"prefetched {w.get('prefetched')} rounds "
+            f"({w.get('straddled')} straddled a local publish)"
         )
 
 
@@ -595,6 +661,12 @@ def main(argv=None) -> int:
         "trajectory, hedge rate, busy/slow fetch counts, serving-side "
         "admission sheds)",
     )
+    ap.add_argument(
+        "--wire",
+        action="store_true",
+        help="print the wire-plane digest (publishing codec, compression "
+        "ratio, sparse fetch counts, prefetch overlap occupancy)",
+    )
     args = ap.parse_args(argv)
     summary = summarize(args.paths, split_step=args.split_step)
     if args.json:
@@ -606,6 +678,8 @@ def main(argv=None) -> int:
             _print_trust(summary)
         if args.flowctl:
             _print_flowctl(summary)
+        if args.wire:
+            _print_wire(summary)
     return 0
 
 
