@@ -396,9 +396,9 @@ def bench_wire(d: int, iters: int, timeout_ms: int = 10000) -> dict:
     from dpwa_tpu.config import make_local_config
     from dpwa_tpu.parallel.tcp import TcpTransport
 
-    def ring(**kw):
+    def ring(base_port=0, **kw):
         cfg = make_local_config(
-            2, base_port=0, schedule="ring", timeout_ms=timeout_ms, **kw
+            2, base_port=base_port, schedule="ring", timeout_ms=timeout_ms, **kw
         )
         ts = [TcpTransport(cfg, f"node{i}") for i in range(2)]
         for t in ts:
@@ -473,6 +473,82 @@ def bench_wire(d: int, iters: int, timeout_ms: int = 10000) -> dict:
     finally:
         for t in ts:
             t.close()
+
+    # Observability leg (BENCH_r07): dense f32 with tracing + sketch on.
+    # ``obs.trace`` forces the Python Rx server so serve spans can be
+    # timed, so the overhead baseline must be a dense f32 leg on the
+    # SAME server — against the native-Rx f32 leg the delta would mostly
+    # measure the server swap, not tracing.  The tracer's per-stage
+    # medians are the span breakdown; the wall delta vs the Python-Rx
+    # baseline is the measured tracing + sketch overhead (acceptance
+    # budget: <5% of round wall).
+    import os
+
+    prev_rx = os.environ.get("DPWA_NATIVE_RX")
+    os.environ["DPWA_NATIVE_RX"] = "0"
+    try:
+        # Localhost exchange walls drift by a few percent over seconds
+        # with system load — the same order as the overhead being
+        # measured — so the two legs are ITERATION-INTERLEAVED: both
+        # rings stay live (distinct ports) and each iteration drives one
+        # round on the baseline ring, then one on the obs ring, pairing
+        # walls measured milliseconds apart.  The median of per-
+        # iteration deltas is immune to drift on any slower timescale;
+        # back-to-back full drives per leg were observed to report
+        # anywhere from 0% to 11% for the same build.
+        obs_iters = max(iters, 40)
+        base_ts = ring()
+        obs_ts = ring(base_port=2, obs={"trace": True, "sketch": True})
+        try:
+            base_vecs = [b.copy() for b in base]
+            obs_vecs = [b.copy() for b in base]
+
+            def one_round(ts, vecs, it):
+                for i, t in enumerate(ts):
+                    t.publish(vecs[i], it, 0.0)
+                t0 = time.perf_counter()
+                for i, t in enumerate(ts):
+                    merged, alpha, _ = t.exchange(vecs[i], it, 0.0, it)
+                    if alpha != 0.0:
+                        vecs[i] = np.asarray(merged, np.float32)
+                return time.perf_counter() - t0
+
+            # Warmup: the sketch's one-time sign generation (a JAX
+            # compile) lands here, off the clock.
+            for it in range(5):
+                one_round(base_ts, base_vecs, it)
+                one_round(obs_ts, obs_vecs, it)
+            deltas, bases = [], []
+            for it in range(5, 5 + obs_iters):
+                b = one_round(base_ts, base_vecs, it)
+                o = one_round(obs_ts, obs_vecs, it)
+                bases.append(b)
+                deltas.append(o - b)
+            summary = obs_ts[0].tracer.stage_summary()
+        finally:
+            for t in base_ts + obs_ts:
+                t.close()
+        # Pair wall halved to the per-exchange figure the codec legs use.
+        mid = float(np.median(deltas)) * 1e3 / 2
+        pyrx_ms = round(float(np.median(bases)) * 1e3 / 2, 3)
+        obs_ms = round(pyrx_ms + max(mid, 0.0), 3)
+    finally:
+        if prev_rx is None:
+            os.environ.pop("DPWA_NATIVE_RX", None)
+        else:
+            os.environ["DPWA_NATIVE_RX"] = prev_rx
+    out["spans"] = {
+        "exchange_ms": obs_ms,
+        "pyrx_baseline_ms": pyrx_ms,
+        "stage_median_ms": {
+            stage: info["median_ms"] for stage, info in summary.items()
+        },
+        "obs_overhead_pct": (
+            round(max(obs_ms - pyrx_ms, 0.0) / pyrx_ms * 100, 2)
+            if pyrx_ms
+            else None
+        ),
+    }
     return out
 
 
@@ -672,6 +748,13 @@ def main() -> None:
                 f"{tk.get('reduction_vs_int8')}x vs int8; overlap "
                 f"hidden_frac={ov.get('hidden_frac')}"
             )
+            spans = wire_sweep.get("spans") or {}
+            if spans:
+                log(
+                    "obs leg: overhead "
+                    f"{spans.get('obs_overhead_pct')}% over f32; stage "
+                    f"medians {spans.get('stage_median_ms')}"
+                )
 
     # --- Backend probe, then the watchdog'd device leg with CPU fallback.
     # A fresh cached verdict (artifacts/backend_verdict.json) skips the

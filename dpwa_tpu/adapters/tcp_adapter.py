@@ -80,7 +80,11 @@ class DpwaTcpAdapter:
         self.last_partner = -1
         self._own_metrics = isinstance(metrics, str)
         self.metrics: Optional[MetricsLogger] = (
-            MetricsLogger(path=metrics) if self._own_metrics else metrics
+            MetricsLogger(
+                path=metrics, max_bytes=self.config.obs.log_max_bytes
+            )
+            if self._own_metrics
+            else metrics
         )
         self._health_every = max(1, health_every)
         rec = self.config.recovery
@@ -89,6 +93,12 @@ class DpwaTcpAdapter:
         self.ring: Optional[RollbackRing] = (
             RollbackRing(rec.snapshot_ring) if rec.enabled else None
         )
+        if self.ring is not None and self.transport.metrics_registry is not None:
+            # The rollback ring lives in the adapter (not the transport),
+            # so its /metrics collector is wired here.
+            from dpwa_tpu.recovery.guard import register_metrics
+
+            register_metrics(self.transport.metrics_registry, self.ring)
         self.last_bootstrap: Optional[dict] = None
         self.last_rollback: Optional[dict] = None
         if bootstrap is None:

@@ -172,6 +172,20 @@ Schema::
       request_timeout_ms: 5000  # per-connection handler budget (was the
                                 #   hard-coded 5 s accept-path timeout)
       busy_retry_ms: 50         # retry hint carried in the DPWB reply
+    obs:                        # observability plane (docs/observability.md)
+      trace: true               # per-stage round spans + cross-peer trace
+                                #   IDs piggybacked on frames (forces the
+                                #   Python Rx server for serve-side spans)
+      trace_every: 1            # sample 1-in-N rounds for tracing
+      trace_path: trace.jsonl   # trace JSONL stream (null = in-memory only)
+      trace_max_records: 4096   # in-memory trace ring (tests/adapters)
+      sketch: true              # piggyback a replica sketch per frame for
+                                #   the ring-disagreement estimate
+      sketch_k: 64              # sketch width (floats on the wire)
+      sketch_every: 1           # refresh the local sketch 1-in-N publishes
+      metrics: true             # Prometheus /metrics on the healthz port
+      log_max_bytes: 0          # rotate metrics/health JSONL at this size
+                                #   (<path>.1 roll; 0 = unbounded)
 """
 
 from __future__ import annotations
@@ -885,6 +899,69 @@ class FlowctlConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class ObsConfig:
+    """``obs:`` block — observability plane (docs/observability.md).
+
+    Three independently-gated facilities, all default-off because the
+    contract is zero-cost-when-disabled: with this block off no trailing
+    section is added to gossip frames, no tracing ``perf_counter`` calls
+    run, and exchange byte streams are bit-identical to an obs-free
+    build.
+
+    - ``trace`` — per-stage round spans written as ``trace`` JSONL
+      records, with the round's trace ID piggybacked on served frames
+      (``DPWT`` trailing section) so ``tools/trace_report.py`` can join
+      fetcher and server spans into one cross-peer timeline.  Forces the
+      Python Rx server (like flowctl) so the serve leg can be timed.
+    - ``sketch`` — a ``sketch_k``-float threefry-seeded random-projection
+      sketch of the local replica piggybacked per frame, giving every
+      peer an online ring-disagreement estimate.
+    - ``metrics`` — a Prometheus text ``/metrics`` route on the healthz
+      port, exposing counters/gauges from every enabled plane.
+
+    ``log_max_bytes`` caps any JSONL file the adapter's MetricsLogger
+    writes (health/exchange records), rolling to ``<path>.1``."""
+
+    trace: bool = False
+    trace_every: int = 1
+    trace_path: "str | None" = None
+    trace_max_records: int = 4096
+    sketch: bool = False
+    sketch_k: int = 64
+    sketch_every: int = 1
+    metrics: bool = False
+    log_max_bytes: int = 0
+
+    def __post_init__(self) -> None:
+        if self.trace_every < 1:
+            raise ValueError(
+                f"trace_every must be >= 1, got {self.trace_every}"
+            )
+        if self.trace_max_records < 1:
+            raise ValueError(
+                f"trace_max_records must be >= 1, "
+                f"got {self.trace_max_records}"
+            )
+        if not 1 <= self.sketch_k <= 4096:
+            raise ValueError(
+                f"sketch_k must be in [1, 4096], got {self.sketch_k}"
+            )
+        if self.sketch_every < 1:
+            raise ValueError(
+                f"sketch_every must be >= 1, got {self.sketch_every}"
+            )
+        if self.log_max_bytes < 0:
+            raise ValueError(
+                f"log_max_bytes must be >= 0, got {self.log_max_bytes}"
+            )
+
+    @property
+    def enabled(self) -> bool:
+        """Any facility on (the transport builds obs state iff this)."""
+        return self.trace or self.sketch or self.metrics
+
+
+@dataclasses.dataclass(frozen=True)
 class InterpolationConfig:
     type: str = "constant"
     factor: float = 0.5
@@ -907,6 +984,7 @@ class DpwaConfig:
     membership: MembershipConfig = MembershipConfig()
     trust: TrustConfig = TrustConfig()
     flowctl: FlowctlConfig = FlowctlConfig()
+    obs: ObsConfig = ObsConfig()
 
     @property
     def n_peers(self) -> int:
@@ -966,6 +1044,7 @@ def config_from_dict(raw: Mapping[str, Any]) -> DpwaConfig:
     membership = dict(raw.get("membership") or {})
     trust = dict(raw.get("trust") or {})
     flowctl = dict(raw.get("flowctl") or {})
+    obs = dict(raw.get("obs") or {})
     for key in (
         "down_windows", "partition_windows", "link_windows",
         "byzantine_peers", "trickle_windows", "accept_delay_windows",
@@ -982,6 +1061,7 @@ def config_from_dict(raw: Mapping[str, Any]) -> DpwaConfig:
         membership=MembershipConfig(**membership),
         trust=TrustConfig(**trust),
         flowctl=FlowctlConfig(**flowctl),
+        obs=ObsConfig(**obs),
     )
 
 
@@ -1009,13 +1089,14 @@ def make_local_config(
     membership: "MembershipConfig | Mapping[str, Any] | None" = None,
     trust: "TrustConfig | Mapping[str, Any] | None" = None,
     flowctl: "FlowctlConfig | Mapping[str, Any] | None" = None,
+    obs: "ObsConfig | Mapping[str, Any] | None" = None,
     **protocol_kwargs: Any,
 ) -> DpwaConfig:
     """Programmatic config for tests/benchmarks: n local peers on 127.0.0.1.
 
     ``health`` / ``chaos`` / ``recovery`` / ``membership`` / ``trust`` /
-    ``flowctl`` accept a config object or a plain dict (the YAML-block
-    shorthand)."""
+    ``flowctl`` / ``obs`` accept a config object or a plain dict (the
+    YAML-block shorthand)."""
     if isinstance(health, Mapping):
         health = HealthConfig(**health)
     if isinstance(chaos, Mapping):
@@ -1028,6 +1109,8 @@ def make_local_config(
         trust = TrustConfig(**trust)
     if isinstance(flowctl, Mapping):
         flowctl = FlowctlConfig(**flowctl)
+    if isinstance(obs, Mapping):
+        obs = ObsConfig(**obs)
     return DpwaConfig(
         nodes=tuple(
             NodeSpec(name=f"node{i}", host="127.0.0.1", port=base_port + i)
@@ -1046,4 +1129,5 @@ def make_local_config(
         membership=membership if membership is not None else MembershipConfig(),
         trust=trust if trust is not None else TrustConfig(),
         flowctl=flowctl if flowctl is not None else FlowctlConfig(),
+        obs=obs if obs is not None else ObsConfig(),
     )

@@ -425,11 +425,16 @@ class ChaosPeerServer:
             )
         )
 
-    def publish(self, vec, clock, loss, code=None, digest=None) -> None:
+    def publish(
+        self, vec, clock, loss, code=None, digest=None, obs=None,
+        trace_id=None,
+    ) -> None:
         # The integer publish clock IS the round key: training loops
         # publish clock = step, pinning faults to gossip rounds.
         self._round = int(clock)
-        self._srv.publish(vec, clock, loss, code, digest)
+        self._srv.publish(
+            vec, clock, loss, code, digest, obs=obs, trace_id=trace_id
+        )
         with self._srv._lock:
             framed = self._srv._payload
         if framed is not None:

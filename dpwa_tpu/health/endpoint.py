@@ -20,16 +20,24 @@ sub-document (per-peer adaptive deadlines, hedge/busy counters, serving
 admission sheds — present when the flowctl plane is enabled), and
 ``/wire`` the wire-plane sub-document (publishing codec, on-wire byte
 tallies, compression ratio, prefetch-overlap occupancy — present when
-the topk codec or the prefetch pipeline is enabled); every
+the topk codec or the prefetch pipeline is enabled); ``/metrics``
+serves Prometheus text exposition when a ``metrics_fn`` is wired
+(``obs.metrics``, docs/observability.md); every
 other path gets the full snapshot — the endpoint is a
-liveness/introspection hook, not a general router."""
+liveness/introspection hook, not a general router.
+
+This is the one text parser facing untrusted input, so it is written
+to shrug off garbage: a single bounded ``recv`` (oversized request
+lines are truncated, never buffered), a per-connection timeout bounding
+slow writers, and a routing step that treats anything unparseable as a
+request for the full snapshot."""
 
 from __future__ import annotations
 
 import json
 import socket
 import threading
-from typing import Callable
+from typing import Callable, Optional
 
 
 class HealthzServer:
@@ -40,8 +48,12 @@ class HealthzServer:
         snapshot_fn: Callable[[], dict],
         host: str = "127.0.0.1",
         port: int = 0,
+        metrics_fn: "Optional[Callable[[], str]]" = None,
+        request_timeout_s: float = 2.0,
     ):
         self._snapshot_fn = snapshot_fn
+        self._metrics_fn = metrics_fn
+        self._request_timeout_s = max(0.05, float(request_timeout_s))
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
@@ -66,7 +78,7 @@ class HealthzServer:
             except OSError:
                 break
             try:
-                conn.settimeout(2.0)
+                conn.settimeout(self._request_timeout_s)
                 # Read the request line (best effort) for the one routed
                 # path; anything unparseable serves the full snapshot.
                 raw = b""
@@ -74,9 +86,25 @@ class HealthzServer:
                     raw = conn.recv(4096)
                 except OSError:
                     pass
+                request_line = raw.split(b"\r\n", 1)[0]
+                if self._metrics_fn is not None and (
+                    b" /metrics" in request_line
+                ):
+                    try:
+                        text = self._metrics_fn()
+                    except Exception:  # never kill the endpoint
+                        text = ""
+                    body = text.encode()
+                    conn.sendall(
+                        b"HTTP/1.0 200 OK\r\n"
+                        b"Content-Type: text/plain; version=0.0.4; "
+                        b"charset=utf-8\r\n"
+                        b"Content-Length: " + str(len(body)).encode()
+                        + b"\r\nConnection: close\r\n\r\n" + body
+                    )
+                    continue
                 try:
                     doc = self._snapshot_fn()
-                    request_line = raw.split(b"\r\n", 1)[0]
                     if b" /membership" in request_line:
                         doc = doc.get("membership") or {
                             "error": "membership disabled"

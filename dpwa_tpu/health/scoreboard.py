@@ -418,3 +418,58 @@ def run_probe(
         ok = False
     scoreboard.record_probe(peer, ok, round)
     return ok
+
+
+# Numeric encoding of PeerState for the /metrics exposition (strings
+# ride JSONL; Prometheus wants numbers).
+_STATE_CODES = {
+    PeerState.HEALTHY: 0,
+    PeerState.SUSPECT: 1,
+    PeerState.DEGRADED: 2,
+    PeerState.QUARANTINED: 3,
+}
+
+
+def register_metrics(registry, scoreboard: Scoreboard) -> None:
+    """Expose the health plane on a :class:`dpwa_tpu.obs.MetricsRegistry`.
+
+    Pull-based: nothing is sampled until a ``/metrics`` scrape calls the
+    collector, which reads one :meth:`Scoreboard.snapshot`."""
+    from dpwa_tpu.obs.prometheus import Family
+
+    def collect():
+        snap = scoreboard.snapshot()
+        state = Family(
+            "dpwa_peer_state", "gauge",
+            "Scoreboard state per peer (0 healthy, 1 suspect, "
+            "2 degraded, 3 quarantined)",
+        )
+        suspicion = Family(
+            "dpwa_peer_suspicion", "gauge",
+            "Failure-detector suspicion score per peer",
+        )
+        quarantines = Family(
+            "dpwa_peer_quarantines_total", "counter",
+            "Lifetime quarantine entries per peer",
+        )
+        attempts = Family(
+            "dpwa_peer_attempts_total", "counter",
+            "Exchange attempts recorded per peer",
+        )
+        failures = Family(
+            "dpwa_peer_failures_total", "counter",
+            "Failed exchange attempts recorded per peer",
+        )
+        for p, info in sorted(snap.get("peers", {}).items()):
+            labels = {"peer": p}
+            state.sample(_STATE_CODES.get(info.get("state")), labels)
+            suspicion.sample(info.get("suspicion"), labels)
+            quarantines.sample(info.get("quarantines"), labels)
+            attempts.sample(info.get("attempts"), labels)
+            failures.sample(info.get("failures"), labels)
+        rnd = Family(
+            "dpwa_health_round", "counter", "Scoreboard round clock"
+        ).sample(snap.get("round"))
+        return [state, suspicion, quarantines, attempts, failures, rnd]
+
+    registry.register(collect)

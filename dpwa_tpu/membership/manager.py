@@ -331,3 +331,27 @@ class MembershipManager:
                     p: e.incarnation for p, e in sorted(self._view.items())
                 },
             }
+
+
+def register_metrics(registry, manager: "MembershipManager") -> None:
+    """Expose the membership plane on a MetricsRegistry (pull-based)."""
+    from dpwa_tpu.obs.prometheus import Family
+
+    def collect():
+        view = manager.view_snapshot()
+        return [
+            Family(
+                "dpwa_membership_incarnation", "counter",
+                "Own SWIM incarnation number",
+            ).sample(view.get("incarnation")),
+            Family(
+                "dpwa_membership_component_size", "gauge",
+                "Size of the connected component this node sits in",
+            ).sample(view.get("component_size")),
+            Family(
+                "dpwa_membership_degraded", "gauge",
+                "1 when the partition quorum check has degraded the node",
+            ).sample(view.get("partition_state") == "degraded"),
+        ]
+
+    registry.register(collect)

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import atexit
 import json
+import os
 import sys
 import time
 from typing import IO, Any, Mapping, Optional
@@ -49,13 +50,40 @@ class MetricsLogger:
         path: Optional[str] = None,
         stream: Optional[IO[str]] = None,
         every: int = 1,
+        max_bytes: int = 0,
     ):
+        self._path = path
         self._file = open(path, "a", encoding="utf-8") if path else None
         self._stream = stream
         self.every = max(1, every)
+        # Size cap for the JSONL file: when the next record would push it
+        # past ``max_bytes`` the current file rolls to ``<path>.1``
+        # (replacing any previous roll) and a fresh file starts — a soak
+        # run keeps at most ~2x max_bytes on disk instead of growing
+        # unboundedly.  0 = unbounded (the historical behaviour).
+        self.max_bytes = max(0, int(max_bytes))
         self._t0 = time.perf_counter()
         self._pending = None
         self._atexit = atexit.register(self.flush)
+
+    def _write(self, line: str) -> None:
+        if self._file is not None:
+            if self.max_bytes and self._path:
+                try:
+                    pos = self._file.tell()
+                except OSError:
+                    pos = 0
+                if pos and pos + len(line) + 1 > self.max_bytes:
+                    try:
+                        self._file.close()
+                        os.replace(self._path, self._path + ".1")
+                    except OSError:
+                        pass
+                    self._file = open(self._path, "a", encoding="utf-8")
+            self._file.write(line + "\n")
+            self._file.flush()
+        if self._stream is not None:
+            print(line, file=self._stream, flush=True)
 
     def __enter__(self) -> "MetricsLogger":
         return self
@@ -79,12 +107,7 @@ class MetricsLogger:
         }
         for k, v in fields.items():
             rec[k] = _jsonable(v)
-        line = json.dumps(rec)
-        if self._file is not None:
-            self._file.write(line + "\n")
-            self._file.flush()
-        if self._stream is not None:
-            print(line, file=self._stream, flush=True)
+        self._write(json.dumps(rec))
 
     def elapsed(self) -> float:
         """Seconds since this logger was created (the ``t`` clock)."""
@@ -155,6 +178,9 @@ class MetricsLogger:
           ``overlap_prefetched`` / ``overlap_straddled`` — the wire
           plane's codec accounting and prefetch-overlap view (present
           only when the topk codec or the prefetch pipeline is on);
+        - ``disagreement_rms`` / ``disagreement_rel`` / ``sketch_peers``
+          — the obs plane's sketch-based ring-disagreement estimate
+          (present only when ``obs.sketch`` is on);
 
         plus attempt/success/quarantine counters.  Obeys ``every`` like
         every other record; written immediately (health snapshots are
@@ -230,6 +256,19 @@ class MetricsLogger:
                     overlap_prefetched=overlap.get("prefetched"),
                     overlap_straddled=overlap.get("straddled"),
                 )
+        obs = snapshot.get("obs")
+        if obs is not None:
+            # Observability columns (absent without the obs plane,
+            # keeping earlier records byte-identical): the sketch-based
+            # ring-disagreement estimate described in docs/observability.md.
+            conv = obs.get("convergence")
+            if conv is not None:
+                extra = dict(
+                    extra,
+                    disagreement_rms=conv.get("rms"),
+                    disagreement_rel=conv.get("rel_rms"),
+                    sketch_peers=conv.get("peers_seen"),
+                )
         self.log(
             step,
             record="health",
@@ -266,12 +305,7 @@ class MetricsLogger:
         }
         for k, v in fields.items():
             rec[k] = _jsonable(v)
-        line = json.dumps(rec)
-        if self._file is not None:
-            self._file.write(line + "\n")
-            self._file.flush()
-        if self._stream is not None:
-            print(line, file=self._stream, flush=True)
+        self._write(json.dumps(rec))
 
     def flush(self) -> None:
         """Write the deferred record, if any (blocks only on its arrays)."""

@@ -1,0 +1,39 @@
+"""Observability plane: round-trace spans, convergence sketches, /metrics.
+
+Three independent, individually-gated facilities (``obs:`` config block,
+all default-off; see docs/observability.md):
+
+- ``trace`` — a :class:`~dpwa_tpu.obs.trace.Tracer` timing every stage of
+  an exchange (partner draw, wire leg, decode, guard, trust screen,
+  merge, publish, prefetch join) into a ``trace`` JSONL stream, with the
+  round's trace ID piggybacked on gossip frames so the serving peer's
+  spans join the fetching peer's spans into one cross-peer timeline
+  (``tools/trace_report.py``).
+- ``sketch`` — a seeded random-projection sketch of the local replica
+  (:mod:`dpwa_tpu.obs.sketch`) piggybacked per frame, giving every peer
+  an online estimate of ring-wide replica disagreement without extra
+  round trips.
+- ``metrics`` — a pull-based :class:`~dpwa_tpu.obs.prometheus.MetricsRegistry`
+  over the health/recovery/membership/trust/flowctl/wire planes, served
+  as a Prometheus text ``/metrics`` route on the healthz port.
+
+Everything here is zero-cost when disabled: with the ``obs:`` block off
+no trailing section is emitted, no ``perf_counter`` calls are added to
+the hot path, and exchange byte streams are bit-identical to an
+obs-free build.
+"""
+
+from dpwa_tpu.obs.prometheus import MetricsRegistry
+from dpwa_tpu.obs.sketch import SketchBoard, replica_sketch
+from dpwa_tpu.obs.trace import Tracer
+from dpwa_tpu.obs.wire import ObsFrame, decode_obs, encode_obs
+
+__all__ = [
+    "MetricsRegistry",
+    "ObsFrame",
+    "SketchBoard",
+    "Tracer",
+    "decode_obs",
+    "encode_obs",
+    "replica_sketch",
+]

@@ -1,0 +1,217 @@
+"""Seeded random-projection sketch of the local replica.
+
+The paper's convergence quantity is ring-wide replica disagreement —
+how far apart the peers' parameter vectors are.  Measuring it directly
+would need all-to-all parameter exchange; instead every peer piggybacks
+a tiny *sketch* of its replica on each served frame (``DPWT`` section,
+dpwa_tpu/obs/wire.py) and every fetcher folds the sketches it sees into
+an online disagreement estimate.
+
+The sketch is a **blocked-Rademacher projection**: the flattened replica
+is zero-padded to ``k*m``, multiplied elementwise by a cached ±1 sign
+vector, and block-summed into ``k`` floats::
+
+    s_j = sum_i  sign[j, i] * v[j*m + i]
+
+With i.i.d. Rademacher signs the cross terms vanish in expectation, so
+for any two replicas ``E ||s_a - s_b||^2 = ||a - b||^2`` — an unbiased
+distance estimator with variance ~ 2/k of the square, at the cost of
+roughly two vectorized passes over the parameters (well under the <5%
+round-overhead budget; a dense k x d JL projection would be k passes).
+As a free corollary ``E ||s||^2 = ||v||^2``, which is what the DPWT
+header's ``norm_est`` field carries.
+
+Determinism: the signs come from the run's threefry seed via the same
+``_pair_key`` fold-in chain as every other control draw (control tag 9,
+reserved here), keyed on the seed *only* — every peer in a run projects
+through the same signs, so sketches are directly comparable, and a rerun
+with the same seed reproduces them bit-for-bit.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Dict, Optional
+
+import numpy as np
+
+# Control-draw tag claimed from the 9..15 free range documented in
+# dpwa_tpu/parallel/schedules.py (0..8 taken by participation, fault,
+# fallback, backoff, donor, relay, heal, and degrade-shed draws).
+SKETCH_TAG = 9
+
+_sign_lock = threading.Lock()
+_sign_cache: Dict[tuple, np.ndarray] = {}
+
+
+def _sketch_signs(seed: int, n: int, k: int) -> np.ndarray:
+    """Cached ±1 sign matrix of shape (k, ceil(n/k)) for (seed, n, k).
+
+    Stored as float32 (not int8): the projection below is a single
+    ``einsum('km,km->k')`` against the f32 replica, and a same-dtype
+    einsum runs ~5x faster than an int8-upcast multiply + reduce —
+    the difference between fitting the <5% obs-overhead budget and
+    blowing it.  Cache cost is 4 bytes/parameter for <= 4 shapes."""
+    key = (int(seed), int(n), int(k))
+    with _sign_lock:
+        hit = _sign_cache.get(key)
+    if hit is not None:
+        return hit
+    import jax
+    import jax.numpy as jnp
+
+    from dpwa_tpu.parallel.schedules import _pair_key
+
+    m = -(-n // k)
+    rk = _pair_key(int(seed), 0, 0, SKETCH_TAG)
+    signs = (
+        np.asarray(jax.random.rademacher(rk, (k * m,), dtype=jnp.int8))
+        .reshape(k, m)
+        .astype(np.float32)
+    )
+    with _sign_lock:
+        # One replica shape per process in practice; keep the cache from
+        # accreting if a test sweeps shapes.
+        if len(_sign_cache) >= 4:
+            _sign_cache.clear()
+        _sign_cache[key] = signs
+    return signs
+
+
+def replica_sketch(vec: np.ndarray, seed: int, k: int = 64) -> np.ndarray:
+    """Project a flattened replica to ``k`` float32s (see module doc)."""
+    v = np.ascontiguousarray(vec, dtype=np.float32).reshape(-1)
+    n = v.size
+    k = int(k)
+    if n == 0 or k <= 0:
+        return np.zeros(max(k, 0), dtype=np.float32)
+    signs = _sketch_signs(seed, n, k)
+    m = signs.shape[1]
+    if k * m != n:
+        v = np.concatenate([v, np.zeros(k * m - n, dtype=np.float32)])
+    # Batched (1,m)@(m,1) matvec — one fused BLAS pass per block, no k*m
+    # temporary.  f32 accumulation is plenty for an estimator whose own
+    # variance is ~2/k of the quantity squared.
+    out = np.matmul(
+        signs[:, None, :], v.reshape(k, m)[:, :, None]
+    ).reshape(k)
+    return np.ascontiguousarray(out, dtype=np.float32)
+
+
+class SketchBoard:
+    """Online ring-disagreement estimate from piggybacked sketches.
+
+    Thread-safe: remote sketches arrive on whatever thread runs the
+    consume half of a fetch, and ``snapshot()`` is read by the healthz
+    thread and the metrics registry.
+    """
+
+    def __init__(self, me: int, k: int = 64):
+        self.me = int(me)
+        self.k = int(k)
+        self._lock = threading.Lock()
+        self._local: Optional[np.ndarray] = None
+        self._local_seq: Optional[int] = None
+        self._remote: Dict[int, dict] = {}  # origin -> {sketch, seq, round}
+
+    def note_local(self, seq: int, sketch: np.ndarray) -> None:
+        with self._lock:
+            self._local = sketch
+            self._local_seq = int(seq)
+
+    def note_remote(
+        self,
+        origin: int,
+        seq: int,
+        sketch: np.ndarray,
+        round: Optional[int] = None,
+    ) -> None:
+        origin = int(origin)
+        if origin == self.me or sketch is None:
+            return
+        with self._lock:
+            prev = self._remote.get(origin)
+            # seq is a truncated publish clock; keep the newest, but
+            # accept resets (a restarted peer republishes from 0).
+            if prev is not None and 0 <= int(seq) < prev["seq"] <= 1 << 20:
+                return
+            self._remote[origin] = {
+                "sketch": sketch,
+                "seq": int(seq),
+                "round": None if round is None else int(round),
+            }
+
+    def disagreement(self) -> tuple:
+        """``(rms, rel_rms)`` only — the hot-path slice of ``snapshot()``.
+
+        The round tracer reads this every traced round, so it skips the
+        per-peer dict building and the ``np.linalg.norm`` wrappers (a
+        raw ``dot`` on a k-float vector is ~10x cheaper).  ``(None,
+        None)`` until both a local and a remote sketch exist."""
+        with self._lock:
+            local = self._local
+            if local is None or not self._remote:
+                return None, None
+            tot, n = 0.0, 0
+            for info in self._remote.values():
+                sk = info["sketch"]
+                if sk.shape != local.shape:
+                    continue
+                dv = local - sk
+                tot += float(np.dot(dv, dv))
+                n += 1
+            if n == 0:
+                return None, None
+            norm2 = float(np.dot(local, local))
+        rms = math.sqrt(tot / n)
+        rel = rms / math.sqrt(norm2) if norm2 > 0.0 else None
+        return rms, rel
+
+    def snapshot(self) -> dict:
+        """Disagreement estimate vs every peer seen so far.
+
+        ``rms`` is the root-mean-square over peers of the estimated
+        replica distance ``||v_me - v_p||``; ``rel_rms`` divides by the
+        local norm estimate so curves from different model scales
+        compare.  All None until both a local sketch and at least one
+        remote sketch exist.
+        """
+        with self._lock:
+            local = self._local
+            local_seq = self._local_seq
+            remote = {
+                p: dict(info) for p, info in self._remote.items()
+            }
+        out: dict = {
+            "k": self.k,
+            "seq": local_seq,
+            "peers_seen": len(remote),
+            "rms": None,
+            "rel_rms": None,
+            "norm_est": None,
+            "peers": {},
+        }
+        if local is None:
+            return out
+        norm = float(np.linalg.norm(local))
+        out["norm_est"] = round(norm, 6)
+        if not remote:
+            return out
+        d2 = []
+        for p, info in sorted(remote.items()):
+            sk = info["sketch"]
+            if sk.shape != local.shape:
+                continue
+            dist = float(np.linalg.norm(local - sk))
+            d2.append(dist * dist)
+            out["peers"][str(p)] = {
+                "distance": round(dist, 6),
+                "seq": info["seq"],
+            }
+        if not d2:
+            return out
+        rms = float(np.sqrt(np.mean(d2)))
+        out["rms"] = round(rms, 6)
+        out["rel_rms"] = round(rms / max(norm, 1e-12), 6)
+        return out

@@ -1,0 +1,125 @@
+"""``DPWT`` trailing section: trace ID + replica sketch on gossip frames.
+
+Mirrors the membership digest (``DPWM``, dpwa_tpu/membership/digest.py):
+an *optional* section appended after the payload of a served frame,
+never counted in the header's ``nbytes``, read tolerantly in two phases
+(fixed header, then a body whose size the header declares) so that
+
+- readers that predate this section see nothing (they stop at the
+  payload, or their digest read fails the magic check harmlessly), and
+- readers that expect it degrade to ``None`` on truncation, wrong
+  magic/version, or an absurd sketch length — a malformed trailer can
+  degrade observability but never an exchange.
+
+Layout (little-endian)::
+
+    DPWT | u8 version | u16 origin | u32 seq | f32 norm_est | u16 n
+    n x f32 sketch values
+
+``seq`` is the publisher's publish clock truncated to 32 bits; the
+string form ``"{origin}:{seq}"`` is the cross-peer trace ID joining the
+server-side spans of this frame to the fetcher's round record.
+``norm_est`` is the publisher's replica-norm estimate (the sketch's own
+L2 norm — unbiased for the replica norm, so it costs no extra pass over
+the parameters); zero when the sketch is off.  ``n`` is zero when only
+tracing is enabled.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import struct
+from typing import Optional
+
+import numpy as np
+
+OBS_MAGIC = b"DPWT"
+OBS_VERSION = 1
+
+_OBS_HDR = struct.Struct("<4sBHIfH")  # magic, version, origin, seq, norm, n
+
+OBS_HEADER_SIZE = _OBS_HDR.size
+
+# A sketch is ~64 floats by design; anything past this is a corrupt or
+# hostile length field, not a bigger sketch.
+MAX_SKETCH_VALUES = 4096
+
+
+def header_sketch_count(header: bytes) -> Optional[int]:
+    """Sketch-value count declared by ``header``, or None if it is not a
+    valid DPWT header (wrong size, magic, version, or absurd count)."""
+    if len(header) != OBS_HEADER_SIZE:
+        return None
+    magic, version, _origin, _seq, _norm, n = _OBS_HDR.unpack(header)
+    if magic != OBS_MAGIC or version != OBS_VERSION:
+        return None
+    if n > MAX_SKETCH_VALUES:
+        return None
+    return n
+
+
+def values_size(n: int) -> int:
+    """On-wire size of ``n`` sketch values."""
+    return 4 * n
+
+
+@dataclasses.dataclass(frozen=True)
+class ObsFrame:
+    """Decoded DPWT section."""
+
+    origin: int
+    seq: int
+    norm_est: float
+    sketch: Optional[np.ndarray]  # float32 (n,) or None when n == 0
+
+    @property
+    def trace_id(self) -> str:
+        return f"{self.origin}:{self.seq}"
+
+
+def encode_obs(
+    origin: int,
+    seq: int,
+    norm_est: float = 0.0,
+    sketch: Optional[np.ndarray] = None,
+) -> bytes:
+    if sketch is None:
+        vals = b""
+        n = 0
+    else:
+        s = np.ascontiguousarray(sketch, dtype="<f4").reshape(-1)
+        if s.size > MAX_SKETCH_VALUES:
+            raise ValueError(f"sketch too large: {s.size}")
+        vals = s.tobytes()
+        n = s.size
+    head = _OBS_HDR.pack(
+        OBS_MAGIC,
+        OBS_VERSION,
+        int(origin) & 0xFFFF,
+        int(seq) & 0xFFFFFFFF,
+        float(norm_est),
+        n,
+    )
+    return head + vals
+
+
+def decode_obs(blob: bytes) -> Optional[ObsFrame]:
+    """Tolerant decode; None on any malformation."""
+    if len(blob) < OBS_HEADER_SIZE:
+        return None
+    n = header_sketch_count(blob[:OBS_HEADER_SIZE])
+    if n is None or len(blob) != OBS_HEADER_SIZE + values_size(n):
+        return None
+    _magic, _version, origin, seq, norm, n = _OBS_HDR.unpack(
+        blob[:OBS_HEADER_SIZE]
+    )
+    sketch = None
+    if n:
+        sketch = np.frombuffer(
+            blob, dtype="<f4", count=n, offset=OBS_HEADER_SIZE
+        ).astype(np.float32)
+        if not np.all(np.isfinite(sketch)):
+            return None
+    return ObsFrame(
+        origin=origin, seq=seq, norm_est=float(norm), sketch=sketch
+    )

@@ -211,6 +211,7 @@ def _frame(
     loss: float,
     code: Optional[int] = None,
     digest: Optional[bytes] = None,
+    obs: Optional[bytes] = None,
 ) -> bytes:
     """Header + raw vector bytes — the one definition of the wire format,
     shared by the Python and native Rx servers.
@@ -223,7 +224,15 @@ def _frame(
     still counts only the vector, so a pre-membership fetcher reads
     exactly header + payload and never sees the trailer, while a
     digest-aware fetcher attempts a tolerant trailing read — version-
-    gated wire compatibility in both directions (docs/membership.md)."""
+    gated wire compatibility in both directions (docs/membership.md).
+
+    ``obs`` (a serialized ``DPWT`` observability section: trace id +
+    replica sketch, dpwa_tpu/obs/wire.py) rides the same way, AFTER the
+    digest when both are present.  Ordering matters for back-compat:
+    a digest-aware pre-obs fetcher reads the digest it wants, then its
+    next read fails the DPWM magic check on the DPWT header and stops
+    harmlessly; obs-aware fetchers dispatch trailers by magic
+    (:func:`_read_trailers`) and handle every presence combination."""
     vec = np.ascontiguousarray(vec)
     if code is None:
         # Exact-dtype lookup first (covers bf16, whose custom numpy dtype
@@ -242,8 +251,8 @@ def _frame(
             code = _DTYPE_CODES[np.dtype("<f4")]
     data = vec.tobytes()
     header = _HDR.pack(_MAGIC, 1, code, float(clock), float(loss), len(data))
-    if digest:
-        return header + data + digest
+    if digest or obs:
+        return header + data + (digest or b"") + (obs or b"")
     return header + data
 
 
@@ -260,6 +269,14 @@ class PeerServer:
     # partitions constrain relays exactly like real ones).
     relay_guard = None
 
+    # Optional serve-span hook (obs.trace): a callable
+    # (trace_id, nbytes, dur_s) invoked after each served blob, wired by
+    # the transport to Tracer.note_serve so the serving side of an
+    # exchange lands in the cross-peer round trace.  The trace id is
+    # stored WITH the payload under the publish lock, so a served frame
+    # and the id reported for it can never come from different rounds.
+    obs_serve_hook = None
+
     def __init__(
         self,
         host: str,
@@ -268,6 +285,7 @@ class PeerServer:
     ):
         self._lock = threading.Lock()
         self._payload: Optional[bytes] = None  # pre-framed header+data
+        self._payload_trace_id: Optional[str] = None
         self._state: Optional[bytes] = None  # serialized bootstrap state
         self._state_gen = 0
         # Serving-side flow control (dpwa_tpu/flowctl/): connection cap,
@@ -298,10 +316,13 @@ class PeerServer:
         loss: float,
         code: Optional[int] = None,
         digest: Optional[bytes] = None,
+        obs: Optional[bytes] = None,
+        trace_id: Optional[str] = None,
     ) -> None:
-        payload = _frame(vec, clock, loss, code, digest)
+        payload = _frame(vec, clock, loss, code, digest, obs)
         with self._lock:
             self._payload = payload
+            self._payload_trace_id = trace_id
 
     def publish_state(self, blob: bytes) -> None:
         """Expose a serialized train state for peer-assisted bootstrap.
@@ -426,6 +447,7 @@ class PeerServer:
         """Send the published frame under the in-flight-bytes ceiling."""
         with self._lock:
             payload = self._payload
+            trace_id = self._payload_trace_id
         if payload is None:
             return
         adm = self.admission
@@ -437,11 +459,18 @@ class PeerServer:
             except OSError:
                 pass
             return
+        hook = self.obs_serve_hook
+        t0 = time.monotonic() if hook is not None else 0.0
         try:
             conn.sendall(payload)
         finally:
             if adm is not None:
                 adm.release_bytes(len(payload))
+            if hook is not None and trace_id is not None:
+                try:
+                    hook(trace_id, len(payload), time.monotonic() - t0)
+                except Exception:
+                    pass  # observability must never break a serve
 
     def _handle_relay(self, conn: socket.socket) -> None:
         """Serve one relayed header probe: probe the requested target
@@ -522,10 +551,14 @@ class NativePeerServer:
         loss: float,
         code: Optional[int] = None,
         digest: Optional[bytes] = None,
+        obs: Optional[bytes] = None,
+        trace_id: Optional[str] = None,
     ) -> None:
         # The native loop serves the framed bytes verbatim, so the
-        # digest trailer rides along without the C++ side knowing.
-        self._srv.publish_framed(_frame(vec, clock, loss, code, digest))
+        # digest/obs trailers ride along without the C++ side knowing.
+        # trace_id is accepted-and-ignored: serve-side spans need the
+        # Python server's hook (the transport forces it when obs.trace).
+        self._srv.publish_framed(_frame(vec, clock, loss, code, digest, obs))
 
     def publish_state(self, blob: bytes) -> None:
         raise RuntimeError(
@@ -602,6 +635,74 @@ def _read_digest_trailer(
     return head + body
 
 
+def _read_trailers(
+    sock: socket.socket,
+    want_digest: bool,
+    want_obs: bool,
+    budget_s: float = 0.25,
+) -> Tuple[Optional[bytes], Optional[bytes]]:
+    """Magic-dispatched tolerant read of ALL optional trailing sections.
+
+    A served frame may carry, after the payload: a membership digest
+    (``DPWM``) and/or an observability section (``DPWT``), in that
+    order.  Reading them naively in sequence breaks when the local node
+    wants only one of them — e.g. membership off + obs on against a peer
+    serving both would consume the digest header while looking for the
+    obs magic and lose the section boundary.  So: read a 4-byte magic
+    tolerantly, dispatch on it, repeat; stop on anything unrecognized.
+    Sections the caller doesn't want are still consumed (the socket is
+    about to close — the bytes are free) but returned as None.
+
+    Returns ``(digest_bytes, obs_bytes)``; each None when absent,
+    malformed, or unwanted.  Never raises."""
+    from dpwa_tpu.membership.digest import (
+        DIGEST_MAGIC,
+        HEADER_SIZE,
+        entries_size,
+        header_entry_count,
+    )
+    from dpwa_tpu.obs.wire import (
+        OBS_HEADER_SIZE,
+        OBS_MAGIC,
+        header_sketch_count,
+        values_size,
+    )
+
+    deadline = time.monotonic() + budget_s
+    digest = obs = None
+    # Bounded dispatch: one section per known magic, tiny loop cap so a
+    # hostile peer streaming valid-looking sections can't pin us here.
+    for _ in range(4):
+        magic = _recv_trailing(sock, 4, deadline)
+        if magic is None:
+            break
+        if magic == DIGEST_MAGIC and digest is None:
+            rest = _recv_trailing(sock, HEADER_SIZE - 4, deadline)
+            if rest is None:
+                break
+            n = header_entry_count(magic + rest)
+            if n is None:
+                break
+            body = _recv_trailing(sock, entries_size(n), deadline)
+            if body is None:
+                break
+            digest = magic + rest + body
+        elif magic == OBS_MAGIC and obs is None:
+            rest = _recv_trailing(sock, OBS_HEADER_SIZE - 4, deadline)
+            if rest is None:
+                break
+            n = header_sketch_count(magic + rest)
+            if n is None:
+                break
+            body = _recv_trailing(sock, values_size(n), deadline)
+            if body is None:
+                break
+            obs = magic + rest + body
+        else:
+            break
+    return (digest if want_digest else None, obs if want_obs else None)
+
+
 def fetch_blob_full(
     host: str,
     port: int,
@@ -609,18 +710,20 @@ def fetch_blob_full(
     min_bandwidth_bps: float = _MIN_WIRE_BANDWIDTH,
     want_digest: bool = False,
     sock_box: Optional[list] = None,
+    want_obs: bool = False,
 ) -> Tuple[
     Optional[Tuple[np.ndarray, float, float]], str, float, int,
-    Optional[bytes],
+    Optional[bytes], Optional[bytes],
 ]:
     """:func:`fetch_blob` plus the classified outcome the health
-    subsystem feeds on, plus the optional membership-digest trailer.
+    subsystem feeds on, plus the optional trailing sections.
 
     Returns ``(result, outcome, latency_s, payload_bytes_received,
-    digest)`` where ``result`` is ``(vec, clock, loss)`` or None,
-    ``digest`` is the raw trailer bytes (only attempted when
-    ``want_digest`` and the payload fetch succeeded; None whenever the
-    peer served no valid trailer) and ``outcome``
+    digest, obs)`` where ``result`` is ``(vec, clock, loss)`` or None,
+    ``digest`` is the raw membership-digest trailer bytes and ``obs``
+    the raw DPWT observability trailer bytes (each only attempted when
+    ``want_digest`` / ``want_obs`` and the payload fetch succeeded; None
+    whenever the peer served no valid section) and ``outcome``
     is one of :class:`dpwa_tpu.health.detector.Outcome`:
 
     - ``refused`` — the connect itself failed (peer process gone);
@@ -664,11 +767,11 @@ def fetch_blob_full(
             (host, port), timeout=timeout_ms / 1000.0
         )
     except socket.timeout:
-        return None, Outcome.TIMEOUT, time.monotonic() - t0, 0, None
+        return None, Outcome.TIMEOUT, time.monotonic() - t0, 0, None, None
     except (ConnectionError, OSError):
         # Refused, unreachable, reset during handshake: no peer process
         # is answering on that port.
-        return None, Outcome.REFUSED, time.monotonic() - t0, 0, None
+        return None, Outcome.REFUSED, time.monotonic() - t0, 0, None, None
     if sock_box is not None:
         sock_box.append(sock)
     try:
@@ -697,9 +800,9 @@ def fetch_blob_full(
                 if bversion != 1:
                     return (
                         None, Outcome.CORRUPT, time.monotonic() - t0, 0,
-                        None,
+                        None, None,
                     )
-                return None, Outcome.BUSY, time.monotonic() - t0, 0, None
+                return None, Outcome.BUSY, time.monotonic() - t0, 0, None, None
             raw = peek + _recv_exact(
                 sock, _HDR.size - 4, deadline, progress=rx
             )
@@ -707,9 +810,15 @@ def fetch_blob_full(
             if magic != _MAGIC or version != 1 or (
                 code not in _DTYPES and code not in _PAYLOAD_CODES
             ):
-                return None, Outcome.CORRUPT, time.monotonic() - t0, 0, None
+                return (
+                    None, Outcome.CORRUPT, time.monotonic() - t0, 0, None,
+                    None,
+                )
             if nbytes > _MAX_BLOB:
-                return None, Outcome.CORRUPT, time.monotonic() - t0, 0, None
+                return (
+                    None, Outcome.CORRUPT, time.monotonic() - t0, 0, None,
+                    None,
+                )
             data = _recv_exact(
                 sock, nbytes, deadline, 1.0 / min_bandwidth_bps,
                 progress=rx,
@@ -732,7 +841,7 @@ def fetch_blob_full(
                 except ValueError:
                     return (
                         None, Outcome.CORRUPT,
-                        time.monotonic() - t0, nbytes_rx, None,
+                        time.monotonic() - t0, nbytes_rx, None, None,
                     )
             elif code == _INT8_CHUNKED:
                 # Receiver-side dequantize: the wire moved 1 byte/elem
@@ -759,24 +868,30 @@ def fetch_blob_full(
                         None, Outcome.CORRUPT,
                         time.monotonic() - t0, nbytes_rx, None,
                     )
-            # Optional epidemic-membership trailer: attempted only after
-            # a fully valid payload (a frame that failed above carries
-            # no trustworthy trailer), tolerant of its absence.
-            digest = _read_digest_trailer(sock) if want_digest else None
+            # Optional trailing sections (epidemic-membership digest,
+            # DPWT observability): attempted only after a fully valid
+            # payload (a frame that failed above carries no trustworthy
+            # trailer), tolerant of their absence, dispatched by magic
+            # so every presence combination parses.
+            if want_digest or want_obs:
+                digest, obs = _read_trailers(sock, want_digest, want_obs)
+            else:
+                digest = obs = None
             return (
                 (vec, clock, loss), Outcome.SUCCESS,
-                time.monotonic() - t0, nbytes_rx, digest,
+                time.monotonic() - t0, nbytes_rx, digest, obs,
             )
     except socket.timeout:
         # Bytes flowed and the budget still lapsed: a live-but-slow peer
         # (trickle, overload) — soft evidence, not a death mark.
         outcome = Outcome.SLOW if rx[0] > 0 else Outcome.TIMEOUT
-        return None, outcome, time.monotonic() - t0, nbytes_rx, None
+        return None, outcome, time.monotonic() - t0, nbytes_rx, None, None
     except (ConnectionError, OSError):
         # Accepted, then closed/reset mid-frame: the peer process is
         # alive enough to accept but served a broken stream.
         return (
-            None, Outcome.SHORT_READ, time.monotonic() - t0, nbytes_rx, None
+            None, Outcome.SHORT_READ, time.monotonic() - t0, nbytes_rx, None,
+            None,
         )
 
 
@@ -788,7 +903,7 @@ def fetch_blob_ex(
 ) -> Tuple[
     Optional[Tuple[np.ndarray, float, float]], str, float, int
 ]:
-    """:func:`fetch_blob_full` without the digest trailer — the
+    """:func:`fetch_blob_full` without the trailing sections — the
     4-tuple ``(result, outcome, latency_s, nbytes_rx)`` shape the
     health subsystem and existing callers consume."""
     return fetch_blob_full(host, port, timeout_ms, min_bandwidth_bps)[:4]
@@ -1297,6 +1412,37 @@ class TcpTransport:
             "fetch_s": 0.0, "join_wait_s": 0.0,
             "inflight_s": 0.0, "round_s": 0.0,
         }
+        # Observability plane (dpwa_tpu/obs/, docs/observability.md):
+        # round tracer, replica-sketch board, /metrics registry.  All
+        # None when the obs: block is off — the hot path then takes no
+        # obs branches, adds no timing calls, and publishes frames
+        # bit-identical to an obs-free build.
+        obs_cfg = config.obs
+        self.tracer = None
+        if obs_cfg.trace:
+            from dpwa_tpu.obs.trace import Tracer
+
+            self.tracer = Tracer(
+                self.me,
+                every=obs_cfg.trace_every,
+                path=obs_cfg.trace_path,
+                max_records=obs_cfg.trace_max_records,
+            )
+        self.sketchboard = None
+        if obs_cfg.sketch:
+            from dpwa_tpu.obs.sketch import SketchBoard
+
+            self.sketchboard = SketchBoard(self.me, k=obs_cfg.sketch_k)
+        # Published DPWT sections and fetch-side trailer reads gate on
+        # either facility (the trace id is free once the section exists).
+        self._obs_wire = obs_cfg.trace or obs_cfg.sketch
+        self._trace_id: Optional[str] = None
+        self._obs_trailer_cache: Optional[Tuple[int, bytes]] = None
+        self.metrics_registry = None
+        if obs_cfg.metrics:
+            from dpwa_tpu.obs.prometheus import MetricsRegistry
+
+            self.metrics_registry = MetricsRegistry()
         spec = config.nodes[self.me]
         # Fetcher-side flow control: the per-peer latency estimator that
         # derives adaptive cumulative deadlines and hedge launch points.
@@ -1326,6 +1472,7 @@ class TcpTransport:
         elif (
             config.recovery.enabled
             or config.flowctl.enabled
+            or config.obs.trace
             or (config.health.enabled and config.membership.enabled)
         ):
             # STATE serving (peer-assisted bootstrap), the RELAY probe
@@ -1340,6 +1487,12 @@ class TcpTransport:
             self.server = make_peer_server(
                 spec.host, spec.port, flowctl=config.flowctl
             )
+        if self.tracer is not None and isinstance(self.server, PeerServer):
+            # Serve-side spans: only the Python Rx server can time its
+            # sends (obs.trace forces it above).  Under chaos the serve
+            # path bypasses _serve_blob, so chaos runs trace the fetcher
+            # side only.
+            self.server.obs_serve_hook = self.tracer.note_serve
         self._ports = {
             i: (n.host, n.port) for i, n in enumerate(config.nodes)
         }
@@ -1375,7 +1528,12 @@ class TcpTransport:
             from dpwa_tpu.health.endpoint import HealthzServer
 
             self.healthz = HealthzServer(
-                self.health_snapshot, spec.host, config.health.healthz_port
+                self.health_snapshot, spec.host, config.health.healthz_port,
+                metrics_fn=(
+                    self.metrics_registry.render
+                    if self.metrics_registry is not None
+                    else None
+                ),
             )
         # Bookkeeping for metrics/adapters: last fetch outcome and the
         # last round's partner resolution (schedule vs. health remap).
@@ -1401,6 +1559,10 @@ class TcpTransport:
             from dpwa_tpu.parallel.schedules import warm_control_draws
 
             warm_control_draws(self.schedule.seed, self.me)
+        if self.metrics_registry is not None:
+            # Last: collectors read plane snapshots, so every plane must
+            # exist before its collector registers.
+            self._register_metrics(self.metrics_registry)
 
     @property
     def port(self) -> int:
@@ -1412,6 +1574,53 @@ class TcpTransport:
         self._ports[index] = (host, port)
 
     def publish(self, vec: np.ndarray, clock: float, loss: float) -> None:
+        tr = self.tracer
+        if tr is None:
+            self._publish(vec, clock, loss)
+            return
+        t0 = time.monotonic()
+        try:
+            self._publish(vec, clock, loss)
+        finally:
+            tr.mark("publish", time.monotonic() - t0)
+            tr.set(trace_id=self._trace_id)
+
+    def _make_obs_trailer(self, vec: np.ndarray, clock: float) -> bytes:
+        """The DPWT section for this publish: trace id + (optionally)
+        the replica sketch.  The norm estimate is the sketch's own L2
+        norm — unbiased for the replica norm under Rademacher signs, so
+        it costs no extra pass over the parameters."""
+        from dpwa_tpu.obs.wire import encode_obs
+
+        seq = int(clock) & 0xFFFFFFFF
+        self._trace_id = f"{self.me}:{seq}"
+        # One trailer per publish clock: the round protocol republishes
+        # the same replica under the same clock (driver publish, then
+        # the publish inside ``_round``), and seq granularity is the
+        # estimator's contract anyway — so a same-seq republish reuses
+        # the encoded section instead of paying a second sketch pass on
+        # the exchange hot path.
+        cached = self._obs_trailer_cache
+        if cached is not None and cached[0] == seq:
+            return cached[1]
+        sketch = None
+        norm = 0.0
+        board = self.sketchboard
+        if (
+            board is not None
+            and vec.dtype == np.float32
+            and int(clock) % self.config.obs.sketch_every == 0
+        ):
+            from dpwa_tpu.obs.sketch import replica_sketch
+
+            sketch = replica_sketch(vec, self.schedule.seed, board.k)
+            norm = float(np.dot(sketch, sketch)) ** 0.5
+            board.note_local(seq, sketch)
+        blob = encode_obs(self.me, seq, norm, sketch)
+        self._obs_trailer_cache = (seq, blob)
+        return blob
+
+    def _publish(self, vec: np.ndarray, clock: float, loss: float) -> None:
         # Compressed wire: only the PUBLISHED (served) copy is compressed
         # — bf16 halves the wire bytes, int8 quarters them; the local
         # replica stays f32 (mirrors the ICI transport, which compresses
@@ -1419,6 +1628,7 @@ class TcpTransport:
         # with stochastic rounding keyed on (seed, clock, me) and
         # dequantized by the FETCHING side (ops/quantize.py).
         self._last_clock = float(clock)
+        f32_vec = None  # contiguous-f32 view of vec, stashed below
         if (
             self.trust is not None
             or self._wire_topk
@@ -1432,6 +1642,7 @@ class TcpTransport:
             # incoming payload to what we just published — and a top-k
             # frame can only densify against it.
             self._local_vec = np.ascontiguousarray(vec, dtype=np.float32)
+            f32_vec = self._local_vec
             self._local_norm = float(
                 np.linalg.norm(self._local_vec.astype(np.float64))
             )
@@ -1442,6 +1653,19 @@ class TcpTransport:
             if self.membership is not None
             else None
         )
+        # Observability piggyback: trace id + replica sketch ride AFTER
+        # the digest (ordering is the back-compat contract — see _frame).
+        # When trust/topk/guard already stashed a contiguous-f32 copy of
+        # this vec, sketch THAT — it saves a second full-replica pass
+        # (and a device transfer when vec is a jax array).
+        obs = (
+            self._make_obs_trailer(
+                vec if f32_vec is None else f32_vec, clock
+            )
+            if self._obs_wire
+            else None
+        )
+        tid = self._trace_id if obs is not None else None
         if self._wire_topk and vec.dtype == np.float32:
             payload = self._topk_encoder.encode(
                 np.ascontiguousarray(vec, dtype=np.float32).reshape(-1),
@@ -1449,7 +1673,8 @@ class TcpTransport:
             )
             self._note_published(int(payload.size), int(vec.size) * 4)
             self.server.publish(
-                payload, clock, loss, code=_TOPK_DELTA, digest=digest
+                payload, clock, loss, code=_TOPK_DELTA, digest=digest,
+                obs=obs, trace_id=tid,
             )
             return
         if self._wire_int8 and vec.dtype == np.float32:
@@ -1460,13 +1685,15 @@ class TcpTransport:
             )
             self._note_published(int(payload.size), int(vec.size) * 4)
             self.server.publish(
-                payload, clock, loss, code=_INT8_CHUNKED, digest=digest
+                payload, clock, loss, code=_INT8_CHUNKED, digest=digest,
+                obs=obs, trace_id=tid,
             )
             return
         if self._wire_bf16 and vec.dtype == np.float32:
             vec = vec.astype(_DTYPES[3])
         self._note_published(int(vec.nbytes), int(vec.size) * 4)
-        self.server.publish(vec, clock, loss, digest=digest)
+        self.server.publish(vec, clock, loss, digest=digest, obs=obs,
+                            trace_id=tid)
 
     def _note_published(self, wire_bytes: int, dense_bytes: int) -> None:
         t = self._wire_tally
@@ -1498,8 +1725,8 @@ class TcpTransport:
         run ahead; every judgement about a payload happens at consume
         time against the replica it would actually merge into.
 
-        Returns the 8-tuple ``(winner_peer, got, outcome, latency_s,
-        nbytes, digest, hedged, hedge_winner)``."""
+        Returns the 9-tuple ``(winner_peer, got, outcome, latency_s,
+        nbytes, digest, obs, hedged, hedge_winner)``."""
         if timeout_ms is None:
             timeout_ms = self.config.protocol.timeout_ms
         if self._link_blocked(peer_index):
@@ -1508,7 +1735,7 @@ class TcpTransport:
             # round records a refused fetch, exactly what a firewalled
             # link produces.
             return (
-                peer_index, None, Outcome.REFUSED, 0.0, 0, None,
+                peer_index, None, Outcome.REFUSED, 0.0, 0, None, None,
                 False, None,
             )
         if self._estimator is not None:
@@ -1522,15 +1749,16 @@ class TcpTransport:
             # was already recorded inside _hedged_fetch.
             return self._hedged_fetch(peer_index, step, timeout_ms)
         host, port = self._ports[peer_index]
-        got, outcome, latency_s, nbytes, digest = fetch_blob_full(
+        got, outcome, latency_s, nbytes, digest, obs = fetch_blob_full(
             host, port, timeout_ms,
             min_bandwidth_bps=(
                 self.config.protocol.min_wire_mb_per_s * 1e6
             ),
             want_digest=self.membership is not None,
+            want_obs=self._obs_wire,
         )
         return (
-            peer_index, got, outcome, latency_s, nbytes, digest,
+            peer_index, got, outcome, latency_s, nbytes, digest, obs,
             False, None,
         )
 
@@ -1545,14 +1773,34 @@ class TcpTransport:
         that straddled a local publish is screened against the replica
         that exists NOW, never against the one that existed at launch."""
         (
-            peer_index, got, outcome, latency_s, nbytes, digest,
+            peer_index, got, outcome, latency_s, nbytes, digest, obs,
             hedged, hedge_winner,
         ) = raw
         est = self._estimator
+        tr = self.tracer
+        timing = tr is not None and tr.active
+        if timing:
+            # The wire span is the leg's own streaming duration — under
+            # prefetch it ran on a background slot a round earlier; the
+            # blocking cost the caller actually paid is the join_wait
+            # span marked by _prefetch_take.
+            tr.mark("wire", latency_s)
+        if obs is not None and (timing or self.sketchboard is not None):
+            from dpwa_tpu.obs.wire import decode_obs
+
+            frame = decode_obs(obs)
+            if frame is not None:
+                if timing:
+                    tr.set(remote_trace_id=frame.trace_id)
+                if self.sketchboard is not None and frame.sketch is not None:
+                    self.sketchboard.note_remote(
+                        frame.origin, frame.seq, frame.sketch, round=step
+                    )
         codec = None
         sparse_guard = None   # (values, local_selected) for the guard
         sparse_trust = None   # (indices, values) for trust screening
         if got is not None and not isinstance(got[0], np.ndarray):
+            t_stage = time.monotonic() if timing else 0.0
             # Top-k delta frame: fetch_blob_full returns the decoded
             # TopkPayload in the vector slot; only this side holds the
             # replica the indices splice into.  No stashed local replica
@@ -1569,6 +1817,8 @@ class TcpTransport:
                 got = (sp.densify(lv), got[1], got[2])
                 sparse_guard = (sp.values, local_sel)
                 sparse_trust = (sp.indices, sp.values)
+            if timing:
+                tr.mark("decode", time.monotonic() - t_stage)
         reason = None
         if got is not None and self.config.recovery.enabled:
             # Divergence/poison guard: a frame can be perfectly formed
@@ -1578,11 +1828,14 @@ class TcpTransport:
             # dead one.
             from dpwa_tpu.recovery.guard import validate_payload
 
+            t_stage = time.monotonic() if timing else 0.0
             reason = validate_payload(
                 got[0], got[2], self.config.recovery,
                 local_norm=self._local_norm,
                 sparse=sparse_guard,
             )
+            if timing:
+                tr.mark("guard", time.monotonic() - t_stage)
             if reason is not None:
                 got = None
                 outcome = Outcome.POISONED
@@ -1602,10 +1855,13 @@ class TcpTransport:
             # recorded below exactly like ``poisoned``, and — also like
             # poisoned — never gated behind indirect probing, since a
             # byzantine peer answers header probes perfectly.
+            t_stage = time.monotonic() if timing else 0.0
             verdict, scale, tstats = self.trust.screen(
                 peer_index, got[0], got[1], self._local_vec, round=step,
                 codec=codec or "dense", sparse=sparse_trust,
             )
+            if timing:
+                tr.mark("trust", time.monotonic() - t_stage)
             from dpwa_tpu.trust.manager import REJECTED
 
             trust_info = dict(
@@ -1673,7 +1929,7 @@ class TcpTransport:
         self, peer: int, deadline_ms: float, box: list, sock_box: list
     ) -> None:
         """One fetch leg of a (possibly hedged) flowctl fetch, run on a
-        thread: appends the full 5-tuple to ``box``; ``sock_box`` lets
+        thread: appends the full 6-tuple to ``box``; ``sock_box`` lets
         the racing side cancel this leg by closing its socket."""
         host, port = self._ports[peer]
         box.append(
@@ -1684,6 +1940,7 @@ class TcpTransport:
                 ),
                 want_digest=self.membership is not None,
                 sock_box=sock_box,
+                want_obs=self._obs_wire,
             )
         )
 
@@ -1716,12 +1973,12 @@ class TcpTransport:
 
     @staticmethod
     def _leg_result(box: list, elapsed: float) -> tuple:
-        """A leg's 5-tuple result; a leg that died without reporting
+        """A leg's 6-tuple result; a leg that died without reporting
         (should not happen — fetch_blob_full classifies every failure)
         degrades to a short_read instead of crashing the round."""
         if box:
             return box[0]
-        return None, Outcome.SHORT_READ, elapsed, 0, None
+        return None, Outcome.SHORT_READ, elapsed, 0, None, None
 
     def _record_loser(
         self,
@@ -1740,7 +1997,7 @@ class TcpTransport:
         if cancelled or result is None:
             outcome, lat, nbytes = Outcome.SLOW, latency_s, 0
         else:
-            _got, outcome, lat, nbytes, _digest = result
+            _got, outcome, lat, nbytes, _digest, _obs = result
         if self.scoreboard is not None:
             self.scoreboard.record(
                 peer, outcome, latency_s=lat, nbytes=nbytes, round=step
@@ -1761,7 +2018,7 @@ class TcpTransport:
         flight and a healthy fallback partner exists, launches ONE hedge
         leg and returns the first success (closing the loser's socket
         promptly).  Returns ``(winner_peer, got, outcome, latency_s,
-        nbytes, digest, hedged, hedge_winner)`` — the winner's outcome
+        nbytes, digest, obs, hedged, hedge_winner)`` — the winner's outcome
         flows through fetch()'s normal screening tail; only the LOSER is
         recorded here."""
         est = self._estimator
@@ -1791,10 +2048,13 @@ class TcpTransport:
             # fallback.  The leg's own cumulative deadline bounds the
             # join (budget extends only while bytes actually flow).
             p_thread.join()
-            got, outcome, latency_s, nbytes, digest = self._leg_result(
+            got, outcome, latency_s, nbytes, digest, obs = self._leg_result(
                 p_box, time.monotonic() - t0
             )
-            return peer, got, outcome, latency_s, nbytes, digest, False, None
+            return (
+                peer, got, outcome, latency_s, nbytes, digest, obs,
+                False, None,
+            )
         est.note_hedge(peer)
         f_box: list = []
         f_sock: list = []
@@ -1835,10 +2095,13 @@ class TcpTransport:
                     fallback, f_box[0], cancelled=False,
                     latency_s=f_box[0][2], step=step,
                 )
-            got, outcome, latency_s, nbytes, digest = self._leg_result(
+            got, outcome, latency_s, nbytes, digest, obs = self._leg_result(
                 p_box, elapsed
             )
-            return peer, got, outcome, latency_s, nbytes, digest, True, peer
+            return (
+                peer, got, outcome, latency_s, nbytes, digest, obs,
+                True, peer,
+            )
         # Fallback wins (or both failed — prefer the fallback's result
         # only on success; otherwise report the primary's real failure).
         if f_done and f_box and f_box[0][1] == Outcome.SUCCESS:
@@ -1852,9 +2115,9 @@ class TcpTransport:
                 latency_s=elapsed,
                 step=step,
             )
-            got, outcome, latency_s, nbytes, digest = f_box[0]
+            got, outcome, latency_s, nbytes, digest, obs = f_box[0]
             return (
-                fallback, got, outcome, latency_s, nbytes, digest,
+                fallback, got, outcome, latency_s, nbytes, digest, obs,
                 True, fallback,
             )
         # Both legs finished without a success: record the fallback's
@@ -1864,10 +2127,10 @@ class TcpTransport:
                 fallback, f_box[0], cancelled=False,
                 latency_s=f_box[0][2], step=step,
             )
-        got, outcome, latency_s, nbytes, digest = self._leg_result(
+        got, outcome, latency_s, nbytes, digest, obs = self._leg_result(
             p_box, elapsed
         )
-        return peer, got, outcome, latency_s, nbytes, digest, True, None
+        return peer, got, outcome, latency_s, nbytes, digest, obs, True, None
 
     def _link_blocked(self, peer_index: int) -> bool:
         """Fetcher-side view of an injected partition (False without
@@ -2062,7 +2325,20 @@ class TcpTransport:
             # Gated on the new planes being ON: a dense sequential run
             # keeps its health records byte-identical to PR 5.
             snap["wire"] = self.wire_snapshot()
+        if self.tracer is not None or self.sketchboard is not None:
+            snap["obs"] = self.obs_snapshot()
         return snap
+
+    def obs_snapshot(self) -> dict:
+        """JSON-ready observability sub-document (healthz ``obs`` key,
+        metrics' ``disagreement_*`` columns): the sketch-based ring
+        convergence estimate and the tracer's per-stage summary."""
+        out: dict = {}
+        if self.sketchboard is not None:
+            out["convergence"] = self.sketchboard.snapshot()
+        if self.tracer is not None:
+            out["trace"] = self.tracer.stage_summary()
+        return out
 
     def wire_snapshot(self) -> dict:
         """JSON-ready wire-plane state: which codec is publishing, the
@@ -2107,6 +2383,133 @@ class TcpTransport:
                 ),
             }
         return out
+
+    def _register_metrics(self, registry) -> None:
+        """Wire every enabled plane's collectors into the /metrics
+        registry (called once, at the end of __init__).  Collectors read
+        the planes' existing snapshots at scrape time — nothing here
+        touches the exchange hot path."""
+        from dpwa_tpu.obs.prometheus import Family
+
+        registry.gauge_fn(
+            "dpwa_me", "This node's ring index.", lambda: self.me
+        )
+        if self.scoreboard is not None:
+            from dpwa_tpu.health.scoreboard import (
+                register_metrics as _reg_health,
+            )
+
+            _reg_health(registry, self.scoreboard)
+        if self.membership is not None:
+            from dpwa_tpu.membership.manager import (
+                register_metrics as _reg_member,
+            )
+
+            _reg_member(registry, self.membership)
+        if self.trust is not None:
+            from dpwa_tpu.trust.manager import (
+                register_metrics as _reg_trust,
+            )
+
+            _reg_trust(registry, self.trust)
+        if self._estimator is not None:
+            from dpwa_tpu.flowctl.estimator import (
+                register_metrics as _reg_est,
+            )
+
+            _reg_est(registry, self._estimator)
+        admission = getattr(self.server, "admission", None)
+        if admission is not None:
+            from dpwa_tpu.flowctl.admission import (
+                register_metrics as _reg_adm,
+            )
+
+            _reg_adm(registry, admission)
+
+        def _wire():
+            snap = self.wire_snapshot()
+            fams = [
+                Family(
+                    "dpwa_wire_bytes_total",
+                    "counter",
+                    "Payload bytes published to the wire.",
+                ).sample(snap["wire_bytes"]),
+                Family(
+                    "dpwa_wire_frames_total",
+                    "counter",
+                    "Frames published to the wire.",
+                ).sample(snap["frames"]),
+                Family(
+                    "dpwa_wire_compression_ratio",
+                    "gauge",
+                    "Dense f32 bytes over on-wire bytes.",
+                ).sample(snap["compression_ratio"]),
+            ]
+            ov = snap.get("overlap")
+            if ov is not None:
+                fams.append(
+                    Family(
+                        "dpwa_overlap_occupancy",
+                        "gauge",
+                        "Fetch in-flight time over round wall time.",
+                    ).sample(ov["occupancy"])
+                )
+                fams.append(
+                    Family(
+                        "dpwa_overlap_hidden_frac",
+                        "gauge",
+                        "Fraction of fetch wall-time hidden from the "
+                        "caller.",
+                    ).sample(ov["hidden_frac"])
+                )
+            return fams
+
+        registry.register(_wire)
+        if self.sketchboard is not None:
+
+            def _sketch():
+                snap = self.sketchboard.snapshot()
+                return [
+                    Family(
+                        "dpwa_disagreement_rms",
+                        "gauge",
+                        "Sketch-estimated RMS replica disagreement "
+                        "across peers seen.",
+                    ).sample(snap["rms"]),
+                    Family(
+                        "dpwa_disagreement_rel",
+                        "gauge",
+                        "RMS disagreement relative to the local "
+                        "replica norm estimate.",
+                    ).sample(snap["rel_rms"]),
+                    Family(
+                        "dpwa_sketch_peers",
+                        "gauge",
+                        "Peers with a current sketch on the board.",
+                    ).sample(snap["peers_seen"]),
+                ]
+
+            registry.register(_sketch)
+        if self.tracer is not None:
+
+            def _trace():
+                summary = self.tracer.stage_summary()
+                total = Family(
+                    "dpwa_trace_stage_seconds_total",
+                    "counter",
+                    "Cumulative seconds spent per exchange stage.",
+                )
+                med = Family(
+                    "dpwa_trace_stage_median_ms",
+                    "gauge",
+                    "Median stage duration over the recent window.",
+                )
+                for stage, info in summary.items():
+                    total.sample(info["total_s"], {"stage": stage})
+                    med.sample(info["median_ms"], {"stage": stage})
+                return [total, med]
+
+            registry.register(_trace)
 
     def _trust_alpha_scale(self) -> float:
         """The CURRENT exchange's trust damping (interpolation hook)."""
@@ -2166,7 +2569,12 @@ class TcpTransport:
         fetch timeout) and the caller keeps its vector untouched."""
         try:
             self.publish(vec, clock, loss)
+            tr = self.tracer
+            timing = tr is not None and tr.active
+            t0 = time.monotonic() if timing else 0.0
             sched, partner, remapped = self._resolve_partner(step)
+            if timing:
+                tr.mark("partner_resolve", time.monotonic() - t0)
             self.last_round = {
                 "step": step, "sched_partner": sched, "partner": partner,
                 "remapped": remapped, "outcome": None,
@@ -2247,10 +2655,21 @@ class TcpTransport:
         bit-identity reference the pipeline is tested against."""
         if self._prefetch_on:
             return self._exchange_pipelined(vec, clock, loss, step)
-        remote_vec, alpha, partner = self._round(vec, clock, loss, step)
-        if remote_vec is None:
-            return vec, alpha, partner
-        return _host_merge(vec, remote_vec, alpha), alpha, partner
+        tr = self.tracer
+        rt = tr is not None and tr.begin_round(step)
+        try:
+            remote_vec, alpha, partner = self._round(vec, clock, loss, step)
+            if remote_vec is None:
+                return vec, alpha, partner
+            t0 = time.monotonic() if rt else 0.0
+            merged = _host_merge(vec, remote_vec, alpha)
+            if rt:
+                tr.mark("merge", time.monotonic() - t0)
+                tr.set(alpha=float(alpha))
+            return merged, alpha, partner
+        finally:
+            if rt:
+                self._trace_finish(tr)
 
     def _exchange_pipelined(
         self, vec: np.ndarray, clock: float, loss: float, step: int
@@ -2278,6 +2697,8 @@ class TcpTransport:
             o["round_s"] += t_entry - self._pipe_last_entry
         self._pipe_last_entry = t_entry
         o["rounds"] += 1
+        tr = self.tracer
+        rt = tr is not None and tr.begin_round(step)
         try:
             self.publish(vec, clock, loss)
             raw, sched, partner, remapped = self._prefetch_take(step)
@@ -2305,9 +2726,38 @@ class TcpTransport:
             if got is None:
                 return vec, 0.0, partner
             remote_vec, alpha = self._weigh_remote(got, clock, loss)
-            return _host_merge(vec, remote_vec, alpha), alpha, partner
+            t_m = time.monotonic() if rt else 0.0
+            merged = _host_merge(vec, remote_vec, alpha)
+            if rt:
+                tr.mark("merge", time.monotonic() - t_m)
+                tr.set(alpha=float(alpha))
+            return merged, alpha, partner
         finally:
             self._membership_end_round(step)
+            if rt:
+                self._trace_finish(tr)
+
+    def _trace_finish(self, tr) -> None:
+        """Close the active round trace with the round's resolution
+        fields (from ``last_round``/``last_fetch``) plus the current
+        sketch-based disagreement estimate when the board is on."""
+        lr = self.last_round
+        fields = {
+            "partner": lr.get("partner"),
+            "sched_partner": lr.get("sched_partner"),
+            "remapped": lr.get("remapped"),
+            "outcome": lr.get("outcome"),
+            "codec": lr.get("codec"),
+        }
+        if lr.get("outcome") is not None:
+            fields["nbytes"] = self.last_fetch.get("nbytes")
+        if lr.get("hedged"):
+            fields["hedged"] = True
+        if self.sketchboard is not None:
+            rms, rel = self.sketchboard.disagreement()
+            fields["disagreement_rms"] = rms
+            fields["disagreement_rel"] = rel
+        tr.end_round(**fields)
 
     def _prefetch_launch(self, step: int, expected_nbytes: int) -> None:
         """Arm the slot for round ``step``: resolve its partner NOW (the
@@ -2316,7 +2766,12 @@ class TcpTransport:
         gated) and start the wire leg on a daemon thread.  A slot whose
         round does not participate (self-pair / masked) is armed with no
         thread so the take side still returns its partner resolution."""
+        tr = self.tracer
+        timing = tr is not None and tr.active
+        t0 = time.monotonic() if timing else 0.0
         sched, partner, remapped = self._resolve_partner(step)
+        if timing:
+            tr.mark("partner_resolve", time.monotonic() - t0)
         slot = {
             "step": step, "sched": sched, "partner": partner,
             "remapped": remapped, "expected_nbytes": int(expected_nbytes),
@@ -2339,7 +2794,7 @@ class TcpTransport:
         self._prefetch_slot = slot
 
     def _prefetch_take(self, step: int) -> tuple:
-        """Claim the slot for round ``step``: ``(raw_8tuple | None,
+        """Claim the slot for round ``step``: ``(raw_9tuple | None,
         sched, partner, remapped)``.
 
         A cold pipeline (first round) or a step discontinuity resolves
@@ -2352,6 +2807,8 @@ class TcpTransport:
         round — a lapsed join skips the merge like any failed fetch."""
         slot, self._prefetch_slot = self._prefetch_slot, None
         o = self._overlap
+        tr = self.tracer
+        timing = tr is not None and tr.active
         if slot is None or slot["step"] != step:
             sched, partner, remapped = self._resolve_partner(step)
             if partner == self.me or not self.schedule.participates(
@@ -2365,6 +2822,9 @@ class TcpTransport:
             o["fetch_s"] += dt
             o["join_wait_s"] += dt
             o["inflight_s"] += dt
+            if timing:
+                tr.mark("join_wait", dt)
+                tr.set(prefetched=False)
             return raw, sched, partner, remapped
         sched, partner, remapped = (
             slot["sched"], slot["partner"], slot["remapped"]
@@ -2373,6 +2833,8 @@ class TcpTransport:
         if th is None:
             return None, sched, partner, remapped
         o["prefetched"] += 1
+        if timing:
+            tr.set(prefetched=True, straddled=slot["t_end"][0] == 0.0)
         if slot["t_end"][0] == 0.0:
             # Still streaming as this round's publish landed: the
             # payload straddled a local publish and the consume-time
@@ -2389,7 +2851,10 @@ class TcpTransport:
             + slot["expected_nbytes"]
             / (self.config.protocol.min_wire_mb_per_s * 1e6)
         )
-        o["join_wait_s"] += time.monotonic() - t_join
+        join_dt = time.monotonic() - t_join
+        o["join_wait_s"] += join_dt
+        if timing:
+            tr.mark("join_wait", join_dt)
         t_end = slot["t_end"][0] or time.monotonic()
         span = max(t_end - slot["t_start"], 0.0)
         o["fetch_s"] += span
@@ -2404,7 +2869,7 @@ class TcpTransport:
             # the consuming round's — refuses the payload even though
             # the launch-time check (one clock earlier) let the wire
             # leg run: partition semantics charge the consuming round.
-            raw = (partner, None, Outcome.REFUSED, 0.0, 0, None,
+            raw = (partner, None, Outcome.REFUSED, 0.0, 0, None, None,
                    False, None)
         return raw, sched, partner, remapped
 
@@ -2459,4 +2924,6 @@ class TcpTransport:
     def close(self) -> None:
         if self.healthz is not None:
             self.healthz.close()
+        if self.tracer is not None:
+            self.tracer.close()
         self.server.close()

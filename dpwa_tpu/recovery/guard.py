@@ -151,3 +151,26 @@ class RollbackRing:
 
     def clear(self) -> None:
         self._ring.clear()
+
+
+def register_metrics(registry, ring: RollbackRing) -> None:
+    """Expose the rollback ring on a MetricsRegistry (pull-based)."""
+    from dpwa_tpu.obs.prometheus import Family
+
+    def collect():
+        return [
+            Family(
+                "dpwa_rollback_pushes_total", "counter",
+                "Healthy replica snapshots banked",
+            ).sample(ring.pushes),
+            Family(
+                "dpwa_rollback_rollbacks_total", "counter",
+                "Guard-tripped rollbacks consumed",
+            ).sample(ring.rollbacks),
+            Family(
+                "dpwa_rollback_held", "gauge",
+                "Snapshots currently held in the ring",
+            ).sample(len(ring)),
+        ]
+
+    registry.register(collect)

@@ -461,3 +461,39 @@ class TrustManager:
 
 def round_f(x: float, digits: int = 4) -> float:
     return round(float(x), digits)
+
+
+def register_metrics(registry, manager: "TrustManager") -> None:
+    """Expose the content-trust plane on a MetricsRegistry."""
+    from dpwa_tpu.obs.prometheus import Family
+
+    def collect():
+        snap = manager.snapshot()
+        trust = Family(
+            "dpwa_trust_score", "gauge",
+            "Per-peer content-trust EWMA (1.0 = fully trusted)",
+        )
+        rejected = Family(
+            "dpwa_trust_rejected_total", "counter",
+            "Payloads rejected by the trust screen per peer",
+        )
+        damped = Family(
+            "dpwa_trust_damped_total", "counter",
+            "Payloads merged with damped alpha per peer",
+        )
+        for p, info in sorted((snap.get("peers") or {}).items()):
+            labels = {"peer": p}
+            trust.sample(info.get("trust"), labels)
+            rejected.sample(info.get("trust_rejected"), labels)
+            damped.sample(info.get("trust_damped"), labels)
+        return [
+            trust,
+            rejected,
+            damped,
+            Family(
+                "dpwa_trust_armed", "gauge",
+                "1 once the robust baselines have enough history to arm",
+            ).sample(snap.get("armed")),
+        ]
+
+    registry.register(collect)

@@ -280,7 +280,7 @@ def test_fetch_classifies_busy_and_rejects_bad_busy_version():
 
     srv = RawServer(busy_script)
     try:
-        got, outcome, latency, nbytes, digest = fetch_blob_full(
+        got, outcome, latency, nbytes, digest, _obs = fetch_blob_full(
             "127.0.0.1", srv.port, 500
         )
         assert got is None and outcome == Outcome.BUSY
@@ -665,7 +665,7 @@ def test_fuzzed_frames_are_always_classified_within_budget():
         srv = RawServer(script)
         try:
             t0 = time.monotonic()
-            got, outcome, latency, nbytes_rx, digest = fetch_blob_full(
+            got, outcome, latency, nbytes_rx, digest, _obs = fetch_blob_full(
                 "127.0.0.1", srv.port, 400
             )
             elapsed = time.monotonic() - t0

@@ -355,7 +355,7 @@ def test_frame_without_digest_still_parses():
     try:
         vec = np.arange(16, dtype=np.float32)
         srv.publish(vec, 3.0, 0.25)  # no digest
-        result, outcome, _lat, nrx, digest = fetch_blob_full(
+        result, outcome, _lat, nrx, digest, _obs = fetch_blob_full(
             "127.0.0.1", srv.port, 500, want_digest=True
         )
         assert outcome == Outcome.SUCCESS
@@ -396,7 +396,7 @@ def test_frame_with_digest_is_backward_compatible():
         outcome, clock = probe_header_classified("127.0.0.1", srv.port)
         assert outcome == Outcome.SUCCESS and clock == 7.0
         # New reader: the digest comes back byte-identical.
-        *_, digest = fetch_blob_full(
+        *_, digest, _obs = fetch_blob_full(
             "127.0.0.1", srv.port, 500, want_digest=True
         )
         assert digest == dg

@@ -1,0 +1,319 @@
+#!/usr/bin/env python
+"""Validate dpwa metrics JSONL files against the frozen record schemas.
+
+The JSONL streams are the repo's observability contract: every
+downstream consumer (tools/health_report.py, tools/trace_report.py,
+jq one-liners, soak-run dashboards) reads them by field name, and the
+planes keep old records **byte-identical** when a new plane is off —
+so a field renamed, retyped, or silently added is a cross-PR
+regression even when every unit test passes.  This checker pins the
+schemas:
+
+- ``record: "health"`` — the scoreboard snapshot columns, plus the
+  optional membership / trust / flowctl / wire / obs column groups
+  (each group is all-or-nothing: a record with ``trust`` but without
+  ``trust_verdict`` is malformed);
+- ``record: "trace"``, ``kind: "round" | "serve"`` — the obs plane's
+  round/serve spans (docs/observability.md);
+- ``record: "event"`` — control-plane events: ``step``/``t``/``event``
+  are pinned, evidence fields are free-form by design (each event kind
+  carries its own);
+- records with no ``record`` key — per-step exchange/training records
+  (``MetricsLogger.log`` / ``log_exchange``): ``step`` and ``t`` are
+  pinned, the rest is adapter-defined.
+
+Unknown fields in a pinned schema, missing required fields, and
+mistyped pinned fields are errors; the exit code is the error count
+(0 = clean), so the check can run in tier-1 and in soak harnesses.
+
+Usage::
+
+    python tools/schema_check.py metrics.jsonl [more.jsonl ...]
+    python tools/schema_check.py --json metrics.jsonl
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+_NUM = (int, float)
+
+# Pinned field -> allowed types.  ``list`` columns are parallel arrays
+# keyed by the record's ``peer`` column.
+_HEALTH_REQUIRED: Dict[str, tuple] = {
+    "step": (int,),
+    "t": _NUM,
+    "record": (str,),
+    "me": (int,),
+    "round": (int,),
+    "peer": (list,),
+    "peer_state": (list,),
+    "suspicion": (list,),
+    "quarantined_rounds": (list,),
+    "quarantines": (list,),
+    "attempts": (list,),
+    "failures": (list,),
+    "probe_attempts": (list,),
+    "last_outcome": (list,),
+}
+
+# Optional column GROUPS: a plane contributes all of its columns or
+# none of them (that is what keeps plane-off records byte-identical).
+_HEALTH_GROUPS: Dict[str, Dict[str, tuple]] = {
+    "membership": {
+        "incarnation": (list,),
+        "own_incarnation": (int,),
+        "component": (list,),
+        "component_id": _NUM + (str, type(None)),
+        "partition_state": (str,),
+    },
+    "trust": {
+        "trust": (list,),
+        "trust_verdict": (list,),
+        "trust_damped": (list,),
+        "trust_rejected": (list,),
+    },
+    "flowctl": {
+        "deadline_ms": (list,),
+        "hedges": (list,),
+        "hedge_wins": (list,),
+        "busy": (list,),
+        "slow": (list,),
+        "hedge_rate": _NUM,
+        "shed_total": (int,),
+    },
+    "wire": {
+        "wire_codec": (str,),
+        "wire_bytes": (int,),
+        "compression_ratio": _NUM,
+    },
+    "overlap": {
+        "overlap_occupancy": _NUM,
+        "overlap_hidden_frac": _NUM,
+        "overlap_prefetched": (int,),
+        "overlap_straddled": (int,),
+    },
+    "obs": {
+        "disagreement_rms": _NUM + (type(None),),
+        "disagreement_rel": _NUM + (type(None),),
+        "sketch_peers": (int,),
+    },
+}
+
+_TRACE_ROUND_REQUIRED: Dict[str, tuple] = {
+    "step": (int,),
+    "t": _NUM,
+    "record": (str,),
+    "kind": (str,),
+    "me": (int,),
+    "stages": (dict,),
+}
+_TRACE_ROUND_OPTIONAL: Dict[str, tuple] = {
+    "trace_id": (str,),
+    "remote_trace_id": (str,),
+    "partner": (int,),
+    "sched_partner": (int,),
+    "remapped": (bool,),
+    "outcome": (str,),
+    "codec": (str,),
+    "nbytes": (int,),
+    "alpha": _NUM,
+    "hedged": (bool,),
+    "prefetched": (bool,),
+    "straddled": (bool,),
+    "disagreement_rms": _NUM,
+    "disagreement_rel": _NUM,
+}
+
+_TRACE_SERVE_REQUIRED: Dict[str, tuple] = {
+    "step": (int,),
+    "t": _NUM,
+    "record": (str,),
+    "kind": (str,),
+    "me": (int,),
+    "trace_id": (str,),
+    "nbytes": (int,),
+    "dur_s": _NUM,
+}
+
+_EVENT_REQUIRED: Dict[str, tuple] = {
+    "step": (int,),
+    "t": _NUM,
+    "record": (str,),
+    "event": (str,),
+}
+
+_EXCHANGE_REQUIRED: Dict[str, tuple] = {
+    "step": (int,),
+    "t": _NUM,
+}
+
+
+def _check_fields(
+    rec: dict,
+    required: Dict[str, tuple],
+    optional: Optional[Dict[str, tuple]] = None,
+    closed: bool = False,
+) -> List[str]:
+    errs: List[str] = []
+    known = dict(required)
+    if optional:
+        known.update(optional)
+    for field, types in required.items():
+        if field not in rec:
+            errs.append(f"missing required field {field!r}")
+        elif not isinstance(rec[field], types):
+            errs.append(
+                f"field {field!r} has type "
+                f"{type(rec[field]).__name__}, expected "
+                f"{'/'.join(t.__name__ for t in types)}"
+            )
+    if optional:
+        for field, types in optional.items():
+            if field in rec and not isinstance(rec[field], types):
+                errs.append(
+                    f"field {field!r} has type "
+                    f"{type(rec[field]).__name__}, expected "
+                    f"{'/'.join(t.__name__ for t in types)}"
+                )
+    if closed:
+        for field in rec:
+            if field not in known:
+                errs.append(f"unknown field {field!r}")
+    return errs
+
+
+def check_record(rec: dict) -> List[str]:
+    """Errors for one parsed JSONL record (empty = valid)."""
+    kind = rec.get("record")
+    if kind == "health":
+        errs = _check_fields(rec, _HEALTH_REQUIRED)
+        # Group completeness + closed-world over required ∪ groups.
+        known = dict(_HEALTH_REQUIRED)
+        for group, fields in _HEALTH_GROUPS.items():
+            known.update(fields)
+            present = [f for f in fields if f in rec]
+            if present and len(present) != len(fields):
+                missing = sorted(set(fields) - set(present))
+                errs.append(
+                    f"partial {group!r} column group: missing {missing}"
+                )
+            for f in present:
+                if not isinstance(rec[f], fields[f]):
+                    errs.append(
+                        f"field {f!r} has type "
+                        f"{type(rec[f]).__name__}, expected "
+                        f"{'/'.join(t.__name__ for t in fields[f])}"
+                    )
+        for field in rec:
+            if field not in known:
+                errs.append(f"unknown field {field!r}")
+        # Parallel-array discipline: every list column matches peer.
+        # (``component`` is the membership member list, not a per-peer
+        # column; ``peer`` is the key column itself.)
+        peers = rec.get("peer")
+        if isinstance(peers, list):
+            for f, v in rec.items():
+                if f in ("peer", "component"):
+                    continue
+                if isinstance(v, list) and len(v) != len(peers):
+                    errs.append(
+                        f"column {f!r} has {len(v)} entries for "
+                        f"{len(peers)} peers"
+                    )
+        return errs
+    if kind == "trace":
+        tkind = rec.get("kind")
+        if tkind == "round":
+            return _check_fields(
+                rec, _TRACE_ROUND_REQUIRED, _TRACE_ROUND_OPTIONAL,
+                closed=True,
+            )
+        if tkind == "serve":
+            return _check_fields(rec, _TRACE_SERVE_REQUIRED, closed=True)
+        return [f"unknown trace kind {tkind!r}"]
+    if kind == "event":
+        # Evidence fields are free-form by design; only the envelope is
+        # pinned.
+        return _check_fields(rec, _EVENT_REQUIRED)
+    if kind is None:
+        return _check_fields(rec, _EXCHANGE_REQUIRED)
+    return [f"unknown record kind {kind!r}"]
+
+
+def check_file(path: str) -> Tuple[int, List[dict]]:
+    """(records_checked, error_entries) for one JSONL file."""
+    n = 0
+    errors: List[dict] = []
+    with open(path, "r", encoding="utf-8") as fh:
+        for lineno, line in enumerate(fh, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError as e:
+                errors.append(
+                    {"file": path, "line": lineno,
+                     "errors": [f"unparseable JSON: {e}"]}
+                )
+                continue
+            if not isinstance(rec, dict):
+                errors.append(
+                    {"file": path, "line": lineno,
+                     "errors": ["record is not a JSON object"]}
+                )
+                continue
+            n += 1
+            errs = check_record(rec)
+            if errs:
+                errors.append(
+                    {"file": path, "line": lineno, "errors": errs}
+                )
+    return n, errors
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Validate dpwa metrics JSONL against the frozen "
+        "record schemas."
+    )
+    ap.add_argument("paths", nargs="+", help="JSONL files to check")
+    ap.add_argument(
+        "--json", action="store_true", help="machine-readable output"
+    )
+    args = ap.parse_args(argv)
+    total = 0
+    all_errors: List[dict] = []
+    for path in args.paths:
+        n, errors = check_file(path)
+        total += n
+        all_errors.extend(errors)
+    if args.json:
+        json.dump(
+            {
+                "records": total,
+                "error_count": len(all_errors),
+                "errors": all_errors,
+            },
+            sys.stdout,
+            indent=2,
+        )
+        print()
+    else:
+        for entry in all_errors:
+            for e in entry["errors"]:
+                print(f"{entry['file']}:{entry['line']}: {e}")
+        status = "FAIL" if all_errors else "OK"
+        print(
+            f"{status}: {total} records checked, "
+            f"{len(all_errors)} bad record(s)"
+        )
+    return min(len(all_errors), 125)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,314 @@
+#!/usr/bin/env python
+"""Join per-node round-trace JSONL streams into cross-peer timelines.
+
+Stdlib-only companion to the ``record: "trace"`` lines the obs plane
+writes (``obs.trace``, docs/observability.md).  Each node emits two
+kinds of trace record through its :class:`~dpwa_tpu.obs.trace.Tracer`:
+
+- ``kind: "round"`` — one per traced exchange on the *fetching* node:
+  per-stage seconds (partner_resolve, wire, join_wait, decode, guard,
+  trust, merge, publish), the trace id it published (``trace_id``), and
+  the id carried by the frame it consumed (``remote_trace_id``).
+- ``kind: "serve"`` — one per served frame on the *serving* node,
+  stamped with the id of the frame it pushed onto the wire.
+
+Joining ``round.remote_trace_id`` across files to the partner's
+``serve.trace_id`` reconstructs the full cross-peer story of a round:
+who fetched from whom, what the server spent pushing the frame, and
+where the fetcher's wall time went.  The report prints:
+
+- **join completeness** — the fraction of successful exchanges whose
+  consumed frame has a matching serve span in the other node's stream
+  (the acceptance gate for the 4-node soak);
+- **per-round timelines** (``--rounds``) — step by step, each node's
+  partner, outcome, stage breakdown, and the matched serve span;
+- **critical-path attribution** — total traced seconds split into
+  wire (the stream), judgement (guard + trust screen), and compute
+  (decode + merge + publish + partner resolve), plus the share of wire
+  time the caller actually waited on (join_wait);
+- **overlap verification** — for prefetched rounds,
+  ``hidden_frac = 1 - join_wait/wire`` recomputed purely from spans, an
+  independent check of the transport's ``wire_snapshot()`` self-report
+  (they must agree within a few points on a healthy pipeline);
+- **convergence curve** — per-step RMS ring disagreement from the
+  sketch estimates riding on the round records (``obs.sketch``).
+
+Usage::
+
+    python tools/trace_report.py node0.jsonl node1.jsonl ...
+    python tools/trace_report.py --json traces/*.jsonl
+    python tools/trace_report.py --rounds 10 traces/*.jsonl
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, Iterable, List, Optional
+
+# Stage → critical-path bucket.  "wire" is the stream itself;
+# "judgement" is the serve-side screening verdicts; everything the node
+# computes locally lands in "compute".  join_wait is reported separately
+# — it is the part of "wire" the caller actually paid for.
+_BUCKETS = {
+    "wire": "wire",
+    "guard": "judgement",
+    "trust": "judgement",
+    "decode": "compute",
+    "merge": "compute",
+    "publish": "compute",
+    "partner_resolve": "compute",
+}
+
+
+def load_traces(paths: Iterable[str]) -> List[dict]:
+    recs: List[dict] = []
+    for path in paths:
+        with open(path, "r", encoding="utf-8") as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if rec.get("record") == "trace":
+                    recs.append(rec)
+    return recs
+
+
+def _median(vals: List[float]) -> float:
+    vals = sorted(vals)
+    return vals[len(vals) // 2] if vals else 0.0
+
+
+def build_report(recs: List[dict]) -> Dict[str, Any]:
+    rounds = [r for r in recs if r.get("kind") == "round"]
+    serves = [r for r in recs if r.get("kind") == "serve"]
+
+    # Serve spans by (server_node, trace_id).  A server may push the
+    # same published frame to several fetchers (hedges, relays): keep
+    # every span and join on first-available.
+    serve_idx: Dict[tuple, List[dict]] = {}
+    for s in serves:
+        serve_idx.setdefault((s.get("me"), s.get("trace_id")), []).append(s)
+
+    timelines: Dict[int, List[dict]] = {}
+    successes = 0
+    matched = 0
+    for r in rounds:
+        entry = {
+            "me": r.get("me"),
+            "partner": r.get("partner"),
+            "outcome": r.get("outcome", "skipped"),
+            "prefetched": r.get("prefetched"),
+            "stages": r.get("stages", {}),
+            "remote_trace_id": r.get("remote_trace_id"),
+            "serve": None,
+        }
+        if r.get("outcome") == "success":
+            successes += 1
+            key = (r.get("partner"), r.get("remote_trace_id"))
+            spans = serve_idx.get(key)
+            if spans:
+                matched += 1
+                entry["serve"] = {
+                    "nbytes": spans[0].get("nbytes"),
+                    "dur_s": spans[0].get("dur_s"),
+                }
+        timelines.setdefault(int(r.get("step", 0)), []).append(entry)
+
+    # Critical-path attribution over every traced round.
+    buckets: Dict[str, float] = {"wire": 0.0, "judgement": 0.0,
+                                 "compute": 0.0, "other": 0.0}
+    join_wait_total = 0.0
+    for r in rounds:
+        for stage, dur in (r.get("stages") or {}).items():
+            if stage == "join_wait":
+                join_wait_total += dur
+                continue
+            buckets[_BUCKETS.get(stage, "other")] += dur
+    traced_total = sum(buckets.values())
+    attribution = {
+        "total_traced_s": round(traced_total, 6),
+        "join_wait_s": round(join_wait_total, 6),
+        "buckets_s": {k: round(v, 6) for k, v in buckets.items()},
+        "buckets_frac": {
+            k: round(v / traced_total, 4) if traced_total else 0.0
+            for k, v in buckets.items()
+        },
+    }
+
+    # Overlap verification: spans-only recomputation of hidden_frac over
+    # the rounds that actually went through the prefetch slot.
+    pf = [r for r in rounds if r.get("prefetched") is not None]
+    wire_s = sum((r.get("stages") or {}).get("wire", 0.0) for r in pf)
+    wait_s = sum((r.get("stages") or {}).get("join_wait", 0.0) for r in pf)
+    overlap: Optional[Dict[str, Any]] = None
+    if pf:
+        overlap = {
+            "rounds": len(pf),
+            "prefetched": sum(1 for r in pf if r.get("prefetched")),
+            "wire_s": round(wire_s, 6),
+            "join_wait_s": round(wait_s, 6),
+            "hidden_frac": (
+                round(max(1.0 - wait_s / wire_s, 0.0), 4) if wire_s else 0.0
+            ),
+        }
+
+    # Convergence curve from the sketch estimates on the round records.
+    conv: List[dict] = []
+    for step in sorted(timelines):
+        vals = [
+            e for e in (
+                r.get("disagreement_rms")
+                for r in rounds
+                if int(r.get("step", 0)) == step
+            )
+            if e is not None
+        ]
+        rels = [
+            e for e in (
+                r.get("disagreement_rel")
+                for r in rounds
+                if int(r.get("step", 0)) == step
+            )
+            if e is not None
+        ]
+        if vals:
+            conv.append(
+                {
+                    "step": step,
+                    "rms_mean": round(sum(vals) / len(vals), 6),
+                    "rms_max": round(max(vals), 6),
+                    "rel_mean": round(sum(rels) / len(rels), 6)
+                    if rels
+                    else None,
+                }
+            )
+
+    stage_medians = {}
+    all_stages = sorted(
+        {s for r in rounds for s in (r.get("stages") or {})}
+    )
+    for stage in all_stages:
+        durs = [
+            (r.get("stages") or {}).get(stage)
+            for r in rounds
+            if stage in (r.get("stages") or {})
+        ]
+        stage_medians[stage] = round(_median(durs) * 1e3, 4)
+
+    return {
+        "nodes": sorted({r.get("me") for r in recs}),
+        "rounds_traced": len(rounds),
+        "serves_traced": len(serves),
+        "join": {
+            "successes": successes,
+            "matched": matched,
+            "completeness": (
+                round(matched / successes, 4) if successes else 1.0
+            ),
+        },
+        "stage_median_ms": stage_medians,
+        "attribution": attribution,
+        "overlap": overlap,
+        "convergence": conv,
+        "timelines": {str(k): v for k, v in sorted(timelines.items())},
+    }
+
+
+def print_report(rep: Dict[str, Any], max_rounds: int = 0) -> None:
+    print(f"nodes: {rep['nodes']}")
+    print(
+        f"traced: {rep['rounds_traced']} rounds, "
+        f"{rep['serves_traced']} serve spans"
+    )
+    j = rep["join"]
+    print(
+        f"cross-peer join: {j['matched']}/{j['successes']} successful "
+        f"exchanges matched a serve span "
+        f"(completeness {j['completeness']:.2%})"
+    )
+    print("stage medians (ms):")
+    for stage, ms in rep["stage_median_ms"].items():
+        print(f"  {stage:16s} {ms:10.4f}")
+    att = rep["attribution"]
+    print(f"critical path over {att['total_traced_s']:.4f}s traced:")
+    for k, v in att["buckets_s"].items():
+        frac = att["buckets_frac"][k]
+        print(f"  {k:10s} {v:10.4f}s  ({frac:6.1%})")
+    print(f"  join_wait  {att['join_wait_s']:10.4f}s (paid wire wall)")
+    ov = rep.get("overlap")
+    if ov:
+        print(
+            f"overlap (from spans): {ov['prefetched']}/{ov['rounds']} "
+            f"prefetched, wire {ov['wire_s']:.4f}s, waited "
+            f"{ov['join_wait_s']:.4f}s -> hidden_frac "
+            f"{ov['hidden_frac']:.4f}"
+        )
+    conv = rep.get("convergence")
+    if conv:
+        print("convergence (sketch RMS disagreement):")
+        for row in conv[:12]:
+            rel = row.get("rel_mean")
+            rel_s = f"  rel {rel:.4f}" if rel is not None else ""
+            print(
+                f"  step {row['step']:6d}  rms {row['rms_mean']:.6f}"
+                f"  max {row['rms_max']:.6f}{rel_s}"
+            )
+        if len(conv) > 12:
+            print(f"  ... {len(conv) - 12} more steps")
+    if max_rounds:
+        print("timelines:")
+        for step, entries in list(rep["timelines"].items())[:max_rounds]:
+            print(f"  step {step}:")
+            for e in entries:
+                serve = e.get("serve")
+                serve_s = (
+                    f"  serve {serve['dur_s'] * 1e3:.3f}ms/"
+                    f"{serve['nbytes']}B"
+                    if serve
+                    else ""
+                )
+                stages = ", ".join(
+                    f"{k}={v * 1e3:.2f}ms"
+                    for k, v in (e.get("stages") or {}).items()
+                )
+                print(
+                    f"    node{e['me']} <- {e['partner']} "
+                    f"[{e['outcome']}] {stages}{serve_s}"
+                )
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Join per-node round-trace JSONL into cross-peer "
+        "timelines."
+    )
+    ap.add_argument("paths", nargs="+", help="trace JSONL files")
+    ap.add_argument(
+        "--json", action="store_true", help="machine-readable output"
+    )
+    ap.add_argument(
+        "--rounds",
+        type=int,
+        default=0,
+        metavar="N",
+        help="print the first N per-round timelines",
+    )
+    args = ap.parse_args(argv)
+    recs = load_traces(args.paths)
+    rep = build_report(recs)
+    if args.json:
+        json.dump(rep, sys.stdout, indent=2)
+        print()
+    else:
+        print_report(rep, max_rounds=args.rounds)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
