@@ -498,7 +498,24 @@ def bench_wire(d: int, iters: int, timeout_ms: int = 10000) -> dict:
         # anywhere from 0% to 11% for the same build.
         obs_iters = max(iters, 40)
         base_ts = ring()
-        obs_ts = ring(base_port=2, obs={"trace": True, "sketch": True})
+        # Detectors + flight ring armed on top of trace/sketch: the <5%
+        # budget covers the FULL obs plane, incident tick included.
+        # Flight dumps land in a temp dir, not the repo.
+        import tempfile
+
+        obs_ts = ring(
+            base_port=2,
+            obs={
+                "trace": True,
+                "sketch": True,
+                "incidents": True,
+                "recorder": True,
+                "recorder_path": os.path.join(
+                    tempfile.mkdtemp(prefix="dpwa-bench-flight-"),
+                    "flight-{me}.jsonl",
+                ),
+            },
+        )
         try:
             base_vecs = [b.copy() for b in base]
             obs_vecs = [b.copy() for b in base]
@@ -954,6 +971,23 @@ def main() -> None:
             }
 
     print(json.dumps(out), flush=True)
+
+    # Cumulative history: one line per run so the perf trajectory is
+    # machine-readable across PRs (schema: record="bench" envelope,
+    # payload = this run's parsed result, tools/schema_check.py).
+    history_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "artifacts", "bench_history.jsonl",
+    )
+    try:
+        os.makedirs(os.path.dirname(history_path), exist_ok=True)
+        with open(history_path, "a", encoding="utf-8") as f:
+            f.write(
+                json.dumps({"record": "bench", "t": time.time(), **out})
+                + "\n"
+            )
+    except OSError:
+        pass  # history is best-effort; the stdout record is the output
 
 
 if __name__ == "__main__":

@@ -81,7 +81,9 @@ class DpwaTcpAdapter:
         self._own_metrics = isinstance(metrics, str)
         self.metrics: Optional[MetricsLogger] = (
             MetricsLogger(
-                path=metrics, max_bytes=self.config.obs.log_max_bytes
+                path=metrics,
+                max_bytes=self.config.obs.log_max_bytes,
+                keep=self.config.obs.log_keep,
             )
             if self._own_metrics
             else metrics

@@ -185,7 +185,45 @@ Schema::
       sketch_every: 1           # refresh the local sketch 1-in-N publishes
       metrics: true             # Prometheus /metrics on the healthz port
       log_max_bytes: 0          # rotate metrics/health JSONL at this size
-                                #   (<path>.1 roll; 0 = unbounded)
+                                #   (0 = unbounded)
+      log_keep: 1               # rotated generations kept per JSONL file
+                                #   (<path>.1 .. <path>.N)
+      incidents: true           # online anomaly detectors + incident
+                                #   correlator (docs/incidents.md) and the
+                                #   /incidents healthz route
+      incident_path: null       # alert/incident JSONL stream ("{me}" is
+                                #   substituted; null = in-memory only)
+      incident_window: 8        # rounds of evidence behind the burst and
+                                #   storm detectors
+      incident_fail_streak: 2   # consecutive hard fetch failures from one
+                                #   peer before a peer_failure alert
+      incident_soft_streak: 2   # busy/slow outcomes from one peer inside
+                                #   the window before a straggler alert
+      incident_trust_burst: 2   # untrusted/poisoned outcomes from one
+                                #   peer inside the window before a
+                                #   trust_burst alert
+      incident_storm_threshold: 3  # quarantine/degrade transitions inside
+                                #   the window before a state_storm alert
+      incident_stall_window: 8  # rel_rms samples behind the convergence
+                                #   stall detector
+      incident_stall_min_rel: 0.05  # plateau only counts above this
+                                #   rel_rms floor (converged is not stalled)
+      incident_stall_improve: 0.01  # required fractional rel_rms
+                                #   improvement across the stall window
+      incident_slo_factor: 4.0  # round wall beyond this multiple of the
+                                #   rolling median starts an SLO burn
+      incident_slo_rounds: 5    # consecutive burning rounds before an
+                                #   slo_burn alert
+      incident_slo_warmup: 16   # wall samples before the SLO baseline arms
+      incident_resolve_after: 8 # quiet rounds (no evidence, implicated
+                                #   peers healthy) before an incident
+                                #   resolves
+      recorder: true            # black-box flight recorder: bounded ring
+                                #   of per-round records dumped on crash /
+                                #   incident open / close / endpoint
+      recorder_rounds: 64       # flight-recorder ring depth (rounds)
+      recorder_path: flight-{me}.jsonl  # dump path ("{me}" substituted;
+                                #   null = dpwa-flight-<me>.jsonl in cwd)
 """
 
 from __future__ import annotations
@@ -918,9 +956,21 @@ class ObsConfig:
       peer an online ring-disagreement estimate.
     - ``metrics`` — a Prometheus text ``/metrics`` route on the healthz
       port, exposing counters/gauges from every enabled plane.
+    - ``incidents`` — online anomaly detectors over the existing
+      signals (fetch outcomes, scoreboard transitions, membership and
+      trust events, the sketch's rel_rms, round wall time) feeding a
+      correlator that folds alerts into open→evolve→resolve
+      ``incident`` records (docs/incidents.md), served live at the
+      ``/incidents`` healthz route.
+    - ``recorder`` — a black-box flight recorder: a bounded in-memory
+      ring of the last ``recorder_rounds`` rounds of
+      outcomes/verdicts/digests, dumped to a post-mortem JSONL artifact
+      on crash (atexit/SIGTERM), on incident open, on close, or via the
+      ``/flightdump`` healthz route.
 
     ``log_max_bytes`` caps any JSONL file the adapter's MetricsLogger
-    writes (health/exchange records), rolling to ``<path>.1``."""
+    writes (health/exchange records), rolling through ``log_keep``
+    generations (``<path>.1`` .. ``<path>.N``)."""
 
     trace: bool = False
     trace_every: int = 1
@@ -931,6 +981,24 @@ class ObsConfig:
     sketch_every: int = 1
     metrics: bool = False
     log_max_bytes: int = 0
+    log_keep: int = 1
+    incidents: bool = False
+    incident_path: "str | None" = None
+    incident_window: int = 8
+    incident_fail_streak: int = 2
+    incident_soft_streak: int = 2
+    incident_trust_burst: int = 2
+    incident_storm_threshold: int = 3
+    incident_stall_window: int = 8
+    incident_stall_min_rel: float = 0.05
+    incident_stall_improve: float = 0.01
+    incident_slo_factor: float = 4.0
+    incident_slo_rounds: int = 5
+    incident_slo_warmup: int = 16
+    incident_resolve_after: int = 8
+    recorder: bool = False
+    recorder_rounds: int = 64
+    recorder_path: "str | None" = None
 
     def __post_init__(self) -> None:
         if self.trace_every < 1:
@@ -954,11 +1022,48 @@ class ObsConfig:
             raise ValueError(
                 f"log_max_bytes must be >= 0, got {self.log_max_bytes}"
             )
+        if self.log_keep < 1:
+            raise ValueError(
+                f"log_keep must be >= 1, got {self.log_keep}"
+            )
+        for name in (
+            "incident_window",
+            "incident_fail_streak",
+            "incident_soft_streak",
+            "incident_trust_burst",
+            "incident_storm_threshold",
+            "incident_stall_window",
+            "incident_slo_rounds",
+            "incident_slo_warmup",
+            "incident_resolve_after",
+            "recorder_rounds",
+        ):
+            v = getattr(self, name)
+            if v < 1:
+                raise ValueError(f"{name} must be >= 1, got {v}")
+        if self.incident_stall_min_rel < 0:
+            raise ValueError(
+                f"incident_stall_min_rel must be >= 0, "
+                f"got {self.incident_stall_min_rel}"
+            )
+        if not 0.0 <= self.incident_stall_improve < 1.0:
+            raise ValueError(
+                f"incident_stall_improve must be in [0, 1), "
+                f"got {self.incident_stall_improve}"
+            )
+        if self.incident_slo_factor <= 1.0:
+            raise ValueError(
+                f"incident_slo_factor must be > 1, "
+                f"got {self.incident_slo_factor}"
+            )
 
     @property
     def enabled(self) -> bool:
         """Any facility on (the transport builds obs state iff this)."""
-        return self.trace or self.sketch or self.metrics
+        return (
+            self.trace or self.sketch or self.metrics
+            or self.incidents or self.recorder
+        )
 
 
 @dataclasses.dataclass(frozen=True)

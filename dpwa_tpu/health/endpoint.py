@@ -22,7 +22,9 @@ admission sheds — present when the flowctl plane is enabled), and
 tallies, compression ratio, prefetch-overlap occupancy — present when
 the topk codec or the prefetch pipeline is enabled); ``/metrics``
 serves Prometheus text exposition when a ``metrics_fn`` is wired
-(``obs.metrics``, docs/observability.md); every
+(``obs.metrics``, docs/observability.md).  The transport can register
+additional JSON routes via ``extra_routes`` (a path → callable map —
+used for ``/incidents`` and ``/flightdump``, docs/incidents.md); every
 other path gets the full snapshot — the endpoint is a
 liveness/introspection hook, not a general router.
 
@@ -37,7 +39,7 @@ from __future__ import annotations
 import json
 import socket
 import threading
-from typing import Callable, Optional
+from typing import Callable, Mapping, Optional
 
 
 class HealthzServer:
@@ -50,9 +52,14 @@ class HealthzServer:
         port: int = 0,
         metrics_fn: "Optional[Callable[[], str]]" = None,
         request_timeout_s: float = 2.0,
+        extra_routes: "Optional[Mapping[str, Callable[[], dict]]]" = None,
     ):
         self._snapshot_fn = snapshot_fn
         self._metrics_fn = metrics_fn
+        # Longest-path-first so "/incidents" wins over a "/inc" route.
+        self._extra_routes = sorted(
+            (extra_routes or {}).items(), key=lambda kv: -len(kv[0])
+        )
         self._request_timeout_s = max(0.05, float(request_timeout_s))
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -102,6 +109,38 @@ class HealthzServer:
                         b"Content-Length: " + str(len(body)).encode()
                         + b"\r\nConnection: close\r\n\r\n" + body
                     )
+                    continue
+                routed = None
+                for route, fn in self._extra_routes:
+                    if b" " + route.encode() in request_line:
+                        routed = fn
+                        break
+                try:
+                    if routed is not None:
+                        doc = routed()
+                        if not isinstance(doc, dict):
+                            doc = {"result": doc}
+                        body = json.dumps(doc).encode()
+                        conn.sendall(
+                            b"HTTP/1.0 200 OK\r\n"
+                            b"Content-Type: application/json\r\n"
+                            b"Content-Length: "
+                            + str(len(body)).encode()
+                            + b"\r\nConnection: close\r\n\r\n" + body
+                        )
+                        continue
+                except Exception:  # routes must never kill the endpoint
+                    body = b'{"error": "route failed"}'
+                    try:
+                        conn.sendall(
+                            b"HTTP/1.0 200 OK\r\n"
+                            b"Content-Type: application/json\r\n"
+                            b"Content-Length: "
+                            + str(len(body)).encode()
+                            + b"\r\nConnection: close\r\n\r\n" + body
+                        )
+                    except OSError:
+                        pass
                     continue
                 try:
                     doc = self._snapshot_fn()

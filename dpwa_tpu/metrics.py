@@ -12,6 +12,7 @@ import atexit
 import json
 import os
 import sys
+import threading
 import time
 from typing import IO, Any, Mapping, Optional
 
@@ -51,39 +52,57 @@ class MetricsLogger:
         stream: Optional[IO[str]] = None,
         every: int = 1,
         max_bytes: int = 0,
+        keep: int = 1,
     ):
         self._path = path
         self._file = open(path, "a", encoding="utf-8") if path else None
         self._stream = stream
         self.every = max(1, every)
         # Size cap for the JSONL file: when the next record would push it
-        # past ``max_bytes`` the current file rolls to ``<path>.1``
-        # (replacing any previous roll) and a fresh file starts — a soak
-        # run keeps at most ~2x max_bytes on disk instead of growing
-        # unboundedly.  0 = unbounded (the historical behaviour).
+        # past ``max_bytes`` the current file rolls into a ``<path>.1`` …
+        # ``<path>.keep`` cascade (``.i`` shifts to ``.i+1``, the oldest
+        # roll is replaced) and a fresh file starts — a soak run keeps at
+        # most ~(keep+1)x max_bytes on disk instead of growing
+        # unboundedly, and ``keep`` large enough covers the incident
+        # window a post-mortem needs.  0 = unbounded (the historical
+        # behaviour); keep=1 = the historical single-roll behaviour.
         self.max_bytes = max(0, int(max_bytes))
+        self.keep = max(1, int(keep))
         self._t0 = time.perf_counter()
         self._pending = None
+        # Serializes writers: the training thread and any Rx/healthz
+        # thread logging events through the same logger must not
+        # interleave mid-rotation (torn lines, double-rolls).
+        self._write_lock = threading.Lock()
         self._atexit = atexit.register(self.flush)
 
+    def _rotate(self) -> None:
+        """Roll ``<path>`` into the ``.1`` … ``.keep`` cascade."""
+        try:
+            self._file.close()
+            for i in range(self.keep - 1, 0, -1):
+                older = f"{self._path}.{i}"
+                if os.path.exists(older):
+                    os.replace(older, f"{self._path}.{i + 1}")
+            os.replace(self._path, self._path + ".1")
+        except OSError:
+            pass
+        self._file = open(self._path, "a", encoding="utf-8")
+
     def _write(self, line: str) -> None:
-        if self._file is not None:
-            if self.max_bytes and self._path:
-                try:
-                    pos = self._file.tell()
-                except OSError:
-                    pos = 0
-                if pos and pos + len(line) + 1 > self.max_bytes:
+        with self._write_lock:
+            if self._file is not None:
+                if self.max_bytes and self._path:
                     try:
-                        self._file.close()
-                        os.replace(self._path, self._path + ".1")
+                        pos = self._file.tell()
                     except OSError:
-                        pass
-                    self._file = open(self._path, "a", encoding="utf-8")
-            self._file.write(line + "\n")
-            self._file.flush()
-        if self._stream is not None:
-            print(line, file=self._stream, flush=True)
+                        pos = 0
+                    if pos and pos + len(line) + 1 > self.max_bytes:
+                        self._rotate()
+                self._file.write(line + "\n")
+                self._file.flush()
+            if self._stream is not None:
+                print(line, file=self._stream, flush=True)
 
     def __enter__(self) -> "MetricsLogger":
         return self
@@ -330,6 +349,7 @@ class MetricsLogger:
     def close(self) -> None:
         self.flush()
         atexit.unregister(self.flush)
-        if self._file is not None:
-            self._file.close()
-            self._file = None
+        with self._write_lock:
+            if self._file is not None:
+                self._file.close()
+                self._file = None

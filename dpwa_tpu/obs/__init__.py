@@ -16,6 +16,14 @@ all default-off; see docs/observability.md):
 - ``metrics`` — a pull-based :class:`~dpwa_tpu.obs.prometheus.MetricsRegistry`
   over the health/recovery/membership/trust/flowctl/wire planes, served
   as a Prometheus text ``/metrics`` route on the healthz port.
+- ``incidents`` — an :class:`~dpwa_tpu.obs.incidents.IncidentPlane` of
+  online anomaly detectors over the other planes' existing signals,
+  folded by a correlator into open→update→resolved ``incident`` records
+  served at a ``/incidents`` healthz route (docs/incidents.md).
+- ``recorder`` — a :class:`~dpwa_tpu.obs.recorder.FlightRecorder`
+  black-box ring of the last N rounds, dumped to a post-mortem JSONL
+  artifact on crash, incident open, or the ``/flightdump`` route
+  (``tools/incident_report.py`` joins per-node dumps).
 
 Everything here is zero-cost when disabled: with the ``obs:`` block off
 no trailing section is emitted, no ``perf_counter`` calls are added to
@@ -23,12 +31,16 @@ the hot path, and exchange byte streams are bit-identical to an
 obs-free build.
 """
 
+from dpwa_tpu.obs.incidents import IncidentPlane
 from dpwa_tpu.obs.prometheus import MetricsRegistry
+from dpwa_tpu.obs.recorder import FlightRecorder
 from dpwa_tpu.obs.sketch import SketchBoard, replica_sketch
 from dpwa_tpu.obs.trace import Tracer
 from dpwa_tpu.obs.wire import ObsFrame, decode_obs, encode_obs
 
 __all__ = [
+    "FlightRecorder",
+    "IncidentPlane",
     "MetricsRegistry",
     "ObsFrame",
     "SketchBoard",

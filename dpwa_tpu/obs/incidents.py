@@ -1,0 +1,586 @@
+"""Online incident plane: anomaly detectors + cross-plane correlator.
+
+PR 7 gave the ring raw telemetry (spans, sketches, /metrics); nothing
+watched it.  This module runs ON THE TRAINING THREAD, once per round,
+over signals every other plane already produces — fetch outcomes,
+scoreboard transition counters, membership and trust events, the
+sketch board's rel_rms, round wall time — and turns them into two
+typed JSONL record kinds (tools/schema_check.py freezes both):
+
+- ``record: "alert"`` — one detector firing: ``kind`` (detector),
+  ``plane`` (which subsystem produced the evidence), ``severity``,
+  ``value``/``threshold``, and the implicated ``peer``/``peers``.
+  Alerts are RISING EDGES: a condition that stays true emits one
+  alert, then feeds the open incident as silent support.
+- ``record: "incident"`` — the correlator's folded view with an
+  open → update → resolved lifecycle.  At most ONE incident is open
+  at a time: concurrent alerts fold into it (a partition explains the
+  refused streaks it causes; byzantine rejections explain the
+  quarantine they trigger), the classification upgrading to the
+  highest-priority evidence seen (:data:`KIND_PRIORITY`).  An incident
+  resolves after ``incident_resolve_after`` quiet rounds with every
+  implicated peer back to HEALTHY.
+
+Detector catalog (thresholds in :class:`~dpwa_tpu.config.ObsConfig`,
+walkthrough in docs/incidents.md):
+
+========================  =========  ==========================================
+alert kind                plane      evidence
+========================  =========  ==========================================
+``peer_failure``          health     ``incident_fail_streak`` consecutive hard
+                                     fetch failures (timeout/refused/
+                                     short_read/corrupt) from one peer
+``trust_burst``           trust      ``incident_trust_burst`` untrusted/
+                                     poisoned payloads from one peer inside
+                                     ``incident_window`` rounds
+``straggler``             flowctl    ``incident_soft_streak`` busy/slow soft
+                                     outcomes from one peer inside the window,
+                                     or the scoreboard holding it DEGRADED
+``partition``             membership the membership plane entering below-
+                                     quorum degraded mode (partition_entered)
+``partition_flap``        membership >= 2 partition entries inside
+                                     ``4 * incident_window`` rounds
+``state_storm``           health     ``incident_storm_threshold`` quarantine/
+                                     degrade transitions inside the window
+``slo_burn``              obs        ``incident_slo_rounds`` consecutive rounds
+                                     with wall time > ``incident_slo_factor`` x
+                                     the rolling median (after
+                                     ``incident_slo_warmup`` samples)
+``conv_stall``            obs        rel_rms above ``incident_stall_min_rel``
+                                     improving < ``incident_stall_improve``
+                                     across ``incident_stall_window`` samples
+========================  =========  ==========================================
+
+Determinism discipline: every detector that the chaos-to-incident
+matrix relies on (peer_failure, trust_burst, straggler, partition,
+state_storm) is keyed on round counters and outcome evidence only —
+replays of a seed fire identically.  Only ``slo_burn``/``conv_stall``
+read wall time / float telemetry, and both rank below every
+evidence-keyed classification so they can never misclassify a chaos
+incident.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Sequence, Set
+
+from dpwa_tpu.health.detector import Outcome
+from dpwa_tpu.metrics import MetricsLogger
+
+# Hard fetch failures: direct process/path death evidence.
+_HARD = (
+    Outcome.TIMEOUT, Outcome.REFUSED, Outcome.SHORT_READ, Outcome.CORRUPT,
+)
+# Content (byzantine) evidence — the guard or the trust screen fired.
+_BYZ = (Outcome.POISONED, Outcome.UNTRUSTED)
+# Load evidence — the soft outcomes the scoreboard degrades on.
+_SOFT = (Outcome.BUSY, Outcome.SLOW)
+
+# alert kind -> (emitting plane, incident classification, severity).
+ALERT_KINDS: Dict[str, tuple] = {
+    "partition": ("membership", "partition", "critical"),
+    "partition_flap": ("membership", "partition", "critical"),
+    "trust_burst": ("trust", "byzantine", "critical"),
+    "peer_failure": ("health", "peer_down", "critical"),
+    "straggler": ("flowctl", "straggler", "warning"),
+    "state_storm": ("health", "state_storm", "critical"),
+    "slo_burn": ("obs", "slo_burn", "warning"),
+    "conv_stall": ("obs", "conv_stall", "warning"),
+}
+
+# Root-cause priority between incident classifications (first wins):
+# concurrent alert kinds fold into one incident classified by the
+# highest-priority evidence.  Wall-clock detectors rank last so timing
+# jitter can never misclassify an evidence-keyed chaos incident.
+KIND_PRIORITY = (
+    "partition", "byzantine", "peer_down", "straggler",
+    "state_storm", "slo_burn", "conv_stall",
+)
+
+_SEV_RANK = {"warning": 1, "critical": 2}
+
+# Bounded record/alert memories (snapshot + pop_records back-pressure).
+_ALERT_MEMORY = 256
+_RECORD_MEMORY = 1024
+_CLOSED_MEMORY = 64
+_SLO_BASELINE = 64
+
+
+def _format_me(path: str, me: int) -> str:
+    """Substitute ``{me}`` so one shared config yields per-node files."""
+    try:
+        return path.format(me=me)
+    except (KeyError, IndexError, ValueError):
+        return path
+
+
+class IncidentPlane:
+    """Per-node detectors + correlator (see module doc).
+
+    ``observe_round`` runs on the training thread once per round;
+    ``snapshot`` is read by healthz/metrics threads — hence the lock
+    around the correlator outputs.  Detector state itself is
+    training-thread-only."""
+
+    def __init__(
+        self,
+        me: int,
+        n_peers: int,
+        cfg,
+        path: Optional[str] = None,
+    ):
+        self.me = int(me)
+        self.n_peers = int(n_peers)
+        self.cfg = cfg
+        if path is None:
+            path = cfg.incident_path
+        self._logger = (
+            MetricsLogger(path=_format_me(path, self.me)) if path else None
+        )
+        self._t0 = time.perf_counter()
+        self._lock = threading.Lock()
+        # -- detector state (training thread only) ------------------------
+        self._hard_streak: Dict[int, int] = {}
+        self._byz_steps: Dict[int, deque] = {}
+        self._byz_live: Set[int] = set()
+        self._soft_steps: Dict[int, deque] = {}
+        self._soft_live: Set[int] = set()
+        self._prev_transitions: Dict[int, int] = {}
+        self._storm_steps: deque = deque()
+        self._storm_live = False
+        self._partition_steps: deque = deque()
+        self._partition_live = False
+        self._flap_live = False
+        self._rel: deque = deque(maxlen=max(2, cfg.incident_stall_window))
+        self._stall_live = False
+        self._wall: deque = deque(maxlen=_SLO_BASELINE)
+        self._burn = 0
+        self._slo_live = False
+        # -- correlator outputs (shared with snapshot readers) ------------
+        self._alert_total: Dict[str, int] = {}
+        self._alerts: deque = deque(maxlen=_ALERT_MEMORY)
+        self._records: deque = deque(maxlen=_RECORD_MEMORY)
+        self._open: Optional[dict] = None
+        self._closed: deque = deque(maxlen=_CLOSED_MEMORY)
+        self._opened_total = 0
+        self._resolved_total = 0
+        self._last_step = -1
+
+    # ------------------------------------------------------------------
+    # Detectors (training thread)
+    # ------------------------------------------------------------------
+
+    def observe_round(
+        self,
+        step: int,
+        *,
+        outcome: Optional[str] = None,
+        peer: Optional[int] = None,
+        board: Optional[dict] = None,
+        events: Sequence[dict] = (),
+        rel_rms: Optional[float] = None,
+        wall_s: Optional[float] = None,
+        partition_state: Optional[str] = None,
+        component: Optional[Sequence[int]] = None,
+    ) -> dict:
+        """Feed one round of evidence; returns ``{"alerts": [kinds],
+        "opened": bool}`` so the transport can trigger the flight
+        recorder on incident open.
+
+        ``outcome``/``peer`` are this round's fetch resolution (None on
+        skipped rounds); ``board`` is the scoreboard snapshot;
+        ``events`` are this round's membership + trust event dicts;
+        ``rel_rms`` the sketch board's relative disagreement; ``wall_s``
+        the entry-to-entry round wall; ``partition_state``/``component``
+        the membership view."""
+        cfg = self.cfg
+        step = int(step)
+        fired: List[dict] = []
+        # kind -> implicated peers still actively supported this round.
+        active: Dict[str, Set[int]] = {}
+        window = cfg.incident_window
+
+        def _fire(kind: str, peers: Set[int], value: float,
+                  threshold: float, win: Optional[int] = None) -> None:
+            plane, _, severity = ALERT_KINDS[kind]
+            alert: Dict[str, Any] = {
+                "record": "alert", "kind": kind, "severity": severity,
+                "plane": plane, "value": round(float(value), 6),
+                "threshold": round(float(threshold), 6),
+            }
+            if win is not None:
+                alert["window"] = int(win)
+            if len(peers) == 1:
+                alert["peer"] = next(iter(peers))
+            elif peers:
+                alert["peers"] = sorted(peers)
+            fired.append(alert)
+
+        # 1. Fetch-outcome streaks/bursts against this round's partner.
+        if peer is not None and peer != self.me and outcome is not None:
+            if outcome == Outcome.SUCCESS:
+                self._hard_streak[peer] = 0
+            elif outcome in _HARD:
+                s = self._hard_streak.get(peer, 0) + 1
+                self._hard_streak[peer] = s
+                if s == cfg.incident_fail_streak:
+                    _fire("peer_failure", {peer}, s,
+                          cfg.incident_fail_streak)
+            if outcome in _BYZ:
+                self._byz_steps.setdefault(peer, deque()).append(step)
+            if outcome in _SOFT:
+                self._soft_steps.setdefault(peer, deque()).append(step)
+        for p, s in self._hard_streak.items():
+            if s >= cfg.incident_fail_streak:
+                active.setdefault("peer_failure", set()).add(p)
+        for kind, steps, live, thr in (
+            ("trust_burst", self._byz_steps, self._byz_live,
+             cfg.incident_trust_burst),
+            ("straggler", self._soft_steps, self._soft_live,
+             cfg.incident_soft_streak),
+        ):
+            for p, dq in steps.items():
+                while dq and dq[0] <= step - window:
+                    dq.popleft()
+                if len(dq) >= thr:
+                    active.setdefault(kind, set()).add(p)
+                    if p not in live:
+                        live.add(p)
+                        _fire(kind, {p}, len(dq), thr, window)
+                else:
+                    live.discard(p)
+
+        # 2. Scoreboard transition storm + sticky unhealthy states.
+        sticky: Set[int] = set()
+        if board is not None:
+            for p, info in board.get("peers", {}).items():
+                p = int(p)
+                c = int(info.get("quarantines", 0) or 0) + int(
+                    info.get("degrades", 0) or 0
+                )
+                prev = self._prev_transitions.get(p, 0)
+                if c > prev:
+                    for _ in range(c - prev):
+                        self._storm_steps.append((step, p))
+                self._prev_transitions[p] = c
+                state = info.get("state")
+                if state in ("quarantined", "degraded"):
+                    sticky.add(p)
+                if state == "degraded":
+                    # A DEGRADED peer is ongoing straggler support even
+                    # on rounds we did not fetch it (digest adoption).
+                    active.setdefault("straggler", set()).add(p)
+            while self._storm_steps and (
+                self._storm_steps[0][0] <= step - window
+            ):
+                self._storm_steps.popleft()
+            n_trans = len(self._storm_steps)
+            if n_trans >= cfg.incident_storm_threshold:
+                peers = {p for _, p in self._storm_steps}
+                active.setdefault("state_storm", set()).update(peers)
+                if not self._storm_live:
+                    self._storm_live = True
+                    _fire("state_storm", peers, n_trans,
+                          cfg.incident_storm_threshold, window)
+            else:
+                self._storm_live = False
+
+        # 3. Membership partition events + trust collapse support.
+        members = set(int(p) for p in component) if component else None
+        others = (
+            {p for p in range(self.n_peers)
+             if p != self.me and (members is None or p not in members)}
+        )
+        for ev in events:
+            kind = ev.get("event")
+            if kind == "partition_entered":
+                self._partition_steps.append(step)
+                self._partition_live = True
+                comp = ev.get("component")
+                cut = {
+                    p for p in range(self.n_peers)
+                    if p != self.me and comp is not None and p not in comp
+                }
+                _fire("partition", cut or others,
+                      len(comp) if comp is not None else 0,
+                      float(ev.get("quorum_fraction", 0.0)))
+            elif kind == "partition_healed":
+                self._partition_live = False
+            elif kind == "trust_collapsed":
+                p = ev.get("peer")
+                if p is not None:
+                    active.setdefault("trust_burst", set()).add(int(p))
+        if partition_state == "degraded":
+            self._partition_live = True
+        elif partition_state == "ok" and not any(
+            ev.get("event") == "partition_entered" for ev in events
+        ):
+            self._partition_live = False
+        if self._partition_live:
+            active.setdefault("partition", set()).update(others)
+        while self._partition_steps and (
+            self._partition_steps[0] <= step - 4 * window
+        ):
+            self._partition_steps.popleft()
+        if len(self._partition_steps) >= 2:
+            if not self._flap_live:
+                self._flap_live = True
+                _fire("partition_flap", others, len(self._partition_steps),
+                      2, 4 * window)
+        else:
+            self._flap_live = False
+
+        # 4. Convergence stall over the sketch's rel_rms.
+        if rel_rms is not None and rel_rms > 0.0:
+            self._rel.append(float(rel_rms))
+            if len(self._rel) == self._rel.maxlen:
+                first, last = self._rel[0], self._rel[-1]
+                stalled = (
+                    min(self._rel) > cfg.incident_stall_min_rel
+                    and last > first * (1.0 - cfg.incident_stall_improve)
+                )
+                if stalled:
+                    active.setdefault("conv_stall", set())
+                    if not self._stall_live:
+                        self._stall_live = True
+                        _fire("conv_stall", set(), last,
+                              cfg.incident_stall_min_rel,
+                              cfg.incident_stall_window)
+                else:
+                    self._stall_live = False
+
+        # 5. Round wall-time SLO burn vs the rolling median baseline.
+        if wall_s is not None and wall_s >= 0.0:
+            if len(self._wall) >= cfg.incident_slo_warmup:
+                base = sorted(self._wall)
+                med = base[len(base) // 2]
+                if med > 0.0 and wall_s > cfg.incident_slo_factor * med:
+                    self._burn += 1
+                else:
+                    self._burn = 0
+                if self._burn >= cfg.incident_slo_rounds:
+                    active.setdefault("slo_burn", set())
+                    if not self._slo_live:
+                        self._slo_live = True
+                        _fire("slo_burn", set(), wall_s,
+                              cfg.incident_slo_factor * med,
+                              cfg.incident_slo_rounds)
+                else:
+                    self._slo_live = False
+            self._wall.append(float(wall_s))
+
+        with self._lock:
+            self._last_step = step
+            t = round(time.perf_counter() - self._t0, 4)
+            for alert in fired:
+                self._alert_total[alert["kind"]] = (
+                    self._alert_total.get(alert["kind"], 0) + 1
+                )
+                full = dict(alert)
+                full["step"] = step
+                full["t"] = t
+                self._alerts.append(full)
+                self._records.append(full)
+                if self._logger is not None:
+                    self._logger.log(step, _t=t, **alert)
+            opened = self._fold(step, t, fired, active, sticky)
+        return {"alerts": [a["kind"] for a in fired], "opened": opened}
+
+    # ------------------------------------------------------------------
+    # Correlator (called under self._lock)
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def _rank(kind: str) -> int:
+        try:
+            return KIND_PRIORITY.index(kind)
+        except ValueError:
+            return len(KIND_PRIORITY)
+
+    def _fold(
+        self,
+        step: int,
+        t: float,
+        fired: List[dict],
+        active: Dict[str, Set[int]],
+        sticky: Set[int],
+    ) -> bool:
+        """Fold this round's alerts + ongoing support into the single
+        open incident; open/update/resolve as evidence demands.
+        Returns True when a NEW incident opened this round."""
+        inc = self._open
+        if inc is None:
+            if not fired:
+                return False
+            inc = self._open = {
+                "id": f"{self.me}:{step}",
+                "kind": "conv_stall",  # placeholder, upgraded below
+                "severity": "warning",
+                "peers": set(),
+                "alerts": 0,
+                "alert_kinds": set(),
+                "opened_step": step,
+                "last_evidence_step": step,
+            }
+            self._opened_total += 1
+            self._merge_alerts(inc, fired)
+            self._emit_incident(inc, "open", step, t)
+            return True
+        changed = self._merge_alerts(inc, fired)
+        if fired or active or (sticky & inc["peers"]):
+            inc["last_evidence_step"] = step
+        if changed:
+            self._emit_incident(inc, "update", step, t)
+        elif (
+            step - inc["last_evidence_step"]
+            >= self.cfg.incident_resolve_after
+        ):
+            self._open = None
+            self._resolved_total += 1
+            self._emit_incident(inc, "resolved", step, t)
+            pub = self._public(inc, "resolved")
+            pub["resolved_step"] = step
+            self._closed.append(pub)
+        return False
+
+    def _merge_alerts(self, inc: dict, fired: List[dict]) -> bool:
+        changed = False
+        for alert in fired:
+            inc["alerts"] += 1
+            inc["alert_kinds"].add(alert["kind"])
+            _, cls, severity = ALERT_KINDS[alert["kind"]]
+            if self._rank(cls) < self._rank(inc["kind"]):
+                inc["kind"] = cls
+                changed = True
+            if (
+                _SEV_RANK.get(severity, 0)
+                > _SEV_RANK.get(inc["severity"], 0)
+            ):
+                inc["severity"] = severity
+                changed = True
+            peers = set(alert.get("peers") or ())
+            if "peer" in alert:
+                peers.add(alert["peer"])
+            if peers - inc["peers"]:
+                inc["peers"] |= peers
+                changed = True
+        return changed
+
+    def _emit_incident(
+        self, inc: dict, status: str, step: int, t: float
+    ) -> None:
+        rec: Dict[str, Any] = {
+            "record": "incident",
+            "id": inc["id"],
+            "status": status,
+            "kind": inc["kind"],
+            "severity": inc["severity"],
+            "peers": sorted(inc["peers"]),
+            "alerts": inc["alerts"],
+            "opened_step": inc["opened_step"],
+            "me": self.me,
+        }
+        if status == "resolved":
+            rec["resolved_step"] = step
+        full = dict(rec)
+        full["step"] = step
+        full["t"] = t
+        self._records.append(full)
+        if self._logger is not None:
+            self._logger.log(step, _t=t, **rec)
+
+    def _public(self, inc: dict, status: str) -> dict:
+        return {
+            "id": inc["id"],
+            "status": status,
+            "kind": inc["kind"],
+            "severity": inc["severity"],
+            "peers": sorted(inc["peers"]),
+            "alerts": inc["alerts"],
+            "alert_kinds": sorted(inc["alert_kinds"]),
+            "opened_step": inc["opened_step"],
+            "last_evidence_step": inc["last_evidence_step"],
+        }
+
+    # ------------------------------------------------------------------
+    # Readers (healthz / metrics threads, tests)
+    # ------------------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """JSON-ready incident view — the ``/incidents`` healthz route
+        and the ``incidents`` sub-document of ``health_snapshot``."""
+        with self._lock:
+            return {
+                "me": self.me,
+                "step": self._last_step,
+                "open": (
+                    [self._public(self._open, "open")]
+                    if self._open is not None
+                    else []
+                ),
+                "closed": list(self._closed),
+                "opened_total": self._opened_total,
+                "resolved_total": self._resolved_total,
+                "alerts_total": dict(self._alert_total),
+                "recent_alerts": list(self._alerts)[-16:],
+            }
+
+    def pop_records(self) -> List[dict]:
+        """Drain the in-memory alert/incident records (tests, adapters,
+        the flight recorder's dump join)."""
+        with self._lock:
+            out = list(self._records)
+            self._records.clear()
+        return out
+
+    def close(self) -> None:
+        if self._logger is not None:
+            self._logger.close()
+            self._logger = None
+
+
+def register_metrics(registry, plane: IncidentPlane) -> None:
+    """Prometheus collectors for the incident plane (scrape-time reads
+    of :meth:`IncidentPlane.snapshot`, nothing on the hot path)."""
+    from dpwa_tpu.obs.prometheus import Family
+
+    def _collect():
+        snap = plane.snapshot()
+        alerts = Family(
+            "dpwa_alerts_total",
+            "counter",
+            "Detector alerts fired, by alert kind.",
+        )
+        for kind, n in sorted(snap["alerts_total"].items()):
+            alerts.sample(n, {"kind": kind})
+        sev = 0
+        for inc in snap["open"]:
+            sev = max(sev, _SEV_RANK.get(inc["severity"], 0))
+        return [
+            alerts,
+            Family(
+                "dpwa_incidents_opened_total",
+                "counter",
+                "Incidents opened by the correlator.",
+            ).sample(snap["opened_total"]),
+            Family(
+                "dpwa_incidents_resolved_total",
+                "counter",
+                "Incidents resolved by the correlator.",
+            ).sample(snap["resolved_total"]),
+            Family(
+                "dpwa_incidents_open",
+                "gauge",
+                "Incidents currently open (0 or 1).",
+            ).sample(len(snap["open"])),
+            Family(
+                "dpwa_incident_severity",
+                "gauge",
+                "Max open-incident severity (0=none 1=warning 2=critical).",
+            ).sample(sev),
+        ]
+
+    registry.register(_collect)

@@ -1,0 +1,213 @@
+"""Black-box flight recorder: a bounded ring of per-round evidence.
+
+The incident plane (:mod:`dpwa_tpu.obs.incidents`) tells you THAT
+something happened; the flight recorder preserves WHAT the node saw in
+the rounds leading up to it.  The transport appends one compact entry
+per round — partner, outcome, latency, codec, trust verdict, sketch
+disagreement, membership state, plus any alerts that fired — into an
+in-memory ring of the last ``obs.recorder_rounds`` rounds.  The ring
+is dumped to a JSONL artifact:
+
+- on crash: ``arm_crash_dump`` registers an ``atexit`` hook and a
+  SIGTERM handler (signal registration is skipped off the main
+  thread);
+- on incident open (the transport calls :meth:`dump` when
+  ``observe_round`` reports ``opened``);
+- on demand via the ``/flightdump`` healthz route or :meth:`dump`.
+
+Dump format (frozen in tools/schema_check.py): one
+``record: "flight", kind: "meta"`` header carrying the dump reason and
+ring size, followed by the ring entries as
+``record: "flight", kind: "round"`` in chronological order.  Dumps are
+written to a temp file then ``os.replace``-d so a crash mid-dump never
+leaves a torn artifact, and every failure path swallows ``OSError`` —
+the recorder must never take down the training process it is meant to
+post-mortem.  ``tools/incident_report.py`` joins per-node dumps into a
+cross-peer timeline.
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import signal
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, Optional
+
+
+def default_path(me: int) -> str:
+    return f"dpwa-flight-{me}.jsonl"
+
+
+class FlightRecorder:
+    """Bounded per-round ring with crash-safe JSONL dumps.
+
+    ``note_round`` runs on the training thread; ``dump`` may be called
+    from the training thread, a healthz thread, atexit, or a signal
+    handler — hence the lock and the never-raise discipline."""
+
+    def __init__(
+        self,
+        me: int,
+        rounds: int = 64,
+        path: Optional[str] = None,
+    ):
+        self.me = int(me)
+        if path is None:
+            path = default_path(self.me)
+        else:
+            try:
+                path = path.format(me=self.me)
+            except (KeyError, IndexError, ValueError):
+                pass
+        self.path = path
+        self._ring: deque = deque(maxlen=max(1, int(rounds)))
+        self._t0 = time.perf_counter()
+        self._lock = threading.Lock()
+        self._dumps = 0
+        self._armed = False
+        self._prev_sigterm: Any = None
+
+    # ------------------------------------------------------------------
+    # Recording (training thread)
+    # ------------------------------------------------------------------
+
+    def note_round(self, step: int, **fields: Any) -> None:
+        """Append one round's evidence. Values must be JSON-ready; None
+        values are dropped so the ring stays compact."""
+        entry: Dict[str, Any] = {
+            "record": "flight",
+            "kind": "round",
+            "me": self.me,
+            "step": int(step),
+            "t": round(time.perf_counter() - self._t0, 4),
+        }
+        for k, v in fields.items():
+            if v is not None:
+                entry[k] = v
+        with self._lock:
+            self._ring.append(entry)
+
+    # ------------------------------------------------------------------
+    # Dumping (any thread, atexit, signal)
+    # ------------------------------------------------------------------
+
+    def dump(self, reason: str, step: Optional[int] = None) -> Optional[str]:
+        """Write meta + ring to ``self.path`` (atomic via temp-file +
+        ``os.replace``). Returns the path, or None when the ring is
+        empty or the write failed — never raises."""
+        with self._lock:
+            entries = list(self._ring)
+            self._dumps += 1
+            n_dump = self._dumps
+        if not entries:
+            return None
+        meta: Dict[str, Any] = {
+            "record": "flight",
+            "kind": "meta",
+            "me": self.me,
+            "step": int(step) if step is not None else entries[-1]["step"],
+            "t": round(time.perf_counter() - self._t0, 4),
+            "reason": str(reason),
+            "rounds": len(entries),
+            "dumps": n_dump,
+        }
+        tmp = f"{self.path}.tmp.{os.getpid()}"
+
+        def _coerce(v: Any) -> Any:
+            # numpy scalars and other strays must never abort a dump.
+            try:
+                return float(v)
+            except (TypeError, ValueError):
+                return str(v)
+
+        try:
+            with open(tmp, "w", encoding="utf-8") as fh:
+                fh.write(
+                    json.dumps(meta, separators=(",", ":"), default=_coerce)
+                    + "\n"
+                )
+                for entry in entries:
+                    fh.write(
+                        json.dumps(
+                            entry, separators=(",", ":"), default=_coerce
+                        )
+                        + "\n"
+                    )
+                fh.flush()
+                os.fsync(fh.fileno())
+            os.replace(tmp, self.path)
+        except (OSError, TypeError, ValueError):
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            return None
+        return self.path
+
+    # ------------------------------------------------------------------
+    # Crash hooks
+    # ------------------------------------------------------------------
+
+    def arm_crash_dump(self) -> None:
+        """Register atexit + SIGTERM dump hooks. Idempotent; signal
+        registration is best-effort (skipped off the main thread)."""
+        if self._armed:
+            return
+        self._armed = True
+        atexit.register(self._atexit_dump)
+        try:
+            self._prev_sigterm = signal.signal(
+                signal.SIGTERM, self._on_sigterm
+            )
+        except (ValueError, OSError):  # non-main thread / restricted env
+            self._prev_sigterm = None
+
+    def _atexit_dump(self) -> None:
+        if self._armed:
+            self.dump("atexit")
+
+    def _on_sigterm(self, signum, frame) -> None:
+        self.dump("sigterm")
+        prev = self._prev_sigterm
+        if callable(prev):
+            prev(signum, frame)
+        else:
+            # Restore default disposition and re-raise so the process
+            # still dies with the expected signal semantics.
+            try:
+                signal.signal(signal.SIGTERM, signal.SIG_DFL)
+                os.kill(os.getpid(), signal.SIGTERM)
+            except (ValueError, OSError):
+                raise SystemExit(143)
+
+    def disarm(self) -> None:
+        """Drop crash hooks (clean close path: the transport already
+        dumped with reason="close")."""
+        if not self._armed:
+            return
+        self._armed = False
+        try:
+            atexit.unregister(self._atexit_dump)
+        except Exception:
+            pass
+        if self._prev_sigterm is not None:
+            try:
+                signal.signal(signal.SIGTERM, self._prev_sigterm)
+            except (ValueError, OSError):
+                pass
+            self._prev_sigterm = None
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "me": self.me,
+                "path": self.path,
+                "rounds": len(self._ring),
+                "capacity": self._ring.maxlen,
+                "dumps": self._dumps,
+                "armed": self._armed,
+            }

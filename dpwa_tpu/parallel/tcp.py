@@ -1443,6 +1443,36 @@ class TcpTransport:
             from dpwa_tpu.obs.prometheus import MetricsRegistry
 
             self.metrics_registry = MetricsRegistry()
+        # Incident plane + black-box flight recorder (docs/incidents.md):
+        # online detectors over the signals the other planes already
+        # produce, correlated into open→update→resolved incidents, plus
+        # a bounded last-N-rounds ring dumped on crash/incident/demand.
+        # Both None when off — the round boundary then takes no extra
+        # branches and no timing calls (zero-cost-when-disabled).
+        self.incidents = None
+        if obs_cfg.incidents:
+            from dpwa_tpu.obs.incidents import IncidentPlane
+
+            self.incidents = IncidentPlane(
+                self.me, len(config.nodes), obs_cfg
+            )
+        self.flight = None
+        if obs_cfg.recorder:
+            from dpwa_tpu.obs.recorder import FlightRecorder
+
+            self.flight = FlightRecorder(
+                self.me,
+                rounds=obs_cfg.recorder_rounds,
+                path=obs_cfg.recorder_path,
+            )
+            self.flight.arm_crash_dump()
+        # Event interception: when the incident plane (or recorder) is
+        # armed the round hook drains membership/trust events so
+        # detectors see them the round they happen; adapters keep seeing
+        # every event through pop_*_events reading these buffers.
+        self._membership_event_buf: list = []
+        self._trust_event_buf: list = []
+        self._obs_round_entry_t: Optional[float] = None
         spec = config.nodes[self.me]
         # Fetcher-side flow control: the per-peer latency estimator that
         # derives adaptive cumulative deadlines and hedge launch points.
@@ -1527,6 +1557,11 @@ class TcpTransport:
         if config.health.enabled and config.health.healthz_port is not None:
             from dpwa_tpu.health.endpoint import HealthzServer
 
+            extra_routes: dict = {}
+            if self.incidents is not None:
+                extra_routes["/incidents"] = self.incidents.snapshot
+            if self.flight is not None:
+                extra_routes["/flightdump"] = self._flight_dump_route
             self.healthz = HealthzServer(
                 self.health_snapshot, spec.host, config.health.healthz_port,
                 metrics_fn=(
@@ -1534,6 +1569,7 @@ class TcpTransport:
                     if self.metrics_registry is not None
                     else None
                 ),
+                extra_routes=extra_routes or None,
             )
         # Bookkeeping for metrics/adapters: last fetch outcome and the
         # last round's partner resolution (schedule vs. health remap).
@@ -2327,6 +2363,8 @@ class TcpTransport:
             snap["wire"] = self.wire_snapshot()
         if self.tracer is not None or self.sketchboard is not None:
             snap["obs"] = self.obs_snapshot()
+        if self.incidents is not None:
+            snap["incidents"] = self.incidents.snapshot()
         return snap
 
     def obs_snapshot(self) -> dict:
@@ -2510,6 +2548,12 @@ class TcpTransport:
                 return [total, med]
 
             registry.register(_trace)
+        if self.incidents is not None:
+            from dpwa_tpu.obs.incidents import (
+                register_metrics as _reg_inc,
+            )
+
+            _reg_inc(registry, self.incidents)
 
     def _trust_alpha_scale(self) -> float:
         """The CURRENT exchange's trust damping (interpolation hook)."""
@@ -2612,12 +2656,113 @@ class TcpTransport:
     def _membership_end_round(self, step: int) -> None:
         if self.membership is not None:
             self.membership.end_round(step)
+        if self.incidents is not None or self.flight is not None:
+            self._obs_round_end(step)
+
+    def _obs_round_end(self, step: int) -> None:
+        """Incident-plane + flight-recorder round boundary — runs right
+        after the membership boundary on EVERY exit path of every
+        exchange substrate.  Gathers this round's evidence from state
+        the round already produced (``last_round``/``last_fetch``, the
+        scoreboard, the membership view, the sketch board) — no extra
+        wire traffic, no device syncs."""
+        now = time.monotonic()
+        wall = None
+        if self._obs_round_entry_t is not None:
+            # Entry-to-entry wall: compute + exchange, the quantity the
+            # SLO-burn detector baselines.
+            wall = now - self._obs_round_entry_t
+        self._obs_round_entry_t = now
+        lr = self.last_round
+        this_round = lr.get("step") == step
+        peer = lr.get("partner") if this_round else None
+        outcome = lr.get("outcome") if this_round else None
+        lf = self.last_fetch if this_round else {}
+        # Drain membership/trust events HERE so detectors see them the
+        # round they happen; adapters still receive every event through
+        # the pop_*_events buffers (one drain later at worst).
+        events: list = []
+        if self.membership is not None:
+            evs = self.membership.pop_events()
+            events.extend(evs)
+            self._membership_event_buf.extend(evs)
+        if self.trust is not None:
+            evs = self.trust.pop_events()
+            events.extend(evs)
+            self._trust_event_buf.extend(evs)
+        board = (
+            self.scoreboard.snapshot()
+            if self.scoreboard is not None
+            else None
+        )
+        partition_state = component = None
+        if self.membership is not None:
+            view = self.membership.view_snapshot()
+            partition_state = view.get("partition_state")
+            component = view.get("component")
+        rel = None
+        if self.sketchboard is not None:
+            _, rel = self.sketchboard.disagreement()
+        fired: list = []
+        opened = False
+        if self.incidents is not None:
+            res = self.incidents.observe_round(
+                step,
+                outcome=outcome,
+                peer=peer,
+                board=board,
+                events=events,
+                rel_rms=rel,
+                wall_s=wall,
+                partition_state=partition_state,
+                component=component,
+            )
+            fired = res["alerts"]
+            opened = res["opened"]
+        if self.flight is not None:
+            self.flight.note_round(
+                step,
+                partner=peer,
+                sched_partner=lr.get("sched_partner") if this_round else None,
+                remapped=lr.get("remapped") if this_round else None,
+                outcome=outcome,
+                codec=lr.get("codec") if this_round else None,
+                trust=lr.get("trust") if this_round else None,
+                latency_s=lf.get("latency_s"),
+                nbytes=lf.get("nbytes"),
+                rel_rms=rel,
+                wall_s=round(wall, 6) if wall is not None else None,
+                partition_state=partition_state,
+                events=[e.get("event") for e in events] or None,
+                alerts=fired or None,
+            )
+            if opened:
+                # Incident open is a dump trigger: preserve the run-up
+                # before the ring scrolls past it.
+                self.flight.dump("incident", step)
+
+    def _flight_dump_route(self) -> dict:
+        """``/flightdump`` healthz route: dump the ring on demand."""
+        path = (
+            self.flight.dump("endpoint")
+            if self.flight is not None
+            else None
+        )
+        out: dict = {"dumped": path is not None}
+        if path is not None:
+            out["path"] = path
+        return out
 
     def pop_membership_events(self) -> list:
         """Drain membership events (refutations, component changes,
         partition entered/healed) for the metrics JSONL."""
         if self.membership is None:
             return []
+        if self.incidents is not None or self.flight is not None:
+            out = self._membership_event_buf
+            self._membership_event_buf = []
+            out.extend(self.membership.pop_events())
+            return out
         return self.membership.pop_events()
 
     def pop_heal_advice(self) -> Optional[dict]:
@@ -2631,6 +2776,11 @@ class TcpTransport:
         metrics JSONL."""
         if self.trust is None:
             return []
+        if self.incidents is not None or self.flight is not None:
+            out = self._trust_event_buf
+            self._trust_event_buf = []
+            out.extend(self.trust.pop_events())
+            return out
         return self.trust.pop_events()
 
     def set_trust_leaves(self, sizes) -> None:
@@ -2922,6 +3072,13 @@ class TcpTransport:
         return _device_lerp(vec_dev, remote_vec, alpha), alpha, partner
 
     def close(self) -> None:
+        if self.flight is not None:
+            # Clean-close dump, then drop the crash hooks — atexit must
+            # not overwrite this dump with a shorter post-close ring.
+            self.flight.dump("close")
+            self.flight.disarm()
+        if self.incidents is not None:
+            self.incidents.close()
         if self.healthz is not None:
             self.healthz.close()
         if self.tracer is not None:

@@ -1,0 +1,745 @@
+"""Incident plane (``obs.incidents``) + flight recorder (``obs.recorder``).
+
+Covers the detector catalog and correlator lifecycle as fast units, the
+flight recorder's ring/dump/crash-hook contract, the endpoint surface
+(``/incidents``, ``/flightdump``, incident families on ``/metrics``)
+under concurrent scrapes, and the acceptance gates from
+docs/incidents.md:
+
+- **chaos-to-incident matrix** — each injected fault kind in a 4-node
+  soak (kill, partition, byzantine, straggler) produces EXACTLY ONE
+  correctly classified incident cluster (``tools/incident_report.py``
+  cluster level), detection latency <= 3 rounds of the injection start,
+  implicating the injected peer;
+- a clean run of equal length produces zero alerts and zero incidents;
+- a killed peer's flight dump reconstructs its last >= 8 rounds;
+- every alert/incident/flight artifact validates against the frozen
+  schemas in ``tools/schema_check.py``.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from dpwa_tpu.config import ObsConfig, make_local_config
+from dpwa_tpu.health.detector import Outcome
+from dpwa_tpu.obs.incidents import (
+    ALERT_KINDS,
+    KIND_PRIORITY,
+    IncidentPlane,
+    register_metrics,
+)
+from dpwa_tpu.obs.prometheus import MetricsRegistry
+from dpwa_tpu.obs.recorder import FlightRecorder
+from dpwa_tpu.parallel.tcp import TcpTransport
+
+_ROOT = os.path.join(os.path.dirname(__file__), "..")
+sys.path.insert(0, _ROOT)
+
+from tools import incident_report, schema_check  # noqa: E402
+
+
+# ---------------------------------------------------------------------------
+# Helpers
+# ---------------------------------------------------------------------------
+
+
+def _plane(me=0, n=4, **over):
+    kw = dict(incidents=True)
+    kw.update(over)
+    return IncidentPlane(me, n, ObsConfig(**kw))
+
+
+def _ring(n, **cfg_kwargs):
+    cfg = make_local_config(n, base_port=0, **cfg_kwargs)
+    ts = [TcpTransport(cfg, f"node{i}") for i in range(n)]
+    for t in ts:
+        for i, other in enumerate(ts):
+            t.set_peer_port(i, other.port)
+    return ts
+
+
+def _close(ts):
+    for t in ts:
+        t.close()
+
+
+def _obs(tmp_path, **over):
+    d = dict(
+        incidents=True,
+        incident_path=str(tmp_path / "inc-{me}.jsonl"),
+        recorder=True,
+        recorder_path=str(tmp_path / "flight-{me}.jsonl"),
+    )
+    d.update(over)
+    return d
+
+
+def _soak(tmp_path, steps, n=4, vec=512, loss=0.1, **cfg_kwargs):
+    """Lock-step n-node soak; every node's incident/flight artifacts
+    land in tmp_path via the ``{me}``-substituted obs paths."""
+    ts = _ring(n, **cfg_kwargs)
+    vecs = [np.full(vec, float(i) + 1.0, np.float32) for i in range(n)]
+    try:
+        for step in range(steps):
+            for i, t in enumerate(ts):
+                m, _alpha, _partner = t.exchange(
+                    vecs[i], float(step), loss, step
+                )
+                vecs[i] = np.asarray(m, np.float32)
+    finally:
+        _close(ts)
+    return vecs
+
+
+def _artifacts(tmp_path):
+    return sorted(
+        str(p)
+        for pat in ("inc-*.jsonl", "flight-*.jsonl")
+        for p in tmp_path.glob(pat)
+    )
+
+
+def _report(tmp_path):
+    paths = _artifacts(tmp_path)
+    assert paths, "soak produced no incident/flight artifacts"
+    return incident_report.build_report(incident_report.load_records(paths))
+
+
+def _schemas_clean(tmp_path):
+    for p in _artifacts(tmp_path):
+        _n, errors = schema_check.check_file(p)
+        assert errors == [], f"{p}: {errors[:3]}"
+
+
+# ---------------------------------------------------------------------------
+# Detector units: rising edges, windows, severity
+# ---------------------------------------------------------------------------
+
+
+def test_peer_failure_alert_is_rising_edge():
+    p = _plane()
+    out = p.observe_round(0, outcome=Outcome.TIMEOUT, peer=3)
+    assert out == {"alerts": [], "opened": False}
+    out = p.observe_round(1, outcome=Outcome.TIMEOUT, peer=3)
+    assert out["alerts"] == ["peer_failure"] and out["opened"]
+    # The condition staying true is silent support, not a second alert.
+    out = p.observe_round(2, outcome=Outcome.TIMEOUT, peer=3)
+    assert out["alerts"] == [] and not out["opened"]
+    snap = p.snapshot()
+    assert snap["alerts_total"] == {"peer_failure": 1}
+    assert len(snap["open"]) == 1
+    inc = snap["open"][0]
+    assert inc["kind"] == "peer_down"
+    assert inc["severity"] == "critical"
+    assert inc["peers"] == [3]
+
+
+def test_success_resets_hard_streak():
+    p = _plane()
+    p.observe_round(0, outcome=Outcome.REFUSED, peer=1)
+    p.observe_round(1, outcome=Outcome.SUCCESS, peer=1)
+    out = p.observe_round(2, outcome=Outcome.SHORT_READ, peer=1)
+    assert out["alerts"] == []  # streak restarted at 1
+    out = p.observe_round(3, outcome=Outcome.CORRUPT, peer=1)
+    assert out["alerts"] == ["peer_failure"]
+
+
+def test_trust_burst_respects_window():
+    p = _plane(incident_window=8)
+    p.observe_round(0, outcome=Outcome.UNTRUSTED, peer=2)
+    # Step 9: the step-0 rejection has aged out of the 8-round window.
+    out = p.observe_round(9, outcome=Outcome.POISONED, peer=2)
+    assert out["alerts"] == []
+    out = p.observe_round(10, outcome=Outcome.UNTRUSTED, peer=2)
+    assert out["alerts"] == ["trust_burst"]
+    inc = p.snapshot()["open"][0]
+    assert inc["kind"] == "byzantine" and inc["peers"] == [2]
+
+
+def test_straggler_alert_is_warning_severity():
+    p = _plane()
+    p.observe_round(0, outcome=Outcome.SLOW, peer=1)
+    out = p.observe_round(1, outcome=Outcome.BUSY, peer=1)
+    assert out["alerts"] == ["straggler"]
+    inc = p.snapshot()["open"][0]
+    assert inc["kind"] == "straggler" and inc["severity"] == "warning"
+
+
+def test_partition_event_implicates_cut_peers():
+    p = _plane()
+    out = p.observe_round(
+        5,
+        events=[{"event": "partition_entered", "component": [0, 1]}],
+        partition_state="degraded",
+    )
+    assert out["alerts"] == ["partition"] and out["opened"]
+    inc = p.snapshot()["open"][0]
+    assert inc["kind"] == "partition"
+    assert inc["severity"] == "critical"
+    assert inc["peers"] == [2, 3]  # the far side of the cut
+
+
+def test_partition_flap_fires_on_second_entry():
+    p = _plane(incident_window=8)
+    ev = {"event": "partition_entered", "component": [0, 1]}
+    out = p.observe_round(2, events=[ev])
+    assert out["alerts"] == ["partition"]
+    p.observe_round(5, events=[{"event": "partition_healed"}],
+                    partition_state="ok")
+    out = p.observe_round(8, events=[ev])
+    assert out["alerts"] == ["partition", "partition_flap"]
+
+
+def test_state_storm_counts_board_transitions():
+    p = _plane(incident_storm_threshold=3)
+    boards = [
+        {"peers": {1: {"state": "quarantined", "quarantines": 1},
+                   2: {"state": "healthy", "quarantines": 0}}},
+        {"peers": {1: {"state": "quarantined", "quarantines": 2},
+                   2: {"state": "quarantined", "quarantines": 1}}},
+    ]
+    out = p.observe_round(0, board=boards[0])
+    assert out["alerts"] == []  # one transition
+    out = p.observe_round(1, board=boards[1])
+    assert "state_storm" in out["alerts"]  # three inside the window
+    inc = p.snapshot()["open"][0]
+    assert inc["peers"] == [1, 2]
+
+
+def test_slo_burn_needs_warmup_and_consecutive_rounds():
+    p = _plane(incident_slo_warmup=4, incident_slo_rounds=2,
+               incident_slo_factor=4.0)
+    step = 0
+    for _ in range(4):  # baseline warmup at 10 ms rounds
+        out = p.observe_round(step, wall_s=0.01)
+        assert out["alerts"] == []
+        step += 1
+    out = p.observe_round(step, wall_s=0.1)  # burn 1 of 2
+    assert out["alerts"] == []
+    out = p.observe_round(step + 1, wall_s=0.1)
+    assert out["alerts"] == ["slo_burn"]
+    inc = p.snapshot()["open"][0]
+    assert inc["kind"] == "slo_burn" and inc["severity"] == "warning"
+
+
+def test_conv_stall_fires_on_plateau_not_on_convergence():
+    p = _plane(incident_stall_window=4)
+    for step in range(4):  # converging: rel_rms halves every round
+        out = p.observe_round(step, rel_rms=0.8 / (2 ** step))
+    assert p.snapshot()["alerts_total"] == {}
+    p2 = _plane(incident_stall_window=4)
+    fired = []
+    for step in range(6):  # plateau above the floor
+        fired += p2.observe_round(step, rel_rms=0.2)["alerts"]
+    assert fired == ["conv_stall"]  # rising edge only
+
+
+def test_stall_never_fires_below_rel_floor():
+    p = _plane(incident_stall_window=4, incident_stall_min_rel=0.05)
+    for step in range(8):
+        out = p.observe_round(step, rel_rms=0.01)  # converged plateau
+        assert out["alerts"] == []
+
+
+# ---------------------------------------------------------------------------
+# Correlator: one open incident, priority upgrade, sticky resolve gate
+# ---------------------------------------------------------------------------
+
+
+def test_priority_upgrade_keeps_one_incident():
+    p = _plane()
+    p.observe_round(0, outcome=Outcome.TIMEOUT, peer=3)
+    p.observe_round(1, outcome=Outcome.TIMEOUT, peer=3)
+    p.pop_records()
+    # The membership plane catches up: the same fault reclassifies the
+    # OPEN incident instead of opening a second one.
+    p.observe_round(
+        2,
+        events=[{"event": "partition_entered", "component": [0, 1]}],
+        partition_state="degraded",
+    )
+    recs = p.pop_records()
+    incs = [r for r in recs if r["record"] == "incident"]
+    assert [r["status"] for r in incs] == ["update"]
+    assert incs[0]["id"] == "0:1"  # same incident
+    assert incs[0]["kind"] == "partition"
+    snap = p.snapshot()
+    assert snap["opened_total"] == 1 and len(snap["open"]) == 1
+
+
+def test_kind_priority_order_matches_report_tool():
+    assert KIND_PRIORITY == incident_report.KIND_PRIORITY
+    assert set(k for _, k, _ in ALERT_KINDS.values()) <= set(KIND_PRIORITY)
+
+
+def test_resolve_waits_for_quiet_and_healthy_peers():
+    p = _plane(incident_resolve_after=4)
+    p.observe_round(0, outcome=Outcome.TIMEOUT, peer=3)
+    p.observe_round(1, outcome=Outcome.TIMEOUT, peer=3)
+    sick = {"peers": {3: {"state": "quarantined", "quarantines": 1}}}
+    # Quiet rounds, but the implicated peer is still quarantined: the
+    # sticky-state gate holds the incident open.
+    for step in range(2, 10):
+        p.observe_round(step, board=sick)
+    assert len(p.snapshot()["open"]) == 1
+    # Probe re-admission: a success clears the streak, the board goes
+    # healthy, and the quiet clock finally runs.
+    well = {"peers": {3: {"state": "healthy", "quarantines": 1}}}
+    p.observe_round(10, outcome=Outcome.SUCCESS, peer=3, board=well)
+    resolved_at = None
+    for step in range(11, 20):
+        p.observe_round(step, board=well)
+        recs = p.pop_records()
+        for r in recs:
+            if r.get("record") == "incident" and r["status"] == "resolved":
+                resolved_at = r["step"]
+    # Last evidence was the sticky board at step 9; the success at 10
+    # contributes no evidence, so the 4-round quiet clock lands at 13.
+    assert resolved_at == 13
+    snap = p.snapshot()
+    assert snap["open"] == [] and snap["resolved_total"] == 1
+    assert snap["closed"][0]["resolved_step"] == 13
+
+
+def test_clean_feed_emits_nothing():
+    p = _plane()
+    board = {"peers": {i: {"state": "healthy", "quarantines": 0}}
+             for i in (1, 2, 3)}
+    for step in range(40):
+        out = p.observe_round(
+            step,
+            outcome=Outcome.SUCCESS,
+            peer=1 + step % 3,
+            board=board,
+            rel_rms=0.5 / (1 + step),
+            wall_s=0.01,
+            partition_state="ok",
+        )
+        assert out == {"alerts": [], "opened": False}
+    snap = p.snapshot()
+    assert snap["opened_total"] == 0 and snap["alerts_total"] == {}
+    assert p.pop_records() == []
+
+
+def test_incident_jsonl_schema_and_me_substitution(tmp_path):
+    path = str(tmp_path / "inc-{me}.jsonl")
+    p = _plane(me=2, incident_path=path, incident_resolve_after=2)
+    p.observe_round(0, outcome=Outcome.TIMEOUT, peer=0)
+    p.observe_round(1, outcome=Outcome.TIMEOUT, peer=0)
+    p.observe_round(2, outcome=Outcome.SUCCESS, peer=0)
+    for step in range(3, 8):
+        p.observe_round(step)
+    p.close()
+    out = tmp_path / "inc-2.jsonl"
+    assert out.exists()
+    n, errors = schema_check.check_file(str(out))
+    assert errors == [] and n >= 3  # alert + open + resolved
+    kinds = [json.loads(line)["record"] for line in out.read_text().splitlines()]
+    assert "alert" in kinds and "incident" in kinds
+
+
+def test_register_metrics_renders_incident_families():
+    p = _plane()
+    p.observe_round(0, outcome=Outcome.TIMEOUT, peer=3)
+    p.observe_round(1, outcome=Outcome.TIMEOUT, peer=3)
+    reg = MetricsRegistry()
+    register_metrics(reg, p)
+    text = reg.render()
+    assert 'dpwa_alerts_total{kind="peer_failure"} 1' in text
+    assert "dpwa_incidents_opened_total 1" in text
+    assert "dpwa_incidents_open 1" in text
+    assert "dpwa_incident_severity 2" in text
+
+
+# ---------------------------------------------------------------------------
+# Flight recorder units
+# ---------------------------------------------------------------------------
+
+
+def test_flight_ring_is_bounded_and_dump_is_chronological(tmp_path):
+    rec = FlightRecorder(1, rounds=8, path=str(tmp_path / "f-{me}.jsonl"))
+    assert rec.path.endswith("f-1.jsonl")
+    for step in range(20):
+        rec.note_round(step, partner=step % 4, outcome="success",
+                       skipped_none=None)
+    path = rec.dump("test", step=19)
+    assert path == rec.path and os.path.exists(path)
+    lines = [json.loads(l) for l in open(path)]
+    meta, rounds = lines[0], lines[1:]
+    assert meta["kind"] == "meta" and meta["reason"] == "test"
+    assert meta["rounds"] == 8 and meta["step"] == 19
+    assert [r["step"] for r in rounds] == list(range(12, 20))
+    assert all("skipped_none" not in r for r in rounds)
+    n, errors = schema_check.check_file(path)
+    assert errors == [] and n == 9
+
+
+def test_flight_dump_empty_ring_returns_none(tmp_path):
+    rec = FlightRecorder(0, rounds=4, path=str(tmp_path / "f.jsonl"))
+    assert rec.dump("test") is None
+    assert not (tmp_path / "f.jsonl").exists()
+
+
+def test_flight_dump_coerces_non_json_values(tmp_path):
+    rec = FlightRecorder(0, rounds=4, path=str(tmp_path / "f.jsonl"))
+    rec.note_round(0, rel_rms=np.float32(0.25), nbytes=np.int64(4096))
+    assert rec.dump("test") is not None
+    row = json.loads((tmp_path / "f.jsonl").read_text().splitlines()[1])
+    assert row["rel_rms"] == pytest.approx(0.25)
+    assert float(row["nbytes"]) == 4096
+
+
+_CRASH_SCRIPT = """
+import os, signal, sys
+sys.path.insert(0, {root!r})
+from dpwa_tpu.obs.recorder import FlightRecorder
+rec = FlightRecorder(0, rounds=16, path={path!r})
+rec.arm_crash_dump()
+for step in range(10):
+    rec.note_round(step, outcome="success", partner=1)
+{die}
+"""
+
+
+def _run_crash(tmp_path, die):
+    path = str(tmp_path / "crash-flight.jsonl")
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         _CRASH_SCRIPT.format(root=os.path.abspath(_ROOT), path=path,
+                              die=die)],
+        capture_output=True, timeout=60,
+    )
+    return path, proc
+
+
+def test_sigterm_dumps_flight_ring(tmp_path):
+    path, proc = _run_crash(
+        tmp_path, "os.kill(os.getpid(), signal.SIGTERM)"
+    )
+    assert proc.returncode in (-signal.SIGTERM, 143), proc.stderr
+    lines = [json.loads(l) for l in open(path)]
+    assert lines[0]["reason"] == "sigterm"
+    assert len(lines) == 11  # meta + all 10 rounds
+
+
+def test_atexit_dumps_flight_ring(tmp_path):
+    path, proc = _run_crash(tmp_path, "")
+    assert proc.returncode == 0, proc.stderr
+    lines = [json.loads(l) for l in open(path)]
+    assert lines[0]["reason"] == "atexit" and len(lines) == 11
+
+
+# ---------------------------------------------------------------------------
+# Chaos-to-incident matrix (4-node soaks, lock-step)
+# ---------------------------------------------------------------------------
+
+
+def test_chaos_kill_maps_to_one_peer_down_incident(tmp_path):
+    victim, start = 2, 4
+    _soak(
+        tmp_path, steps=30,
+        schedule="ring", seed=2, timeout_ms=400,
+        health=dict(jitter_rounds=2),
+        chaos=dict(enabled=True, seed=5, down_windows=[(victim, start, 14)]),
+        obs=_obs(tmp_path),
+    )
+    _schemas_clean(tmp_path)
+    rep = _report(tmp_path)
+    assert len(rep["clusters"]) == 1, rep["clusters"]
+    c = rep["clusters"][0]
+    assert c["kind"] == "peer_down"
+    assert c["severity"] == "critical"
+    assert c["implicated_peers"] == [victim]
+    assert c["opened_step"] - start <= 3  # detection latency gate
+    fc = c["first_cause"]
+    assert fc["alert"] == "peer_failure" and fc["peers"] == [victim]
+    # The killed peer's own flight ring reconstructs the whole window.
+    flight = [
+        json.loads(l) for l in open(tmp_path / f"flight-{victim}.jsonl")
+    ]
+    steps = [r["step"] for r in flight if r["kind"] == "round"]
+    assert len(steps) >= 8
+    assert set(range(start, 14)) <= set(steps)
+    # An observer dumped at incident open AND at close (dump counter).
+    observer_meta = [
+        json.loads(open(p).readline())
+        for p in _artifacts(tmp_path)
+        if os.path.basename(p).startswith("flight-")
+        and f"flight-{victim}" not in p
+    ]
+    assert any(m["dumps"] >= 2 for m in observer_meta)
+
+
+def test_chaos_partition_maps_to_one_partition_incident(tmp_path):
+    start, stop = 6, 18
+    _soak(
+        tmp_path, steps=36,
+        schedule="ring", seed=3, timeout_ms=300,
+        health=dict(jitter_rounds=1, quarantine_base_rounds=2,
+                    quarantine_max_rounds=8),
+        chaos=dict(enabled=True, seed=3,
+                   partition_windows=(((0, 1), start, stop),)),
+        membership=dict(quorum_fraction=0.6),
+        obs=_obs(tmp_path),
+    )
+    _schemas_clean(tmp_path)
+    rep = _report(tmp_path)
+    assert len(rep["clusters"]) == 1, rep["clusters"]
+    c = rep["clusters"][0]
+    assert c["kind"] == "partition"
+    assert c["severity"] == "critical"
+    assert c["opened_step"] - start <= 3
+    # Both sides of the cut report, and the union of implicated peers
+    # covers the whole cut.
+    assert len(c["reporting_nodes"]) >= 2
+    assert set(c["implicated_peers"]) == {0, 1, 2, 3}
+    # At least one node's incident classified as partition outright.
+    assert any(
+        ni["kind"] == "partition" for ni in c["node_incidents"]
+    )
+
+
+def test_chaos_byzantine_maps_to_one_byzantine_incident(tmp_path):
+    attacker, attack_from = 1, 8
+    _soak(
+        tmp_path, steps=26, vec=1024,
+        schedule="ring", seed=3, timeout_ms=400,
+        trust=dict(window=16, min_window=4, amnesty_gap=0,
+                   amnesty_rounds=0),
+        chaos=dict(enabled=True, seed=17,
+                   byzantine_peers=(attacker,),
+                   byzantine_start_round=attack_from,
+                   byzantine_sign_probability=1.0),
+        obs=_obs(tmp_path),
+    )
+    _schemas_clean(tmp_path)
+    rep = _report(tmp_path)
+    assert len(rep["clusters"]) == 1, rep["clusters"]
+    c = rep["clusters"][0]
+    assert c["kind"] == "byzantine"
+    assert c["severity"] == "critical"
+    assert c["implicated_peers"] == [attacker]
+    assert c["opened_step"] - attack_from <= 3
+    assert c["first_cause"]["alert"] == "trust_burst"
+    assert c["first_cause"]["peers"] == [attacker]
+
+
+def test_chaos_straggler_maps_to_one_straggler_incident(tmp_path):
+    victim, start, stop = 2, 6, 22
+    _soak(
+        tmp_path, steps=30, vec=4096,
+        schedule="ring", seed=2, timeout_ms=400,
+        health=dict(jitter_rounds=2),
+        # min_ms=250 keeps warm fast-peer deadlines above loopback
+        # jitter (same rationale as tests/test_flowctl.py).
+        flowctl=dict(min_ms=250.0),
+        chaos=dict(enabled=True, seed=5,
+                   trickle_windows=[(victim, start, stop)],
+                   trickle_bytes_per_s=2048.0),
+        obs=_obs(tmp_path),
+    )
+    _schemas_clean(tmp_path)
+    rep = _report(tmp_path)
+    assert len(rep["clusters"]) == 1, rep["clusters"]
+    c = rep["clusters"][0]
+    assert c["kind"] == "straggler"
+    assert c["implicated_peers"] == [victim]
+    assert c["opened_step"] - start <= 3
+    assert c["first_cause"]["alert"] == "straggler"
+
+
+def test_clean_run_produces_zero_alerts_and_zero_incidents(tmp_path):
+    # Same length as the kill/straggler soaks, chaos off, sketch armed
+    # so the stall detector sees real (converging) rel_rms too.
+    _soak(
+        tmp_path, steps=30,
+        schedule="ring", seed=2, timeout_ms=2000,
+        health=dict(jitter_rounds=2),
+        obs=_obs(tmp_path, sketch=True),
+    )
+    recs = incident_report.load_records(_artifacts(tmp_path))
+    assert recs["alert"] == []
+    assert recs["incident"] == []
+    rep = incident_report.build_report(recs)
+    assert rep["clusters"] == []
+    # Flight rings still recorded every round on every node.
+    for node in rep["flight"]:
+        assert node["rounds"] >= 8 and node["reason"] == "close"
+
+
+# ---------------------------------------------------------------------------
+# Endpoint surface: /incidents, /flightdump, /metrics under concurrency
+# ---------------------------------------------------------------------------
+
+
+def test_endpoints_survive_concurrent_scrapes(tmp_path):
+    ts = _ring(
+        2, schedule="ring", timeout_ms=2000,
+        obs=dict(incidents=True, recorder=True, metrics=True, sketch=True,
+                 recorder_path=str(tmp_path / "flight-{me}.jsonl")),
+        health={"enabled": True, "healthz_port": 0},
+    )
+    try:
+        port = ts[0].healthz.port
+        stop = threading.Event()
+        errors = []
+
+        def check_incidents(raw):
+            doc = json.loads(raw)
+            assert {"open", "closed", "alerts_total"} <= set(doc)
+
+        def check_metrics(raw):
+            assert "dpwa_incidents_opened_total" in raw
+            assert "dpwa_incidents_open" in raw
+
+        def scrape(route, check):
+            while not stop.is_set():
+                try:
+                    raw = urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}{route}", timeout=5
+                    ).read().decode()
+                    check(raw)
+                except Exception as e:  # noqa: BLE001 - collected for assert
+                    errors.append((route, repr(e)))
+                    return
+
+        threads = [
+            threading.Thread(
+                target=scrape, args=("/incidents", check_incidents)
+            ),
+            threading.Thread(
+                target=scrape, args=("/metrics", check_metrics)
+            ),
+            threading.Thread(
+                target=scrape, args=("/incidents", check_incidents)
+            ),
+        ]
+        for th in threads:
+            th.start()
+        vecs = [np.ones(512, np.float32), np.ones(512, np.float32) * 2]
+        for step in range(16):
+            for i, t in enumerate(ts):
+                m, _a, _p = t.exchange(vecs[i], float(step), 0.1, step)
+                vecs[i] = np.asarray(m, np.float32)
+            time.sleep(0.01)
+        stop.set()
+        for th in threads:
+            th.join(timeout=10)
+        assert errors == []
+    finally:
+        _close(ts)
+
+
+def test_flightdump_route_writes_dump_on_demand(tmp_path):
+    ts = _ring(
+        2, schedule="ring", timeout_ms=2000,
+        obs=dict(incidents=True, recorder=True,
+                 recorder_path=str(tmp_path / "flight-{me}.jsonl")),
+        health={"enabled": True, "healthz_port": 0},
+    )
+    try:
+        vecs = [np.ones(256, np.float32), np.ones(256, np.float32) * 2]
+        for step in range(6):
+            for i, t in enumerate(ts):
+                m, _a, _p = t.exchange(vecs[i], float(step), 0.1, step)
+                vecs[i] = np.asarray(m, np.float32)
+        port = ts[0].healthz.port
+        doc = json.loads(
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/flightdump", timeout=5
+            ).read()
+        )
+        assert doc["dumped"] is True
+        assert os.path.exists(doc["path"])
+        lines = [json.loads(l) for l in open(doc["path"])]
+        assert lines[0]["reason"] == "endpoint"
+        assert len(lines) >= 6
+    finally:
+        _close(ts)
+
+
+def test_health_snapshot_carries_incident_view(tmp_path):
+    ts = _ring(2, schedule="ring", timeout_ms=2000,
+               obs=dict(incidents=True))
+    try:
+        vecs = [np.ones(128, np.float32)] * 2
+        for step in range(3):
+            for i, t in enumerate(ts):
+                t.exchange(vecs[i], float(step), 0.1, step)
+        snap = ts[0].health_snapshot()
+        assert "incidents" in snap
+        assert snap["incidents"]["me"] == 0
+        assert snap["incidents"]["opened_total"] == 0
+    finally:
+        _close(ts)
+
+
+# ---------------------------------------------------------------------------
+# tools/incident_report.py units
+# ---------------------------------------------------------------------------
+
+
+def _mk_incident(me, opened, last, kind="peer_down", status="resolved",
+                 peers=(3,)):
+    return {
+        "record": "incident", "id": f"{me}:{opened}", "me": me,
+        "status": status, "kind": kind, "severity": "critical",
+        "peers": list(peers), "alerts": 1, "opened_step": opened,
+        "step": last, "t": 1.0,
+        **({"resolved_step": last} if status == "resolved" else {}),
+    }
+
+
+def test_report_clusters_overlapping_windows_across_nodes():
+    incs = [
+        _mk_incident(0, 10, 20),
+        _mk_incident(1, 12, 22),  # overlaps: same fault, second vantage
+        _mk_incident(2, 40, 50),  # disjoint: a second fault
+    ]
+    clusters = incident_report.cluster_incidents(incs)
+    assert [len(c) for c in clusters] == [2, 1]
+
+
+def test_report_first_cause_picks_earliest_alert():
+    records = {
+        "alert": [
+            {"record": "alert", "kind": "peer_failure", "plane": "health",
+             "severity": "critical", "value": 2, "threshold": 2,
+             "peer": 3, "step": 11, "t": 1.0},
+            {"record": "alert", "kind": "partition", "plane": "membership",
+             "severity": "critical", "value": 2, "threshold": 0.6,
+             "peers": [2, 3], "step": 14, "t": 1.4},
+        ],
+        "incident": [
+            _mk_incident(0, 11, 24, kind="partition"),
+            _mk_incident(1, 14, 24, kind="partition"),
+        ],
+        "flight": [],
+    }
+    rep = incident_report.build_report(records)
+    assert len(rep["clusters"]) == 1
+    c = rep["clusters"][0]
+    assert c["kind"] == "partition"
+    fc = c["first_cause"]
+    assert fc["round"] == 11 and fc["alert"] == "peer_failure"
+    assert fc["plane"] == "health" and fc["peers"] == [3]
+
+
+def test_report_cli_json_roundtrip(tmp_path, capsys):
+    p = tmp_path / "inc-0.jsonl"
+    with open(p, "w") as fh:
+        fh.write(json.dumps(_mk_incident(0, 5, 9)) + "\n")
+    rc = incident_report.main(["--json", str(p)])
+    assert rc == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert len(doc["clusters"]) == 1
+    assert doc["clusters"][0]["kind"] == "peer_down"

@@ -540,6 +540,60 @@ def test_metrics_logger_unbounded_by_default(tmp_path):
     assert not os.path.exists(path + ".1")
 
 
+def test_metrics_logger_keep_cascade(tmp_path):
+    path = str(tmp_path / "metrics.jsonl")
+    with MetricsLogger(path=path, max_bytes=1000, keep=3) as ml:
+        for step in range(400):
+            ml.log(step, filler="x" * 40)
+    # The cascade holds exactly keep rolls plus the live file, newest
+    # first: .1 is the most recent roll, .3 the oldest survivor.
+    for suffix in ("", ".1", ".2", ".3"):
+        assert os.path.exists(path + suffix), suffix
+    assert not os.path.exists(path + ".4")
+    steps = []
+    for p in (path + ".3", path + ".2", path + ".1", path):
+        steps.extend(json.loads(l)["step"] for l in open(p))
+    assert steps == sorted(steps)  # contiguous across the cascade
+    assert steps[-1] == 399
+
+
+def test_metrics_logger_rotation_under_concurrent_writers(tmp_path):
+    """Training + Rx/healthz threads share one logger across rotations:
+    no torn lines, no dropped generations, every surviving line parses."""
+    import threading
+
+    path = str(tmp_path / "metrics.jsonl")
+    ml = MetricsLogger(path=path, max_bytes=4000, keep=3)
+    n_threads, n_each = 4, 300
+
+    def writer(tid):
+        for i in range(n_each):
+            ml.log(i, writer=tid, filler="z" * 30)
+
+    threads = [
+        threading.Thread(target=writer, args=(t,)) for t in range(n_threads)
+    ]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    ml.close()
+    files = [path + s for s in ("", ".1", ".2", ".3") if os.path.exists(path + s)]
+    assert len(files) >= 2  # it actually rotated under load
+    total = 0
+    for p in files:
+        for line in open(p):
+            rec = json.loads(line)  # raises on any torn line
+            assert rec["writer"] in range(n_threads)
+            total += 1
+    # Rotation may replace the oldest roll, so the floor is what the
+    # surviving cascade can hold — but nothing in it is torn or foreign,
+    # and the newest records always survive in the live file.
+    assert total > 0
+    last = [json.loads(l) for l in open(path)]
+    assert last and last[-1]["step"] == n_each - 1
+
+
 # ---------------------------------------------------------------------------
 # schema_check (satellite)
 # ---------------------------------------------------------------------------
@@ -632,6 +686,23 @@ def test_obs_config_validation_and_defaults():
         ObsConfig(sketch_k=MAX_SKETCH_VALUES + 1)
     with pytest.raises(ValueError):
         ObsConfig(log_max_bytes=-1)
+    # Incident-plane / recorder knobs (docs/incidents.md).
+    assert ObsConfig(incidents=True).enabled
+    assert ObsConfig(recorder=True).enabled
+    with pytest.raises(ValueError):
+        ObsConfig(log_keep=0)
+    with pytest.raises(ValueError):
+        ObsConfig(incident_fail_streak=0)
+    with pytest.raises(ValueError):
+        ObsConfig(incident_window=0)
+    with pytest.raises(ValueError):
+        ObsConfig(recorder_rounds=0)
+    with pytest.raises(ValueError):
+        ObsConfig(incident_stall_min_rel=-0.1)
+    with pytest.raises(ValueError):
+        ObsConfig(incident_stall_improve=1.0)
+    with pytest.raises(ValueError):
+        ObsConfig(incident_slo_factor=1.0)
 
 
 def test_obs_config_from_dict():

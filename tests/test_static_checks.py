@@ -1,0 +1,171 @@
+"""Tier-1 shim wiring the static observability checks into pytest.
+
+Two tools guard the JSONL contract (docs/incidents.md,
+docs/observability.md):
+
+- ``tools/schema_check.py`` — every record kind written anywhere has a
+  frozen schema, and any UNREGISTERED kind is an error (runtime half);
+- ``tools/lint_emitters.py`` — every emit SITE in the source tree uses
+  a registered record/event kind (static half).
+
+Running both here means adding a new record kind without registering
+its schema fails tier-1 instead of silently producing unvalidatable
+JSONL in the next soak run.
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+_ROOT = os.path.join(os.path.dirname(__file__), "..")
+sys.path.insert(0, _ROOT)
+
+from tools import lint_emitters, schema_check  # noqa: E402
+
+
+# ---------------------------------------------------------------------------
+# lint_emitters: the whole tree is clean
+# ---------------------------------------------------------------------------
+
+
+def test_tree_has_no_unregistered_emit_sites():
+    errors = lint_emitters.lint(
+        [
+            os.path.join(_ROOT, "dpwa_tpu"),
+            os.path.join(_ROOT, "tools"),
+            os.path.join(_ROOT, "bench.py"),
+        ]
+    )
+    assert errors == [], "\n".join(
+        f"{e['file']}:{e['line']}: {e['error']}" for e in errors
+    )
+
+
+def test_lint_catches_unregistered_record_kind(tmp_path):
+    bad = tmp_path / "bad_emitter.py"
+    bad.write_text(
+        'def emit(log):\n'
+        '    log.write({"record": "made_up_kind", "step": 1})\n'
+        '    log.log_event(1, "made_up_event")\n'
+    )
+    errors = lint_emitters.lint([str(bad)])
+    msgs = " ".join(e["error"] for e in errors)
+    assert len(errors) == 2
+    assert "made_up_kind" in msgs and "made_up_event" in msgs
+
+
+def test_lint_skips_dynamic_sites(tmp_path):
+    ok = tmp_path / "dynamic.py"
+    ok.write_text(
+        'def emit(log, fields):\n'
+        '    kind = fields.pop("event")\n'
+        '    log.log_event(1, kind, **fields)\n'
+        '    log.write({"record": fields["record"]})\n'
+    )
+    assert lint_emitters.lint([str(ok)]) == []
+
+
+def test_event_call_registry_matches_schema_check():
+    # The lint resolves its registries from schema_check — a drift
+    # between the two halves is impossible by construction; pin it.
+    assert lint_emitters.RECORD_KINDS is schema_check.RECORD_KINDS
+    assert lint_emitters.EVENT_KINDS is schema_check.EVENT_KINDS
+
+
+# ---------------------------------------------------------------------------
+# schema_check: every registered kind validates, anything else fails
+# ---------------------------------------------------------------------------
+
+
+def _valid_records():
+    return [
+        {"step": 1, "t": 0.1},
+        {"step": 1, "t": 0.1, "record": "event", "event": "rollback"},
+        {
+            "step": 1, "t": 0.1, "record": "alert", "kind": "peer_failure",
+            "severity": "critical", "plane": "health", "value": 2.0,
+            "threshold": 2.0, "peer": 3,
+        },
+        {
+            "step": 1, "t": 0.1, "record": "incident", "id": "0:1",
+            "status": "open", "kind": "peer_down", "severity": "critical",
+            "peers": [3], "alerts": 1, "opened_step": 1, "me": 0,
+        },
+        {
+            "record": "flight", "kind": "meta", "me": 0, "step": 9,
+            "t": 0.5, "reason": "incident", "rounds": 8, "dumps": 1,
+        },
+        {
+            "record": "flight", "kind": "round", "me": 0, "step": 9,
+            "t": 0.5, "partner": 1, "outcome": "refused",
+            "alerts": ["peer_failure"],
+        },
+        {"record": "bench", "t": 1.0, "merge_ms": 3.2},
+    ]
+
+
+@pytest.mark.parametrize("rec", _valid_records())
+def test_registered_kinds_validate(rec):
+    assert schema_check.check_record(rec) == []
+
+
+def test_unregistered_record_kind_fails():
+    errs = schema_check.check_record(
+        {"step": 1, "t": 0.1, "record": "surprise"}
+    )
+    assert errs and "unknown record kind" in errs[0]
+
+
+def test_unregistered_event_kind_fails():
+    errs = schema_check.check_record(
+        {"step": 1, "t": 0.1, "record": "event", "event": "surprise"}
+    )
+    assert any("unregistered event kind" in e for e in errs)
+
+
+def test_alert_and_incident_schemas_are_closed():
+    alert = {
+        "step": 1, "t": 0.1, "record": "alert", "kind": "trust_burst",
+        "severity": "critical", "plane": "trust", "value": 2.0,
+        "threshold": 2.0, "stray": 1,
+    }
+    errs = schema_check.check_record(alert)
+    assert any("unknown field 'stray'" in e for e in errs)
+    inc = {
+        "step": 1, "t": 0.1, "record": "incident", "id": "0:1",
+        "status": "open", "kind": "byzantine", "severity": "critical",
+        "peers": [2], "alerts": 1, "opened_step": 1, "me": 0,
+        "stray": True,
+    }
+    errs = schema_check.check_record(inc)
+    assert any("unknown field 'stray'" in e for e in errs)
+
+
+def test_flight_unknown_kind_fails():
+    errs = schema_check.check_record(
+        {"record": "flight", "kind": "mystery", "me": 0, "step": 1,
+         "t": 0.1}
+    )
+    assert errs and "unknown flight kind" in errs[0]
+
+
+def test_check_file_counts_errors(tmp_path):
+    path = tmp_path / "mixed.jsonl"
+    with open(path, "w") as fh:
+        for rec in _valid_records():
+            fh.write(json.dumps(rec) + "\n")
+        fh.write(json.dumps({"step": 1, "t": 0.1, "record": "nope"}) + "\n")
+    n, errors = schema_check.check_file(str(path))
+    assert n == len(_valid_records()) + 1
+    assert len(errors) == 1
+
+
+def test_cli_entrypoints(tmp_path):
+    path = tmp_path / "ok.jsonl"
+    with open(path, "w") as fh:
+        for rec in _valid_records():
+            fh.write(json.dumps(rec) + "\n")
+    assert schema_check.main([str(path)]) == 0
+    assert lint_emitters.main([str(tmp_path)]) == 0

@@ -1,0 +1,324 @@
+#!/usr/bin/env python
+"""Join per-node incident/alert/flight JSONL into a cross-peer timeline.
+
+Stdlib-only companion to the incident plane (``obs.incidents``,
+docs/incidents.md).  Feed it any mix of per-node incident JSONL streams
+(``record: "alert"`` / ``record: "incident"``) and flight-recorder
+dumps (``record: "flight"``); it:
+
+- **clusters** the per-node incidents into ring-wide incident clusters
+  — incidents whose ``[opened_step, resolved_step]`` windows overlap
+  (clock skew slack of a few rounds) describe ONE fault seen from
+  several vantage points, so "exactly one incident" is asserted at the
+  cluster level, not per node;
+- attributes a **first cause** per cluster: the earliest alert in the
+  cluster's window, reported as (peer, plane, round) — which peer was
+  implicated, which plane produced the evidence, and at which round it
+  first crossed a threshold;
+- classifies each cluster by the highest-priority incident kind any
+  member reported (the same root-cause order the in-process correlator
+  uses: partition > byzantine > peer_down > straggler > state_storm >
+  slo_burn > conv_stall);
+- prints a **round-by-round timeline** (``--rounds``) interleaving
+  every node's alerts, incident transitions, and — when flight dumps
+  are supplied — the recorded per-round outcomes around the fault.
+
+Usage::
+
+    python tools/incident_report.py node*.jsonl
+    python tools/incident_report.py --json node*.jsonl dpwa-flight-*.jsonl
+    python tools/incident_report.py --rounds 20 node*.jsonl
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, Iterable, List, Optional
+
+# Same root-cause order as dpwa_tpu/obs/incidents.py (kept in sync by
+# tests/test_incidents.py); duplicated here so the report stays
+# stdlib-only and usable on a box without the package installed.
+KIND_PRIORITY = (
+    "partition", "byzantine", "peer_down", "straggler",
+    "state_storm", "slo_burn", "conv_stall",
+)
+
+# Rounds of slack when overlapping per-node incident windows: nodes
+# notice the same fault a few rounds apart (detection latency).
+CLUSTER_SLACK = 4
+
+
+def _rank(kind: str) -> int:
+    try:
+        return KIND_PRIORITY.index(kind)
+    except ValueError:
+        return len(KIND_PRIORITY)
+
+
+def load_records(paths: Iterable[str]) -> Dict[str, List[dict]]:
+    """Parse every file into kind-bucketed record lists."""
+    out: Dict[str, List[dict]] = {
+        "alert": [], "incident": [], "flight": [],
+    }
+    for path in paths:
+        with open(path, "r", encoding="utf-8") as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                kind = rec.get("record")
+                if kind in out:
+                    rec["_file"] = path
+                    out[kind].append(rec)
+    return out
+
+
+def _fold_incidents(incidents: List[dict]) -> List[dict]:
+    """One entry per incident id: the last lifecycle record wins, the
+    open record pins the window start."""
+    by_id: Dict[str, dict] = {}
+    for rec in sorted(incidents, key=lambda r: r.get("step", 0)):
+        iid = rec.get("id")
+        if iid is None:
+            continue
+        cur = by_id.setdefault(iid, dict(rec))
+        cur.update(
+            {
+                k: rec[k]
+                for k in (
+                    "status", "kind", "severity", "peers", "alerts",
+                    "resolved_step",
+                )
+                if k in rec
+            }
+        )
+        cur["last_step"] = rec.get("step", cur.get("step", 0))
+    return list(by_id.values())
+
+
+def _window(inc: dict) -> tuple:
+    start = inc.get("opened_step", inc.get("step", 0))
+    end = inc.get("resolved_step", inc.get("last_step", start))
+    return start, max(start, end)
+
+
+def cluster_incidents(incidents: List[dict]) -> List[List[dict]]:
+    """Group per-node incidents whose windows overlap (with slack)."""
+    folded = sorted(_fold_incidents(incidents), key=_window)
+    clusters: List[List[dict]] = []
+    cluster_end: Optional[int] = None
+    for inc in folded:
+        start, end = _window(inc)
+        if cluster_end is not None and start <= cluster_end + CLUSTER_SLACK:
+            clusters[-1].append(inc)
+            cluster_end = max(cluster_end, end)
+        else:
+            clusters.append([inc])
+            cluster_end = end
+    return clusters
+
+
+def _first_cause(cluster: List[dict], alerts: List[dict]) -> dict:
+    """Earliest alert inside the cluster window: (peer, plane, round)."""
+    start = min(_window(i)[0] for i in cluster)
+    end = max(_window(i)[1] for i in cluster)
+    window_alerts = [
+        a for a in alerts
+        if start - CLUSTER_SLACK <= a.get("step", 0) <= end
+    ]
+    if not window_alerts:
+        return {}
+    first = min(window_alerts, key=lambda a: (a.get("step", 0), _rank(
+        a.get("kind", "")
+    )))
+    peers = first.get("peers") or (
+        [first["peer"]] if "peer" in first else []
+    )
+    return {
+        "round": first.get("step"),
+        "plane": first.get("plane"),
+        "alert": first.get("kind"),
+        "peers": peers,
+    }
+
+
+def build_report(records: Dict[str, List[dict]]) -> Dict[str, Any]:
+    alerts = sorted(records["alert"], key=lambda r: r.get("step", 0))
+    clusters = cluster_incidents(records["incident"])
+    out_clusters = []
+    for cluster in clusters:
+        start = min(_window(i)[0] for i in cluster)
+        end = max(_window(i)[1] for i in cluster)
+        kind = min(
+            (i.get("kind", "") for i in cluster), key=_rank
+        )
+        peers = sorted(
+            {p for i in cluster for p in (i.get("peers") or [])}
+        )
+        nodes = sorted({i.get("me") for i in cluster if "me" in i})
+        resolved = all(
+            i.get("status") == "resolved" for i in cluster
+        )
+        out_clusters.append(
+            {
+                "kind": kind,
+                "severity": (
+                    "critical"
+                    if any(
+                        i.get("severity") == "critical" for i in cluster
+                    )
+                    else "warning"
+                ),
+                "opened_step": start,
+                "last_step": end,
+                "resolved": resolved,
+                "implicated_peers": peers,
+                "reporting_nodes": nodes,
+                "node_incidents": [
+                    {
+                        "id": i.get("id"),
+                        "me": i.get("me"),
+                        "kind": i.get("kind"),
+                        "status": i.get("status"),
+                        "opened_step": i.get("opened_step"),
+                    }
+                    for i in cluster
+                ],
+                "first_cause": _first_cause(cluster, alerts),
+            }
+        )
+    flight_nodes: Dict[int, dict] = {}
+    for rec in records["flight"]:
+        me = rec.get("me")
+        node = flight_nodes.setdefault(
+            me, {"me": me, "rounds": 0, "first_step": None,
+                 "last_step": None, "reason": None}
+        )
+        if rec.get("kind") == "meta":
+            node["reason"] = rec.get("reason")
+        else:
+            node["rounds"] += 1
+            s = rec.get("step", 0)
+            if node["first_step"] is None or s < node["first_step"]:
+                node["first_step"] = s
+            if node["last_step"] is None or s > node["last_step"]:
+                node["last_step"] = s
+    return {
+        "alerts": len(alerts),
+        "alert_kinds": sorted({a.get("kind") for a in alerts}),
+        "clusters": out_clusters,
+        "flight": sorted(
+            flight_nodes.values(), key=lambda n: (n["me"] is None, n["me"])
+        ),
+    }
+
+
+def _timeline(records: Dict[str, List[dict]], max_rounds: int) -> List[str]:
+    lines: List[str] = []
+    events: List[tuple] = []
+    for a in records["alert"]:
+        who = a.get("peers") or ([a["peer"]] if "peer" in a else [])
+        events.append(
+            (a.get("step", 0), f"alert {a.get('kind')} "
+             f"plane={a.get('plane')} peers={who} "
+             f"value={a.get('value')} [{a.get('_file')}]")
+        )
+    for i in records["incident"]:
+        events.append(
+            (i.get("step", 0), f"incident {i.get('status')} "
+             f"{i.get('kind')} id={i.get('id')} peers={i.get('peers')}")
+        )
+    for f in records["flight"]:
+        if f.get("kind") == "round" and f.get("outcome") not in (
+            None, "success"
+        ):
+            events.append(
+                (f.get("step", 0), f"flight me={f.get('me')} "
+                 f"partner={f.get('partner')} outcome={f.get('outcome')}")
+            )
+    events.sort(key=lambda e: e[0])
+    steps_seen: List[int] = []
+    for step, desc in events:
+        if step not in steps_seen:
+            steps_seen.append(step)
+            if max_rounds and len(steps_seen) > max_rounds:
+                lines.append("  ... (truncated)")
+                break
+        lines.append(f"  round {step:>5}: {desc}")
+    return lines
+
+
+def print_report(
+    rep: Dict[str, Any],
+    records: Optional[Dict[str, List[dict]]] = None,
+    max_rounds: int = 0,
+) -> None:
+    print(f"alerts: {rep['alerts']} ({', '.join(rep['alert_kinds'])})"
+          if rep["alerts"] else "alerts: 0")
+    print(f"incident clusters: {len(rep['clusters'])}")
+    for i, c in enumerate(rep["clusters"]):
+        fc = c["first_cause"]
+        cause = (
+            f"first cause: round {fc.get('round')} plane "
+            f"{fc.get('plane')} alert {fc.get('alert')} peers "
+            f"{fc.get('peers')}"
+            if fc
+            else "first cause: (no alerts in window)"
+        )
+        print(
+            f"  [{i}] {c['kind']} ({c['severity']}) rounds "
+            f"{c['opened_step']}..{c['last_step']} "
+            f"{'resolved' if c['resolved'] else 'OPEN'} — implicates "
+            f"peers {c['implicated_peers']} — seen by nodes "
+            f"{c['reporting_nodes']}"
+        )
+        print(f"      {cause}")
+    if rep["flight"]:
+        print("flight dumps:")
+        for n in rep["flight"]:
+            print(
+                f"  node {n['me']}: {n['rounds']} rounds "
+                f"({n['first_step']}..{n['last_step']}), "
+                f"reason={n['reason']}"
+            )
+    if max_rounds and records is not None:
+        print("timeline:")
+        for line in _timeline(records, max_rounds):
+            print(line)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Join per-node incident/alert/flight JSONL into a "
+        "cross-peer incident timeline with first-cause attribution."
+    )
+    ap.add_argument(
+        "paths", nargs="+",
+        help="incident JSONL streams and/or flight dumps",
+    )
+    ap.add_argument(
+        "--json", action="store_true", help="machine-readable output"
+    )
+    ap.add_argument(
+        "--rounds", type=int, default=0,
+        help="print a round-by-round timeline (max N distinct rounds)",
+    )
+    args = ap.parse_args(argv)
+    records = load_records(args.paths)
+    rep = build_report(records)
+    if args.json:
+        json.dump(rep, sys.stdout, indent=2)
+        print()
+    else:
+        print_report(rep, records, max_rounds=args.rounds)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -16,11 +16,25 @@ schemas:
 - ``record: "trace"``, ``kind: "round" | "serve"`` — the obs plane's
   round/serve spans (docs/observability.md);
 - ``record: "event"`` — control-plane events: ``step``/``t``/``event``
-  are pinned, evidence fields are free-form by design (each event kind
-  carries its own);
+  are pinned, the ``event`` kind must be registered in
+  :data:`EVENT_KINDS`, evidence fields are free-form by design (each
+  event kind carries its own);
+- ``record: "alert"`` / ``record: "incident"`` — the incident plane's
+  detector alerts and correlated incident lifecycle records
+  (docs/incidents.md), both closed-world;
+- ``record: "flight"``, ``kind: "meta" | "round"`` — the flight
+  recorder's post-mortem dump header and per-round ring entries;
+- ``record: "bench"`` — bench.py's cumulative history entries
+  (``artifacts/bench_history.jsonl``): the envelope is pinned, the
+  result payload is bench-leg-defined;
 - records with no ``record`` key — per-step exchange/training records
   (``MetricsLogger.log`` / ``log_exchange``): ``step`` and ``t`` are
   pinned, the rest is adapter-defined.
+
+Any other ``record`` kind is an error — a new emitter must register
+its schema here (tools/lint_emitters.py statically enforces the same
+registry over the source tree; tests/test_static_checks.py wires both
+into tier-1).
 
 Unknown fields in a pinned schema, missing required fields, and
 mistyped pinned fields are errors; the exit code is the error count
@@ -146,10 +160,112 @@ _EVENT_REQUIRED: Dict[str, tuple] = {
     "event": (str,),
 }
 
+_ALERT_REQUIRED: Dict[str, tuple] = {
+    "step": (int,),
+    "t": _NUM,
+    "record": (str,),
+    "kind": (str,),
+    "severity": (str,),
+    "plane": (str,),
+    "value": _NUM,
+    "threshold": _NUM,
+}
+_ALERT_OPTIONAL: Dict[str, tuple] = {
+    "peer": (int,),
+    "peers": (list,),
+    "window": (int,),
+}
+
+_INCIDENT_REQUIRED: Dict[str, tuple] = {
+    "step": (int,),
+    "t": _NUM,
+    "record": (str,),
+    "id": (str,),
+    "status": (str,),
+    "kind": (str,),
+    "severity": (str,),
+    "peers": (list,),
+    "alerts": (int,),
+    "opened_step": (int,),
+    "me": (int,),
+}
+_INCIDENT_OPTIONAL: Dict[str, tuple] = {
+    "resolved_step": (int,),
+}
+
+_FLIGHT_META_REQUIRED: Dict[str, tuple] = {
+    "record": (str,),
+    "kind": (str,),
+    "me": (int,),
+    "step": (int,),
+    "t": _NUM,
+    "reason": (str,),
+    "rounds": (int,),
+    "dumps": (int,),
+}
+
+_FLIGHT_ROUND_REQUIRED: Dict[str, tuple] = {
+    "record": (str,),
+    "kind": (str,),
+    "me": (int,),
+    "step": (int,),
+    "t": _NUM,
+}
+_FLIGHT_ROUND_OPTIONAL: Dict[str, tuple] = {
+    "partner": (int,),
+    "sched_partner": (int,),
+    "remapped": (bool,),
+    "outcome": (str,),
+    "codec": (str,),
+    "trust": (dict,),
+    "latency_s": _NUM,
+    "nbytes": (int,),
+    "rel_rms": _NUM,
+    "wall_s": _NUM,
+    "partition_state": (str,),
+    "events": (list,),
+    "alerts": (list,),
+}
+
+# Bench history entries carry no step (one per RUN, not per round);
+# the result payload is bench-leg-defined by design.
+_BENCH_REQUIRED: Dict[str, tuple] = {
+    "t": _NUM,
+    "record": (str,),
+}
+
 _EXCHANGE_REQUIRED: Dict[str, tuple] = {
     "step": (int,),
     "t": _NUM,
 }
+
+# The registry tools/lint_emitters.py checks emit sites against: every
+# ``record`` kind and every ``event`` kind the tree may write.  A new
+# emitter extends these IN THE SAME CHANGE that adds its schema above.
+RECORD_KINDS = frozenset(
+    {
+        "health", "trace", "event", "alert", "incident", "flight",
+        "bench",
+    }
+)
+EVENT_KINDS = frozenset(
+    {
+        # recovery / bootstrap (PR 2)
+        "bootstrap", "bootstrap_failed", "rollback", "resync",
+        "resync_advised",
+        # supervisor lifecycle (tools/supervisor.py)
+        "spawn", "crashed", "exited", "gave_up", "restart_scheduled",
+        "unhealthy",
+        # membership (PR 3)
+        "refutation", "peer_refuted", "component_changed",
+        "partition_entered", "partition_healed",
+        "partition_reconciled", "partition_reconcile_failed",
+        "partition_reconcile_rejected",
+        # trust (PR 4)
+        "trust_amnesty", "trust_clock_reset", "trust_collapsed",
+        "trust_recovered",
+    }
+)
 
 
 def _check_fields(
@@ -237,8 +353,32 @@ def check_record(rec: dict) -> List[str]:
         return [f"unknown trace kind {tkind!r}"]
     if kind == "event":
         # Evidence fields are free-form by design; only the envelope is
-        # pinned.
-        return _check_fields(rec, _EVENT_REQUIRED)
+        # pinned — but the kind itself must be registered.
+        errs = _check_fields(rec, _EVENT_REQUIRED)
+        ev = rec.get("event")
+        if isinstance(ev, str) and ev not in EVENT_KINDS:
+            errs.append(f"unregistered event kind {ev!r}")
+        return errs
+    if kind == "alert":
+        return _check_fields(
+            rec, _ALERT_REQUIRED, _ALERT_OPTIONAL, closed=True
+        )
+    if kind == "incident":
+        return _check_fields(
+            rec, _INCIDENT_REQUIRED, _INCIDENT_OPTIONAL, closed=True
+        )
+    if kind == "flight":
+        fkind = rec.get("kind")
+        if fkind == "meta":
+            return _check_fields(rec, _FLIGHT_META_REQUIRED, closed=True)
+        if fkind == "round":
+            return _check_fields(
+                rec, _FLIGHT_ROUND_REQUIRED, _FLIGHT_ROUND_OPTIONAL,
+                closed=True,
+            )
+        return [f"unknown flight kind {fkind!r}"]
+    if kind == "bench":
+        return _check_fields(rec, _BENCH_REQUIRED)
     if kind is None:
         return _check_fields(rec, _EXCHANGE_REQUIRED)
     return [f"unknown record kind {kind!r}"]
