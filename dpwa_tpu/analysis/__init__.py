@@ -1,0 +1,61 @@
+"""dpwalint — the repo's own static-analysis framework.
+
+Five checkers over one shared core (``tools/dpwalint.py`` is the CLI,
+``tests/test_static_checks.py`` the tier-1 gate):
+
+- :mod:`.lock_discipline` — cross-thread ``self._*`` state must be
+  locked, ``guarded_by``-annotated, or a registered double-buffer;
+- :mod:`.determinism` — decision paths stay replica-identical (no
+  ambient randomness / wall-clock branches / dict-order iteration) and
+  threefry tags come from :mod:`dpwa_tpu.utils.tags`;
+- :mod:`.wire_protocol` — wire magics and struct layouts live only in
+  :mod:`dpwa_tpu.parallel.protocol_constants`;
+- :mod:`.config_keys` — config reads, the schema, and the docs agree;
+- :mod:`.emit_kinds` — JSONL emit sites use registered kinds (the old
+  ``tools/lint_emitters.py`` pass, folded in).
+"""
+
+from __future__ import annotations
+
+from dpwa_tpu.analysis.config_keys import ConfigKeysChecker
+from dpwa_tpu.analysis.core import (
+    Finding,
+    RunResult,
+    SourceFile,
+    iter_py_files,
+    load_baseline,
+    load_files,
+    run_checkers,
+    save_baseline,
+)
+from dpwa_tpu.analysis.determinism import DeterminismChecker
+from dpwa_tpu.analysis.emit_kinds import EmitKindsChecker
+from dpwa_tpu.analysis.lock_discipline import LockDisciplineChecker
+from dpwa_tpu.analysis.rules import RULE_DESCRIPTIONS, RULE_IDS
+from dpwa_tpu.analysis.wire_protocol import WireProtocolChecker
+
+
+def all_checkers():
+    """Fresh instances of every checker, in reporting order."""
+    return [
+        LockDisciplineChecker(),
+        DeterminismChecker(),
+        WireProtocolChecker(),
+        ConfigKeysChecker(),
+        EmitKindsChecker(),
+    ]
+
+
+__all__ = [
+    "Finding",
+    "RunResult",
+    "SourceFile",
+    "RULE_DESCRIPTIONS",
+    "RULE_IDS",
+    "all_checkers",
+    "iter_py_files",
+    "load_baseline",
+    "load_files",
+    "run_checkers",
+    "save_baseline",
+]

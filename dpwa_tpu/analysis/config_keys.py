@@ -1,0 +1,221 @@
+"""Config-key coverage checker.
+
+``dpwa_tpu/config.py`` is the schema: frozen dataclasses, one per YAML
+block, every field validated in ``__post_init__``.  This checker keeps
+the three surfaces that mention a key — code reads, the schema, and the
+operator docs — from drifting apart:
+
+- ``config-unknown-key``: an attribute chain shaped like
+  ``config.<block>.<field>`` (base named ``config``/``cfg``) must name a
+  real field (or property) of that block's dataclass.  A typo'd read
+  (``config.trust.windw``) otherwise raises only on the config path that
+  exercises it.
+- ``config-undocumented-key``: every schema field must appear in the
+  operator-facing docs (``docs/*.md``, ``README.md``, or the schema
+  docstring in config.py itself — which mirrors the full YAML layout).
+- ``config-unparsed-block``: every block field of ``DpwaConfig`` must be
+  named in ``config_from_dict`` — a block that is never popped from the
+  YAML mapping silently swallows user configuration.
+"""
+
+from __future__ import annotations
+
+import ast
+import glob
+import os
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from dpwa_tpu.analysis.core import Finding, SourceFile
+
+CONFIG_PATH_SUFFIX = "dpwa_tpu/config.py"
+
+# attribute-chain bases that mean "this is the DpwaConfig object"
+_CONFIG_BASES = {"config", "cfg", "_config", "_cfg", "dpwa_config"}
+
+
+def _norm(p: str) -> str:
+    return p.replace("\\", "/")
+
+
+def _ann_name(ann: Optional[ast.expr]) -> Optional[str]:
+    if isinstance(ann, ast.Name):
+        return ann.id
+    if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+        return ann.value.split("|")[0].strip()
+    return None
+
+
+class _Schema:
+    """Block map extracted from config.py's AST."""
+
+    def __init__(self, src: SourceFile):
+        self.src = src
+        # block name -> dataclass name (from DpwaConfig's fields)
+        self.blocks: Dict[str, str] = {}
+        # dataclass name -> {field: def line} (AnnAssign fields only)
+        self.fields: Dict[str, Dict[str, int]] = {}
+        # dataclass name -> readable non-field names (properties, methods)
+        self.readables: Dict[str, Set[str]] = {}
+        self.parsed_block_names: Set[str] = set()
+        if src.tree is None:
+            return
+        for node in src.tree.body:
+            if isinstance(node, ast.ClassDef):
+                self._scan_class(node)
+            if (
+                isinstance(node, ast.FunctionDef)
+                and node.name == "config_from_dict"
+            ):
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Constant) and isinstance(
+                        sub.value, str
+                    ):
+                        self.parsed_block_names.add(sub.value)
+
+    def _scan_class(self, node: ast.ClassDef) -> None:
+        fields: Dict[str, int] = {}
+        readable: Set[str] = set()
+        for child in node.body:
+            if isinstance(child, ast.AnnAssign) and isinstance(
+                child.target, ast.Name
+            ):
+                fields[child.target.id] = child.lineno
+                if node.name == "DpwaConfig":
+                    cls = _ann_name(child.annotation)
+                    if cls and cls.endswith("Config"):
+                        self.blocks[child.target.id] = cls
+            elif isinstance(child, ast.FunctionDef):
+                readable.add(child.name)
+        self.fields[node.name] = fields
+        self.readables[node.name] = readable
+
+
+def _doc_text(config_path: str) -> str:
+    """README.md + docs/*.md + the config.py schema docstring."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(config_path)))
+    chunks: List[str] = []
+    for p in [os.path.join(root, "README.md")] + sorted(
+        glob.glob(os.path.join(root, "docs", "*.md"))
+    ):
+        try:
+            with open(p, "r", encoding="utf-8") as fh:
+                chunks.append(fh.read())
+        except OSError:
+            pass
+    return "\n".join(chunks)
+
+
+class ConfigKeysChecker:
+    name = "config-keys"
+    rules = (
+        "config-unknown-key",
+        "config-undocumented-key",
+        "config-unparsed-block",
+    )
+
+    def check(self, files: Sequence[SourceFile]) -> List[Finding]:
+        config_src = next(
+            (
+                f for f in files
+                if _norm(f.path).endswith(CONFIG_PATH_SUFFIX)
+            ),
+            None,
+        )
+        if config_src is None or config_src.tree is None:
+            return []
+        schema = _Schema(config_src)
+        out: List[Finding] = []
+        out.extend(self._unparsed_blocks(config_src, schema))
+        out.extend(self._undocumented(config_src, schema))
+        for src in files:
+            if src.tree is None:
+                continue
+            out.extend(self._unknown_keys(src, schema))
+        return out
+
+    # --- config-unparsed-block ---
+
+    def _unparsed_blocks(
+        self, src: SourceFile, schema: _Schema
+    ) -> List[Finding]:
+        out = []
+        for block, cls in sorted(schema.blocks.items()):
+            if block not in schema.parsed_block_names:
+                out.append(Finding(
+                    "config-unparsed-block", src.path, 1, block,
+                    f"DpwaConfig.{block} ({cls}) is never named in "
+                    "config_from_dict — YAML under that block is "
+                    "silently dropped",
+                ))
+        return out
+
+    # --- config-undocumented-key ---
+
+    def _undocumented(
+        self, src: SourceFile, schema: _Schema
+    ) -> List[Finding]:
+        docs = _doc_text(src.path)
+        # the module docstring mirrors the YAML schema; it counts too
+        docstring = ast.get_docstring(src.tree) or ""
+        haystack = docs + "\n" + docstring
+        out = []
+        for block, cls in sorted(schema.blocks.items()):
+            for field, line in sorted(schema.fields.get(cls, {}).items()):
+                if not re.search(rf"\b{re.escape(field)}\b", haystack):
+                    out.append(Finding(
+                        "config-undocumented-key", src.path, line,
+                        f"{block}.{field}",
+                        f"schema field {block}.{field} appears in no "
+                        "operator doc (README.md, docs/*.md, or the "
+                        "config.py schema docstring)",
+                    ))
+        return out
+
+    # --- config-unknown-key ---
+
+    def _unknown_keys(
+        self, src: SourceFile, schema: _Schema
+    ) -> List[Finding]:
+        out = []
+        for node in ast.walk(src.tree):
+            hit = self._config_chain(node, schema)
+            if hit is None:
+                continue
+            block, field, cls = hit
+            known = set(schema.fields.get(cls, {})) | schema.readables.get(
+                cls, set()
+            )
+            if field not in known:
+                out.append(Finding(
+                    "config-unknown-key", src.path, node.lineno,
+                    f"{block}.{field}",
+                    f"read of config.{block}.{field} but {cls} has no "
+                    f"field/property {field!r} — typo, or add it to the "
+                    "schema in dpwa_tpu/config.py",
+                ))
+        return out
+
+    @staticmethod
+    def _config_chain(
+        node: ast.AST, schema: _Schema
+    ) -> Optional[Tuple[str, str, str]]:
+        """Match ``<config-ish base>.<block>.<field>`` -> tuple."""
+        if not isinstance(node, ast.Attribute):
+            return None
+        field = node.attr
+        blk = node.value
+        if not isinstance(blk, ast.Attribute):
+            return None
+        block = blk.attr
+        if block not in schema.blocks:
+            return None
+        base = blk.value
+        base_name = None
+        if isinstance(base, ast.Name):
+            base_name = base.id
+        elif isinstance(base, ast.Attribute):
+            base_name = base.attr
+        if base_name not in _CONFIG_BASES:
+            return None
+        return block, field, schema.blocks[block]

@@ -1,0 +1,102 @@
+"""Emit-kind checker: JSONL emit sites use registered record/event kinds.
+
+This is the former ``tools/lint_emitters.py`` pass folded into the
+dpwalint framework (one runner, one suppression grammar, one baseline).
+``tools/schema_check.py`` validates JSONL files AFTER a run; this pass
+closes the other half of the loop at the SOURCE level — every site a
+record can be born must name a kind registered in schema_check:
+
+- dict literals with a ``"record"``/``"event"`` key holding a string
+  literal;
+- ``record="..."`` / ``event="..."`` keyword arguments in any call;
+- ``log_event(step, "<kind>", ...)`` / ``self._event("<kind>", ...)``
+  calls, where the first string-literal positional is the kind.
+
+Dynamic kinds (variables, f-strings) are skipped: they are re-emission
+plumbing, and the records they forward were checked at their literal
+birth site.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Sequence
+
+from dpwa_tpu.analysis.core import Finding, SourceFile
+
+# call names whose first string-literal positional argument is an event
+# kind (self._event("kind", ...), metrics.log_event(step, "kind", ...))
+_EVENT_CALLS = ("log_event", "_event")
+
+
+def _kind_sets():
+    # imported lazily so the analysis package never needs tools/ on the
+    # path at import time (the runner and tests both arrange it)
+    from tools.schema_check import EVENT_KINDS, RECORD_KINDS
+    return RECORD_KINDS, EVENT_KINDS
+
+
+def _str_const(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+class EmitKindsChecker:
+    name = "emit-kinds"
+    rules = ("emit-kind",)
+
+    def check(self, files: Sequence[SourceFile]) -> List[Finding]:
+        record_kinds, event_kinds = _kind_sets()
+        out: List[Finding] = []
+
+        def check_record(src, node, kind):
+            if kind not in record_kinds:
+                out.append(Finding(
+                    "emit-kind", src.path, node.lineno, f"record:{kind}",
+                    f"unregistered record kind {kind!r} (register a "
+                    "schema in tools/schema_check.py)",
+                ))
+
+        def check_event(src, node, kind):
+            if kind not in event_kinds:
+                out.append(Finding(
+                    "emit-kind", src.path, node.lineno, f"event:{kind}",
+                    f"unregistered event kind {kind!r} (add it to "
+                    "schema_check.EVENT_KINDS)",
+                ))
+
+        for src in files:
+            if src.tree is None:
+                continue
+            for node in ast.walk(src.tree):
+                if isinstance(node, ast.Dict):
+                    for key, value in zip(node.keys, node.values):
+                        k = _str_const(key) if key is not None else None
+                        v = _str_const(value) if value is not None else None
+                        if v is None:
+                            continue
+                        if k == "record":
+                            check_record(src, value, v)
+                        elif k == "event":
+                            check_event(src, value, v)
+                elif isinstance(node, ast.Call):
+                    for kw in node.keywords:
+                        v = _str_const(kw.value)
+                        if v is None:
+                            continue
+                        if kw.arg == "record":
+                            check_record(src, kw.value, v)
+                        elif kw.arg == "event":
+                            check_event(src, kw.value, v)
+                    fn = node.func
+                    name = fn.attr if isinstance(fn, ast.Attribute) else (
+                        fn.id if isinstance(fn, ast.Name) else None
+                    )
+                    if name in _EVENT_CALLS:
+                        for arg in node.args:
+                            v = _str_const(arg)
+                            if v is not None:
+                                check_event(src, arg, v)
+                                break  # first string literal is the kind
+        return out

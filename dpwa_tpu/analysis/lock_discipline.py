@@ -1,0 +1,338 @@
+"""Lock-discipline checker: cross-thread ``self._*`` state needs a lock.
+
+Per class, per module (the unit the reactor refactor will rewrite):
+
+1. Classify functions into THREAD DOMAINS.  ``threading.Thread(target=
+   self._m)`` / ``target=<nested def>`` marks the target as a spawned
+   root; a ``# dpwalint: thread_root(domain)`` annotation on a ``def``
+   marks an entry the call graph cannot see (a cross-object hook like
+   the transport's fetch running on an overlap daemon, or a snapshot
+   served by the healthz thread).  Public methods and dunders seed the
+   ``main`` domain.  Domains flow along the intra-class call graph
+   (``self.m()`` edges) to a fixpoint.
+2. Collect every ``self.<attr>`` access with its lexical ``with
+   self.<lock>:`` context (or a ``guarded_by`` annotation standing in
+   for one).
+3. An attribute is SHARED when it is accessed from two distinct domains
+   and stored outside ``__init__``; every non-``__init__`` access of a
+   shared attribute must then be guarded — by one consistent lock — or
+   the attribute registered ``double_buffered`` with a reason.
+
+Attributes that are themselves synchronization objects (locks, events,
+threads, queues) are exempt: they exist to be touched cross-thread.
+Init-only attributes are exempt: ``Thread.start()`` publishes them.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from dpwa_tpu.analysis.core import Finding, SourceFile
+
+MAIN_DOMAIN = "main"
+
+_SYNC_FACTORIES = {
+    "Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore",
+    "Event", "Barrier", "Thread", "Timer", "Queue", "SimpleQueue",
+    "LifoQueue", "PriorityQueue", "local",
+}
+
+
+@dataclasses.dataclass
+class _Access:
+    attr: str
+    line: int
+    is_store: bool
+    lock: Optional[str]  # lock name this access is guarded by
+    unit: str  # qualified function name within the class
+
+
+@dataclasses.dataclass
+class _Unit:
+    """One function body: a method or a function nested inside one."""
+
+    name: str
+    node: ast.AST
+    def_line: int
+    calls: Set[str] = dataclasses.field(default_factory=set)
+    accesses: List[_Access] = dataclasses.field(default_factory=list)
+    domains: Set[str] = dataclasses.field(default_factory=set)
+
+
+def _lock_name(expr: ast.expr) -> Optional[str]:
+    """``self._lock`` in a with-item -> ``_lock``."""
+    if (
+        isinstance(expr, ast.Attribute)
+        and isinstance(expr.value, ast.Name)
+        and expr.value.id == "self"
+    ):
+        return expr.attr
+    return None
+
+
+def _is_thread_ctor(func: ast.expr) -> bool:
+    if isinstance(func, ast.Attribute) and func.attr == "Thread":
+        return True
+    return isinstance(func, ast.Name) and func.id == "Thread"
+
+
+def _is_sync_ctor(value: ast.expr) -> bool:
+    """True when the assigned value constructs a sync primitive."""
+    if not isinstance(value, ast.Call):
+        return False
+    f = value.func
+    name = f.attr if isinstance(f, ast.Attribute) else (
+        f.id if isinstance(f, ast.Name) else None
+    )
+    return name in _SYNC_FACTORIES
+
+
+class _FunctionWalker(ast.NodeVisitor):
+    """Walks one function body, tracking the lexical with-lock stack,
+    self-attribute accesses, self-method calls, thread spawns, and
+    nested function definitions (which become their own units)."""
+
+    def __init__(self, checker: "_ClassAnalysis", unit: _Unit,
+                 default_lock: Optional[str]):
+        self.c = checker
+        self.unit = unit
+        self.lock_stack: List[str] = []
+        self.default_lock = default_lock
+
+    def _current_lock(self, line: int) -> Optional[str]:
+        ann_lock = self.c.src.annotations.guarded_by.get(line)
+        if ann_lock is not None:
+            return ann_lock.removeprefix("self.")
+        if self.lock_stack:
+            return self.lock_stack[-1]
+        return self.default_lock
+
+    def visit_With(self, node: ast.With) -> None:
+        pushed = 0
+        for item in node.items:
+            ln = _lock_name(item.context_expr)
+            if ln is not None:
+                self.lock_stack.append(ln)
+                pushed += 1
+            # the with-expression itself reads the lock attr; skip it
+        for stmt in node.body:
+            self.visit(stmt)
+        for _ in range(pushed):
+            self.lock_stack.pop()
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        if isinstance(node.value, ast.Name) and node.value.id == "self":
+            self.unit.accesses.append(_Access(
+                attr=node.attr,
+                line=node.lineno,
+                is_store=isinstance(node.ctx, (ast.Store, ast.Del)),
+                lock=self._current_lock(node.lineno),
+                unit=self.unit.name,
+            ))
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        f = node.func
+        if (
+            isinstance(f, ast.Attribute)
+            and isinstance(f.value, ast.Name)
+            and f.value.id == "self"
+        ):
+            self.unit.calls.add(f.attr)
+        if isinstance(f, ast.Name):
+            # possible call of a nested function in this scope
+            self.unit.calls.add("::" + f.id)
+        if _is_thread_ctor(f):
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    self.c.note_spawn(self.unit, kw.value)
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self.c.add_unit(node, parent=self.unit)
+
+    visit_AsyncFunctionDef = visit_FunctionDef  # type: ignore[assignment]
+
+
+class _ClassAnalysis:
+    """Full analysis of one ClassDef."""
+
+    def __init__(self, src: SourceFile, node: ast.ClassDef):
+        self.src = src
+        self.node = node
+        self.units: Dict[str, _Unit] = {}
+        self.locks: Set[str] = set()
+        self.sync_attrs: Set[str] = set()
+        self.spawns: List[Tuple[str, str]] = []  # (spawning unit, target)
+        self.pending_spawn_names: List[Tuple[_Unit, str]] = []
+        self.double_buffered: Dict[str, str] = {}
+        # double_buffered annotations inside this class's line span
+        end = getattr(node, "end_lineno", None) or node.lineno
+        for ln, (attr, reason) in src.annotations.double_buffered.items():
+            if node.lineno <= ln <= end:
+                self.double_buffered[attr] = reason
+        for child in node.body:
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.add_unit(child, parent=None)
+
+    def add_unit(self, node, parent: Optional[_Unit]) -> None:
+        name = node.name if parent is None else (
+            parent.name + "." + node.name
+        )
+        unit = _Unit(name=name, node=node, def_line=node.lineno)
+        self.units[name] = unit
+        # resolve spawns that referenced this nested function by name
+        default_lock = None
+        probes = [node.lineno, node.lineno - 1]
+        if node.decorator_list:
+            probes.append(node.decorator_list[0].lineno - 1)
+        for probe in probes:
+            ann_lock = self.src.annotations.guarded_by.get(probe)
+            if ann_lock is not None:
+                default_lock = ann_lock.removeprefix("self.")
+                break
+        walker = _FunctionWalker(self, unit, default_lock)
+        for stmt in node.body:
+            walker.visit(stmt)
+        # collect lock declarations / sync attrs from assignments
+        for acc_stmt in ast.walk(node):
+            if isinstance(acc_stmt, ast.Assign) and _is_sync_ctor(
+                acc_stmt.value
+            ):
+                for tgt in acc_stmt.targets:
+                    ln = _lock_name(tgt)
+                    if ln is not None:
+                        self.sync_attrs.add(ln)
+                        self.locks.add(ln)
+        # thread_root annotation on the def line (or the line above it)
+        for probe in (node.lineno, node.lineno - 1):
+            dom = self.src.annotations.thread_roots.get(probe)
+            if dom is not None:
+                unit.domains.add(dom)
+                break
+
+    def note_spawn(self, unit: _Unit, target: ast.expr) -> None:
+        tname = _lock_name(target)  # self.<method> form
+        if tname is not None:
+            self.spawns.append((unit.name, tname))
+        elif isinstance(target, ast.Name):
+            # nested function spawned by local name: unit scope prefix
+            self.spawns.append((unit.name, unit.name + "." + target.id))
+
+    def _seed_domains(self) -> None:
+        for name, unit in self.units.items():
+            base = name.split(".")[0]
+            method = self.units.get(base)
+            is_public = not base.startswith("_") or (
+                base.startswith("__") and base.endswith("__")
+            )
+            if name == base and is_public and method is not None:
+                unit.domains.add(MAIN_DOMAIN)
+        for _, target in self.spawns:
+            unit = self.units.get(target)
+            if unit is not None:
+                unit.domains.add("spawned:" + target)
+
+    def _propagate(self) -> None:
+        changed = True
+        while changed:
+            changed = False
+            for unit in self.units.values():
+                for callee in unit.calls:
+                    if callee.startswith("::"):
+                        target = self.units.get(
+                            unit.name + "." + callee[2:]
+                        )
+                    else:
+                        target = self.units.get(callee)
+                    if target is None:
+                        continue
+                    missing = unit.domains - target.domains
+                    if missing:
+                        target.domains.update(missing)
+                        changed = True
+
+    def findings(self) -> List[Finding]:
+        self._seed_domains()
+        self._propagate()
+        # attr -> (domains, has store outside __init__, accesses)
+        per_attr: Dict[str, List[_Access]] = {}
+        method_names = {n for n in self.units if "." not in n}
+        for unit in self.units.values():
+            if not unit.domains:
+                continue  # unreached private helper: no evidence
+            for acc in unit.accesses:
+                if acc.attr in method_names:
+                    continue  # method reference, not state
+                per_attr.setdefault(acc.attr, []).append(acc)
+        out: List[Finding] = []
+        for attr, accesses in sorted(per_attr.items()):
+            if attr in self.sync_attrs:
+                continue
+            domains: Set[str] = set()
+            for acc in accesses:
+                domains.update(self.units[acc.unit].domains)
+            if len(domains) < 2:
+                continue
+            stores_outside_init = [
+                a for a in accesses
+                if a.is_store and a.unit.split(".")[0] != "__init__"
+            ]
+            if not stores_outside_init:
+                continue  # effectively write-once; Thread.start publishes
+            if attr in self.double_buffered:
+                continue
+            judged = [
+                a for a in accesses if a.unit.split(".")[0] != "__init__"
+            ]
+            unguarded = [a for a in judged if a.lock is None]
+            locks_used = {a.lock for a in judged if a.lock is not None}
+            bogus = locks_used - self.locks
+            if unguarded or len(locks_used) > 1 or bogus:
+                first = min(
+                    unguarded or judged, key=lambda a: a.line
+                )
+                detail = []
+                if unguarded:
+                    detail.append(
+                        "unguarded at line(s) "
+                        + ", ".join(str(a.line) for a in unguarded[:6])
+                    )
+                if len(locks_used) > 1:
+                    detail.append(
+                        f"guarded by MULTIPLE locks {sorted(locks_used)}"
+                    )
+                if bogus:
+                    detail.append(
+                        f"guarded_by names undeclared lock(s) "
+                        f"{sorted(bogus)}"
+                    )
+                out.append(Finding(
+                    "lock-discipline",
+                    self.src.path,
+                    first.line,
+                    f"{self.node.name}.{attr}",
+                    f"self.{attr} is shared across thread domains "
+                    f"{sorted(domains)} and stored outside __init__; "
+                    + "; ".join(detail)
+                    + " — hold a declared lock, annotate guarded_by, or "
+                    "register double_buffered with a reason",
+                ))
+        return out
+
+
+class LockDisciplineChecker:
+    name = "lock-discipline"
+    rules = ("lock-discipline",)
+
+    def check(self, files: Sequence[SourceFile]) -> List[Finding]:
+        out: List[Finding] = []
+        for src in files:
+            if src.tree is None:
+                continue
+            for node in ast.walk(src.tree):
+                if isinstance(node, ast.ClassDef):
+                    out.extend(_ClassAnalysis(src, node).findings())
+        return out

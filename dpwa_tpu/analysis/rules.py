@@ -1,0 +1,69 @@
+"""The frozen rule-id list — ``schema_check.py`` discipline for lint.
+
+Every rule a checker can emit is enumerated here, and
+``tests/test_static_checks.py`` pins this set: renaming or deleting a
+rule (which would silently orphan that rule's suppressions and baseline
+entries across the tree) is an explicit, reviewed act, exactly like
+changing a JSONL record schema.
+"""
+
+from __future__ import annotations
+
+# rule id -> one-line description (docs/static-analysis.md mirrors this).
+RULE_DESCRIPTIONS = {
+    # lock-discipline checker
+    "lock-discipline": (
+        "self._* state reachable from two thread domains must be "
+        "accessed under a declared lock, a guarded_by annotation, or a "
+        "registered double-buffer"
+    ),
+    # determinism checker
+    "det-random": (
+        "no unseeded random.* / np.random.* in merge/partner/trust "
+        "decision paths"
+    ),
+    "det-time": (
+        "no wall-clock (time.time/monotonic/perf_counter) inside a "
+        "branch condition or comparison on a decision path"
+    ),
+    "det-dict-order": (
+        "no bare dict-order iteration (.items/.keys/.values) in a "
+        "decision path — wrap in sorted() or justify"
+    ),
+    "det-tag-literal": (
+        "threefry control-tag arguments must come from "
+        "dpwa_tpu/utils/tags.py, never raw int literals"
+    ),
+    # wire-protocol checker
+    "wire-magic": (
+        "frame magics (b'DPW…'/b'DPS…') may only be defined in "
+        "dpwa_tpu/parallel/protocol_constants.py"
+    ),
+    "wire-struct": (
+        "struct formats on the wire path must come from "
+        "protocol_constants, never inline literals"
+    ),
+    # config-key checker
+    "config-unknown-key": (
+        "config.<block>.<field> reads must name a schema field of that "
+        "block's dataclass"
+    ),
+    "config-undocumented-key": (
+        "every schema field must be mentioned in docs/*.md or README.md"
+    ),
+    "config-unparsed-block": (
+        "every DpwaConfig block must be parsed by config_from_dict"
+    ),
+    # emit-kind checker (the folded-in lint_emitters pass)
+    "emit-kind": (
+        "record=/event= emit sites must use kinds registered in "
+        "tools/schema_check.py"
+    ),
+    # the framework's own hygiene rule
+    "dpwalint-annotation": (
+        "dpwalint directives must be well-formed, with reasons where "
+        "required; files must parse"
+    ),
+}
+
+RULE_IDS = frozenset(RULE_DESCRIPTIONS)

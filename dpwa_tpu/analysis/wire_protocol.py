@@ -1,0 +1,103 @@
+"""Wire-protocol freeze checker.
+
+Two peers built from different commits still have to interoperate, so
+every on-wire constant lives in exactly one reviewed place —
+``dpwa_tpu/parallel/protocol_constants.py`` — with its back-compat
+notes.  This checker makes scattering structurally impossible:
+
+- ``wire-magic``: a ``bytes`` literal starting with ``DPW``/``DPS``
+  (the frame-magic namespaces) anywhere outside the registry is an
+  error.  Tests may spell magics out deliberately (to prove the
+  registry matches the wire) with an inline ignore.
+- ``wire-struct``: in wire-path modules, ``struct.pack/unpack/Struct``
+  with an inline format literal is an error — formats are layout
+  contracts and belong next to their magic in the registry.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Sequence
+
+from dpwa_tpu.analysis.core import Finding, SourceFile
+
+REGISTRY_PATH = "dpwa_tpu/parallel/protocol_constants.py"
+
+# dpwalint: ignore[wire-magic] -- the checker's own prefix table, not a frame magic
+_MAGIC_PREFIXES = (b"DPW", b"DPS")
+
+# modules that read or write frames: inline struct formats banned here
+_WIRE_PATH_MARKERS = (
+    "parallel/tcp.py",
+    "obs/wire.py",
+    "membership/digest.py",
+    "recovery/state_transfer.py",
+    "health/chaos.py",
+    "parallel/protocol_constants.py",
+)
+
+_STRUCT_FNS = {"pack", "unpack", "pack_into", "unpack_from",
+               "calcsize", "iter_unpack", "Struct"}
+
+
+def _norm(path: str) -> str:
+    return path.replace("\\", "/")
+
+
+class WireProtocolChecker:
+    name = "wire-protocol"
+    rules = ("wire-magic", "wire-struct")
+
+    def check(self, files: Sequence[SourceFile]) -> List[Finding]:
+        out: List[Finding] = []
+        for src in files:
+            if src.tree is None:
+                continue
+            is_registry = _norm(src.path).endswith(REGISTRY_PATH)
+            on_wire_path = any(
+                m in _norm(src.path) for m in _WIRE_PATH_MARKERS
+            )
+            for node in ast.walk(src.tree):
+                if (
+                    not is_registry
+                    and isinstance(node, ast.Constant)
+                    and isinstance(node.value, bytes)
+                    and node.value.startswith(_MAGIC_PREFIXES)
+                ):
+                    out.append(Finding(
+                        "wire-magic", src.path, node.lineno,
+                        repr(node.value),
+                        f"wire magic {node.value!r} spelled outside "
+                        f"{REGISTRY_PATH} — import the registered "
+                        "constant so back-compat notes travel with it",
+                    ))
+                if (
+                    on_wire_path
+                    and not is_registry
+                    and isinstance(node, ast.Call)
+                ):
+                    fmt = self._inline_struct_format(node)
+                    if fmt is not None:
+                        out.append(Finding(
+                            "wire-struct", src.path, node.lineno, fmt,
+                            f"inline struct format {fmt!r} on the wire "
+                            f"path — define it in {REGISTRY_PATH} next "
+                            "to its frame magic",
+                        ))
+        return out
+
+    @staticmethod
+    def _inline_struct_format(node: ast.Call) -> Optional[str]:
+        fn = node.func
+        name = fn.attr if isinstance(fn, ast.Attribute) else (
+            fn.id if isinstance(fn, ast.Name) else None
+        )
+        if name not in _STRUCT_FNS:
+            return None
+        # struct.pack("<I", ...) / struct.Struct("<I") with a literal fmt
+        if node.args and isinstance(node.args[0], ast.Constant) and (
+            isinstance(node.args[0].value, (str, bytes))
+        ):
+            v = node.args[0].value
+            return v if isinstance(v, str) else v.decode("ascii", "replace")
+        return None

@@ -73,6 +73,7 @@ class AdmissionController:
         )
         return tokens
 
+    # dpwalint: thread_root(rx)
     def admit(self, host: str) -> Tuple[bool, int]:
         """Try to admit one connection from ``host``.
 

@@ -63,6 +63,7 @@ class DeadlineEstimator:
             }
         return c
 
+    # dpwalint: thread_root(fetch)
     def observe(
         self,
         peer: int,

@@ -51,29 +51,23 @@ import numpy as np
 
 from dpwa_tpu.config import ChaosConfig
 from dpwa_tpu.parallel.schedules import chaos_draw
-
-# Fault-kind indices onto the chaos_draw tag space (CHAOS_TAG_BASE + k).
-_KIND_DROP = 0
-_KIND_DELAY = 1
-_KIND_THROTTLE = 2
-_KIND_TRUNCATE = 3
-_KIND_CORRUPT = 4
-# Drawn partitions: kind 5 decides whether a time block is split (drawn
-# once per block, peer key 0); kind 6 assigns each peer a side.
-_KIND_PARTITION = 5
-_KIND_PARTITION_SIDE = 6
-# Byzantine content faults (served frame stays wire-valid; only the
-# vector content lies — see byzantine_frame).
-_KIND_BYZ_SIGN = 7
-_KIND_BYZ_SCALE = 8
-_KIND_BYZ_REPLAY = 9
-_KIND_BYZ_ZERO = 10
-# Flowctl shaping (slow-peer chaos): kind 11 decides whether this
-# (round, peer) stalls mid-frame, kind 12 draws the stall length as a
-# fraction of ``stall_ms_max`` — both independent of the wire-fault
-# draws, so a trickled peer can ALSO stall, like a real overloaded box.
-_KIND_STALL = 11
-_KIND_STALL_LEN = 12
+# Fault-kind indices onto the chaos_draw tag space (CHAOS_TAG_BASE + k)
+# are allocated in the central tag registry — collision = import error.
+from dpwa_tpu.utils.tags import (
+    CHAOS_KIND_BYZ_REPLAY as _KIND_BYZ_REPLAY,
+    CHAOS_KIND_BYZ_SCALE as _KIND_BYZ_SCALE,
+    CHAOS_KIND_BYZ_SIGN as _KIND_BYZ_SIGN,
+    CHAOS_KIND_BYZ_ZERO as _KIND_BYZ_ZERO,
+    CHAOS_KIND_CORRUPT as _KIND_CORRUPT,
+    CHAOS_KIND_DELAY as _KIND_DELAY,
+    CHAOS_KIND_DROP as _KIND_DROP,
+    CHAOS_KIND_PARTITION as _KIND_PARTITION,
+    CHAOS_KIND_PARTITION_SIDE as _KIND_PARTITION_SIDE,
+    CHAOS_KIND_STALL as _KIND_STALL,
+    CHAOS_KIND_STALL_LEN as _KIND_STALL_LEN,
+    CHAOS_KIND_THROTTLE as _KIND_THROTTLE,
+    CHAOS_KIND_TRUNCATE as _KIND_TRUNCATE,
+)
 # Priority order when several draws fire in one round: exactly one fault
 # kind applies per (round, peer) so injected behavior stays analyzable.
 _PRIORITY = (

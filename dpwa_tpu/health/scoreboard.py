@@ -97,6 +97,7 @@ class Scoreboard:
     # Outcome ingestion
     # ------------------------------------------------------------------
 
+    # dpwalint: thread_root(fetch)
     def record(
         self,
         peer: int,
@@ -295,7 +296,9 @@ class Scoreboard:
     # Internals
     # ------------------------------------------------------------------
 
+    # dpwalint: guarded_by(_lock)
     def _clock(self, round: Optional[int]) -> int:
+        """Advance/read the fallback round clock (callers hold _lock)."""
         if round is not None and round > self._round:
             self._round = int(round)
         return self._round

@@ -24,10 +24,14 @@ again if the peer returns.
 from __future__ import annotations
 
 import dataclasses
-import struct
 from typing import Dict, Iterator, Optional, Tuple
 
-DIGEST_MAGIC = b"DPWM"
+# Magic + layouts come from the wire-constant registry (one source of
+# truth for the protocol; see BACK_COMPAT["digest_trailer_optional"]
+# there for the version-gated compatibility story).
+from dpwa_tpu.parallel import protocol_constants as _pc
+
+DIGEST_MAGIC = _pc.DIGEST_MAGIC
 DIGEST_VERSION = 1
 
 # Severity-ordered member states (merge rule: same incarnation -> the
@@ -39,13 +43,13 @@ DEAD = 3
 
 STATE_NAMES = ("alive", "suspect", "quarantined", "dead")
 
-_DIGEST_HDR = struct.Struct("<4sBHIH")  # magic, version, origin, round, n
-_ENTRY = struct.Struct("<HBIf")  # peer, state, incarnation, suspicion
+_DIGEST_HDR = _pc.DIGEST_HDR  # magic, version, origin, round, n
+_ENTRY = _pc.DIGEST_ENTRY  # peer, state, incarnation, suspicion
 
 # Upper bound a receiver will buffer for one digest body; far above any
 # real ring (65535 peers × 11 B ≈ 700 KiB) but finite, so a corrupt
 # length field cannot make the reader allocate unboundedly.
-MAX_DIGEST_BYTES = 1 << 20
+MAX_DIGEST_BYTES = _pc.MAX_DIGEST_BYTES
 
 # Wire-reader helpers (dpwa_tpu/parallel/tcp.py): the trailing-section
 # read is two-phase — fixed header first, then the entry block the
@@ -93,7 +97,9 @@ class Digest:
     version: int = DIGEST_VERSION
 
     def items(self) -> Iterator[Tuple[int, MemberEntry]]:
-        return iter(self.entries.items())
+        # Sorted so consumers that fold entries into decisions see the
+        # same order on every node regardless of decode insertion order.
+        return iter(sorted(self.entries.items()))
 
 
 def encode_digest(digest: Digest) -> bytes:

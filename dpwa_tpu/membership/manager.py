@@ -240,7 +240,7 @@ class MembershipManager:
             # Peers that dropped out again, or aged out, leave the pool.
             self._returned_pending = {
                 p: r
-                for p, r in self._returned_pending.items()
+                for p, r in sorted(self._returned_pending.items())
                 if p in component and int(step) - r <= RETURN_WINDOW_ROUNDS
             }
             degraded = (

@@ -70,14 +70,25 @@ class MetricsLogger:
         self.keep = max(1, int(keep))
         self._t0 = time.perf_counter()
         self._pending = None
+        # Guards the _pending handoff: log_exchange (training thread)
+        # parks the deferred record, while ANY logging point — including
+        # log_event from an Rx/healthz thread — may pop it.  Without the
+        # lock two concurrent poppers could both pass the None check and
+        # write the record twice.  Separate from _write_lock because
+        # flush() re-enters log() → _write() and the locks are
+        # non-reentrant.
+        self._pending_lock = threading.Lock()
         # Serializes writers: the training thread and any Rx/healthz
         # thread logging events through the same logger must not
         # interleave mid-rotation (torn lines, double-rolls).
         self._write_lock = threading.Lock()
         self._atexit = atexit.register(self.flush)
 
+    # dpwalint: guarded_by(_write_lock)
     def _rotate(self) -> None:
-        """Roll ``<path>`` into the ``.1`` … ``.keep`` cascade."""
+        """Roll ``<path>`` into the ``.1`` … ``.keep`` cascade.
+
+        Only ever called from ``_write`` with ``_write_lock`` held."""
         try:
             self._file.close()
             for i in range(self.keep - 1, 0, -1):
@@ -116,8 +127,7 @@ class MetricsLogger:
         # Keep file order == production order: a deferred exchange record
         # from an earlier step must land before this one.  (flush() pops
         # _pending before re-entering log(), so this never recurses.)
-        if self._pending is not None:
-            self.flush()
+        self.flush()
         rec: dict[str, Any] = {
             "step": int(step),
             "t": round(
@@ -163,14 +173,15 @@ class MetricsLogger:
             if hasattr(arr, "copy_to_host_async"):
                 arr.copy_to_host_async()
         self.flush()
-        self._pending = (
-            step,
-            self.elapsed() if t is None else t,
-            losses,
-            info,
-            payload_bytes,
-            extra,
-        )
+        with self._pending_lock:
+            self._pending = (
+                step,
+                self.elapsed() if t is None else t,
+                losses,
+                info,
+                payload_bytes,
+                extra,
+            )
 
     def log_health(
         self, step: int, snapshot: Mapping[str, Any], **extra: Any
@@ -305,6 +316,7 @@ class MetricsLogger:
             **extra,
         )
 
+    # dpwalint: thread_root(rx)
     def log_event(self, step: int, event: str, **fields: Any) -> None:
         """One recovery/control-plane event record, written immediately.
 
@@ -314,8 +326,7 @@ class MetricsLogger:
         ``tools/health_report.py`` summarizes.  The record carries
         ``record: "event"`` and ``event: <kind>`` so downstream tooling
         can fold all kinds with one filter."""
-        if self._pending is not None:
-            self.flush()
+        self.flush()
         rec: dict[str, Any] = {
             "step": int(step),
             "t": round(time.perf_counter() - self._t0, 4),
@@ -328,10 +339,11 @@ class MetricsLogger:
 
     def flush(self) -> None:
         """Write the deferred record, if any (blocks only on its arrays)."""
-        if self._pending is None:
+        with self._pending_lock:
+            pending, self._pending = self._pending, None
+        if pending is None:
             return
-        step, t, losses, info, payload_bytes, extra = self._pending
-        self._pending = None
+        step, t, losses, info, payload_bytes, extra = pending
         alpha = np.asarray(info.alpha)
         part = np.asarray(info.participated)
         self.log(

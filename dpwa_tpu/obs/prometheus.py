@@ -109,6 +109,7 @@ class MetricsRegistry:
                 continue
         return fams
 
+    # dpwalint: thread_root(healthz)
     def render(self) -> str:
         """Prometheus text exposition format 0.0.4."""
         lines: List[str] = []

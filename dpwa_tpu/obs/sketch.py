@@ -36,10 +36,13 @@ from typing import Dict, Optional
 
 import numpy as np
 
-# Control-draw tag claimed from the 9..15 free range documented in
-# dpwa_tpu/parallel/schedules.py (0..8 taken by participation, fault,
-# fallback, backoff, donor, relay, heal, and degrade-shed draws).
-SKETCH_TAG = 9
+from dpwa_tpu.utils import tags as _tags
+
+# Control-draw tag allocated in the central registry (tag 9):
+# dpwa_tpu/utils/tags.py holds the full map (0..8 taken by
+# participation, fault, fallback, backoff, donor, relay, heal, and
+# degrade-shed draws).
+SKETCH_TAG = _tags.TAG_SKETCH
 
 _sign_lock = threading.Lock()
 _sign_cache: Dict[tuple, np.ndarray] = {}
@@ -120,6 +123,7 @@ class SketchBoard:
             self._local = sketch
             self._local_seq = int(seq)
 
+    # dpwalint: thread_root(fetch)
     def note_remote(
         self,
         origin: int,

@@ -122,6 +122,7 @@ class Tracer:
 
     # -- serve side (Rx connection threads) --------------------------------
 
+    # dpwalint: thread_root(rx)
     def note_serve(self, trace_id: str, nbytes: int, dur_s: float) -> None:
         """One span per served frame, stamped with the frame's trace id.
 

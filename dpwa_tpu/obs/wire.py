@@ -28,21 +28,25 @@ tracing is enabled.
 from __future__ import annotations
 
 import dataclasses
-import struct
 from typing import Optional
 
 import numpy as np
 
-OBS_MAGIC = b"DPWT"
+# Magic + layout come from the wire-constant registry (one source of
+# truth for the protocol; see its BACK_COMPAT ledger for why the DPWT
+# section must ride AFTER the DPWM digest).
+from dpwa_tpu.parallel import protocol_constants as _pc
+
+OBS_MAGIC = _pc.OBS_MAGIC
 OBS_VERSION = 1
 
-_OBS_HDR = struct.Struct("<4sBHIfH")  # magic, version, origin, seq, norm, n
+_OBS_HDR = _pc.OBS_HDR  # magic, version, origin, seq, norm, n
 
 OBS_HEADER_SIZE = _OBS_HDR.size
 
 # A sketch is ~64 floats by design; anything past this is a corrupt or
 # hostile length field, not a bigger sketch.
-MAX_SKETCH_VALUES = 4096
+MAX_SKETCH_VALUES = _pc.MAX_SKETCH_VALUES
 
 
 def header_sketch_count(header: bytes) -> Optional[int]:

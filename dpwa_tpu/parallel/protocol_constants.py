@@ -1,0 +1,222 @@
+"""Single registry of every constant that appears on the gossip wire.
+
+Frame magics, struct layouts, payload codes, outcome tables, and size
+clamps all live HERE and only here: the wire protocol is a compatibility
+contract between peers running different builds, so its constants must
+be impossible to fork by editing one call site.  ``dpwalint``'s
+wire-protocol checker rejects any ``b"DPW…"`` literal or struct format
+string that appears on the wire path outside this module, and
+registering the same magic twice raises at import time.
+
+This module also carries the back-compat ledger that used to be buried
+in comments next to the literals — see the notes on each constant and
+:data:`BACK_COMPAT`.  It imports nothing from the rest of the package
+(stdlib ``struct`` only), so every plane can depend on it without
+cycles.
+
+Request dispatch: a client's first write is a 5-byte request magic; the
+Rx server reads exactly 5 bytes and dispatches on them, which is why all
+request magics share one length.  Response frames lead with a 4-byte
+magic inside a fixed struct header.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Dict, Tuple
+
+_MAGIC_REGISTRY: Dict[bytes, str] = {}
+
+
+def _magic(name: str, value: bytes) -> bytes:
+    """Register a frame magic; collision (or prefix reuse) = error."""
+    if value in _MAGIC_REGISTRY:
+        raise ValueError(
+            "wire magic collision: %r already registered as %r, cannot"
+            " also register %r" % (value, _MAGIC_REGISTRY[value], name)
+        )
+    _MAGIC_REGISTRY[value] = name
+    return value
+
+
+# --- request magics (5 bytes: first — and for relay, only — client write) ---
+# Gossip blob fetch: response is BLOB_HDR + payload (+ optional trailers).
+BLOB_REQ = _magic("blob_request", b"DPWA?")
+# State transfer (crash recovery): followed by STATE_REQ_BODY.
+STATE_REQ = _magic("state_request", b"DPWA@")
+# Relay probe (epidemic membership): followed by RELAY_BODY + host bytes.
+RELAY_REQ = _magic("relay_request", b"DPWA!")
+
+# --- response / section magics (4 bytes, first field of the header) ---
+BLOB_MAGIC = _magic("blob_frame", b"DPWA")
+STATE_MAGIC = _magic("state_frame", b"DPWS")
+RELAY_MAGIC = _magic("relay_report", b"DPWR")
+BUSY_MAGIC = _magic("busy_nack", b"DPWB")
+DIGEST_MAGIC = _magic("membership_digest", b"DPWM")
+OBS_MAGIC = _magic("obs_section", b"DPWT")
+
+# --- struct layouts (little-endian throughout) ---
+# Gossip blob response header:
+#   magic(4s) version(B) dtype(B) clock(d) loss(d) nbytes(Q)
+BLOB_HDR_FMT = "<4sBBddQ"
+# State request body after STATE_REQ: <Q offset><I max_chunk>.
+STATE_REQ_BODY_FMT = "<QI"
+# State response header (ONE chunk per connection — resumable transfer):
+#   magic(4s) version(B) generation(I) total(Q) offset(Q)
+#   chunk_len(I) crc32(I)
+STATE_HDR_FMT = "<4sBIQQII"
+# Relay request body after RELAY_REQ:
+#   <H target_index><H target_port><I probe_timeout_ms><B hostlen> + host
+RELAY_BODY_FMT = "<HHIB"
+# Relay response: magic(4s) version(B) outcome(B) clock(d), where
+# ``outcome`` indexes RELAY_OUTCOME_NAMES.
+RELAY_HDR_FMT = "<4sBBd"
+# Busy shed reply: magic(4s) version(B) retry_hint_ms(H).
+BUSY_HDR_FMT = "<4sBH"
+# Membership digest trailer header: magic(4s) version(B) entry_count(H)
+# incarnation_clock(I) sender(H), then entry_count packed entries.
+DIGEST_HDR_FMT = "<4sBHIH"
+# One digest entry: peer(H) state(B) incarnation(I) suspicion(f).
+DIGEST_ENTRY_FMT = "<HBIf"
+# Observability trailer header: magic(4s) version(B) sketch_count(H)
+# trace_id(I) loss_ema(f) reserved(H), then sketch_count f32 values.
+OBS_HDR_FMT = "<4sBHIfH"
+# Length prefix used by recovery/state_transfer.py when packing leaves
+# into the opaque state blob served under STATE_MAGIC.
+STATE_PACK_LEN_FMT = "<I"
+
+# Inner magic of the packed state blob itself (recovery/state_transfer):
+# the blob rides opaquely inside DPWS chunks, but a donor and a rejoiner
+# from different builds must agree on its framing, so it is part of the
+# frozen contract too.
+STATE_PACK_MAGIC = _magic("state_pack", b"DPST")
+
+# Pre-compiled structs (import these, not struct.Struct(<literal>)).
+BLOB_HDR = struct.Struct(BLOB_HDR_FMT)
+STATE_REQ_BODY = struct.Struct(STATE_REQ_BODY_FMT)
+STATE_HDR = struct.Struct(STATE_HDR_FMT)
+RELAY_BODY = struct.Struct(RELAY_BODY_FMT)
+RELAY_HDR = struct.Struct(RELAY_HDR_FMT)
+BUSY_HDR = struct.Struct(BUSY_HDR_FMT)
+DIGEST_HDR = struct.Struct(DIGEST_HDR_FMT)
+DIGEST_ENTRY = struct.Struct(DIGEST_ENTRY_FMT)
+OBS_HDR = struct.Struct(OBS_HDR_FMT)
+STATE_PACK_LEN = struct.Struct(STATE_PACK_LEN_FMT)
+
+# --- payload (dtype) codes: the B ``dtype`` field of BLOB_HDR ---
+_PAYLOAD_REGISTRY: Dict[int, str] = {}
+
+
+def _payload(name: str, code: int) -> int:
+    if code in _PAYLOAD_REGISTRY:
+        raise ValueError(
+            "payload code collision: %d already registered as %r, cannot"
+            " also register %r" % (code, _PAYLOAD_REGISTRY[code], name)
+        )
+    _PAYLOAD_REGISTRY[code] = name
+    return code
+
+
+# Flat numpy dtypes (raw little-endian vector bytes follow the header).
+PAYLOAD_F32 = _payload("f32", 0)
+PAYLOAD_F64 = _payload("f64", 1)
+PAYLOAD_U16 = _payload("u16", 2)
+PAYLOAD_BF16 = _payload("bf16", 3)
+# Code 4 is NOT a flat numpy dtype: int8-chunked payload
+# (u64 n | f32 scales | int8 q — ops/quantize.py), decoded to f32 by
+# fetch_blob.  protocol.wire_dtype: int8.
+PAYLOAD_INT8_CHUNKED = _payload("int8_chunked", 4)
+# Code 5: top-k delta payload (u64 n | u32 k | u8 value_code | sorted
+# u32 idx[k] | f32-or-int8 values — ops/quantize.py).  fetch_blob_full
+# returns it as a SPARSE TopkPayload object in the vector slot: only the
+# receiver holds the replica the frame splices into, so densification
+# happens in TcpTransport.fetch against the receiver's own published
+# view.  protocol.wire_codec: topk.
+PAYLOAD_TOPK_DELTA = _payload("topk_delta", 5)
+# Codec payloads: codes whose body is NOT a flat dtype cast.
+CODEC_PAYLOAD_CODES: Tuple[int, ...] = (
+    PAYLOAD_INT8_CHUNKED,
+    PAYLOAD_TOPK_DELTA,
+)
+
+# --- relay outcome codes: the B ``outcome`` field of RELAY_HDR ---
+# Index → health-detector outcome name (tcp.py maps these onto the
+# Outcome enum; the NAMES are the wire contract, the enum is not).
+RELAY_OUTCOME_NAMES: Tuple[str, ...] = (
+    "success",  # 0
+    "timeout",  # 1
+    "refused",  # 2
+    "short_read",  # 3
+    "corrupt",  # 4
+    "busy",  # 5 — appended, see BACK_COMPAT["relay_busy_outcome"]
+)
+
+# --- size clamps (DoS bounds, part of the served contract) ---
+MAX_BLOB_BYTES = 1 << 34  # 16 GiB sanity bound on advertised payload size
+MAX_STATE_CHUNK_BYTES = 1 << 26  # 64 MiB server-side clamp on one chunk
+MAX_DIGEST_BYTES = 1 << 20  # 1 MiB bound on a digest trailer
+MAX_SKETCH_VALUES = 4096  # cap on f32 values in a DPWT section
+# A malicious relay requester must not pin the relay's Rx thread with a
+# huge probe timeout.
+MAX_RELAY_TIMEOUT_MS = 500
+
+# --- back-compat ledger ---
+# Notes that explain why the layouts above are the way they are.  These
+# were previously inline comments next to the literals; they are part of
+# the frozen contract and the reactor rewrite must preserve every one.
+BACK_COMPAT: Dict[str, str] = {
+    "busy_nack_short_frame": (
+        "The DPWB frame is 7 bytes, deliberately SHORTER than the "
+        "30-byte blob header: an old fetcher blocked in its header read "
+        "hits EOF when the server closes and lands in its existing "
+        "short_read classification (wire compatible both directions), "
+        "while a flowctl-aware fetcher peeks the 4-byte magic, reads "
+        "the remaining 3, and records the low-weight busy outcome that "
+        "soft-degrades the peer instead of quarantining it."
+    ),
+    "relay_busy_outcome": (
+        "Relay outcome code 5 (busy) was appended by the flowctl plane: "
+        "a relay may find the target alive but shedding.  Old readers "
+        "reject code 5 as corrupt, which is the safe direction — they "
+        "never vouch for a shedding peer."
+    ),
+    "digest_trailer_optional": (
+        "The DPWM digest rides as an OPTIONAL trailing section AFTER "
+        "the nbytes payload: the blob header's nbytes still counts only "
+        "the vector, so a pre-membership fetcher reads exactly header + "
+        "payload and never sees the trailer, while a digest-aware "
+        "fetcher attempts a tolerant trailing read — version-gated wire "
+        "compatibility in both directions (docs/membership.md)."
+    ),
+    "obs_after_digest": (
+        "The DPWT observability section rides AFTER the digest when "
+        "both are present.  Ordering matters for back-compat: a "
+        "digest-aware pre-obs fetcher reads the digest it wants, then "
+        "its next read fails the DPWM magic check on the DPWT header "
+        "and stops harmlessly; obs-aware fetchers dispatch trailers by "
+        "magic and handle every presence combination."
+    ),
+    "state_one_chunk_per_connection": (
+        "The state transfer serves ONE chunk per connection, which "
+        "keeps the transfer resumable: a short read just reconnects at "
+        "the next unacknowledged offset.  ``generation`` increments per "
+        "publish_state, so a client detects a donor re-publishing "
+        "mid-transfer (splicing two states would corrupt the bootstrap) "
+        "and restarts cleanly."
+    ),
+    "request_magic_length": (
+        "All request magics are 5 bytes so the Rx server reads exactly "
+        "5 bytes and dispatches — adding a request type must keep that "
+        "length or old servers mis-frame the connection."
+    ),
+}
+
+
+def registered_magics() -> Dict[bytes, str]:
+    """A copy of the magic → name registry."""
+    return dict(_MAGIC_REGISTRY)
+
+
+def registered_payload_codes() -> Dict[int, str]:
+    """A copy of the payload code → name registry."""
+    return dict(_PAYLOAD_REGISTRY)

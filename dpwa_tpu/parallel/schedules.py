@@ -30,6 +30,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from dpwa_tpu.config import DpwaConfig
+# Every control-tag literal comes from the central registry; dpwalint's
+# determinism checker rejects raw tag ints in the draw calls below.
+from dpwa_tpu.utils import tags as _tags
 
 
 def _pair_key(seed, step, pair_id, tag: int):
@@ -53,7 +56,7 @@ def participation_draw(seed, step, pair_id, fetch_probability):
     TCP-vs-ICI parity test (SURVEY.md §4) bit-comparable.  All of ``step`` and
     ``pair_id`` may be traced.
     """
-    return jax.random.uniform(_pair_key(seed, step, pair_id, 0)) < fetch_probability
+    return jax.random.uniform(_pair_key(seed, step, pair_id, _tags.TAG_PARTICIPATION)) < fetch_probability
 
 
 def fault_draw(seed, step, pair_id, drop_probability):
@@ -66,7 +69,7 @@ def fault_draw(seed, step, pair_id, drop_probability):
     the two knobs compose without correlation.  Same stream on the host (TCP
     path times out naturally, but injection lets tests force it) and in-jit
     (masked merge, α=0)."""
-    return jax.random.uniform(_pair_key(seed, step, pair_id, 1)) < drop_probability
+    return jax.random.uniform(_pair_key(seed, step, pair_id, _tags.TAG_FAULT)) < drop_probability
 
 
 def fallback_draw(seed, step, me, n_candidates: int):
@@ -80,7 +83,7 @@ def fallback_draw(seed, step, me, n_candidates: int):
     bit-identical behavior across replicas — the same property the
     participation draw guarantees."""
     return jax.random.randint(
-        _pair_key(seed, step, me, 3), (), 0, n_candidates
+        _pair_key(seed, step, me, _tags.TAG_FALLBACK), (), 0, n_candidates
     )
 
 
@@ -96,7 +99,7 @@ def backoff_jitter_draw(seed, peer, streak, jitter_rounds: int) -> int:
         return 0
     return int(
         jax.random.randint(
-            _pair_key(seed, peer, streak, 4), (), 0, jitter_rounds + 1
+            _pair_key(seed, peer, streak, _tags.TAG_BACKOFF_JITTER), (), 0, jitter_rounds + 1
         )
     )
 
@@ -112,7 +115,7 @@ def donor_draw(seed, step, me, n_candidates: int):
     and load spreads across donors instead of always hammering the
     lowest-indexed healthy peer."""
     return jax.random.randint(
-        _pair_key(seed, step, me, 5), (), 0, n_candidates
+        _pair_key(seed, step, me, _tags.TAG_DONOR), (), 0, n_candidates
     )
 
 
@@ -126,7 +129,7 @@ def relay_draw(seed, step, me, probe_slot: int, n_candidates: int):
     the identical relay set, so indirect-probe outcomes — and therefore
     quarantine decisions — stay bit-identical across runs."""
     return jax.random.randint(
-        jax.random.fold_in(_pair_key(seed, step, me, 6), probe_slot),
+        jax.random.fold_in(_pair_key(seed, step, me, _tags.TAG_RELAY_PROBE), probe_slot),
         (), 0, n_candidates,
     )
 
@@ -140,7 +143,7 @@ def degrade_shed_draw(seed, step, me):
     fetch proceeds under the peer's (short) adaptive deadline so recovery
     evidence keeps flowing.  Keyed on ``(seed, step, me)`` like
     :func:`fallback_draw`, so shed decisions replay bit-identically."""
-    return float(jax.random.uniform(_pair_key(seed, step, me, 8)))
+    return float(jax.random.uniform(_pair_key(seed, step, me, _tags.TAG_DEGRADE_SHED)))
 
 
 def heal_draw(seed, step, me, n_candidates: int):
@@ -152,7 +155,7 @@ def heal_draw(seed, step, me, n_candidates: int):
     drawn member of the returning one, spreading the anti-entropy fetch
     load while keeping heal events replayable."""
     return jax.random.randint(
-        _pair_key(seed, step, me, 7), (), 0, n_candidates
+        _pair_key(seed, step, me, _tags.TAG_HEAL_DONOR), (), 0, n_candidates
     )
 
 
@@ -183,15 +186,16 @@ def warm_control_draws(seed: int = 0, me: int = 0) -> None:
     int(relay_draw(seed, 0, me, 0, 2))
     int(heal_draw(seed, 0, me, 2))
     float(degrade_shed_draw(seed, 0, me))
-    float(chaos_draw(seed, 0, me, 0))
+    float(chaos_draw(seed, 0, me, _tags.CHAOS_KIND_DROP))
     _CONTROL_DRAWS_WARM = True
 
 
-# Chaos fault-kind tags start at 16: far clear of the control-plane tags
-# (0 participation, 1 fault, 2 pool, 3 fallback, 4 backoff jitter,
-# 5 bootstrap donor, 6 relay probe, 7 heal donor, 8 degrade shed), so
-# new control draws can claim 9..15 without colliding with fault kinds.
-CHAOS_TAG_BASE = 16
+# Chaos fault-kind tags start far clear of the control-plane tags so
+# new control draws can claim the 10..15 range without colliding with
+# fault kinds.  The allocation map lives in dpwa_tpu/utils/tags.py;
+# re-exported here because chaos/test code historically imports it from
+# the schedules module.
+CHAOS_TAG_BASE = _tags.CHAOS_TAG_BASE
 
 
 def chaos_draw(seed, step, peer, kind: int):
@@ -203,7 +207,7 @@ def chaos_draw(seed, step, peer, kind: int):
     always injects the same fault, in tests and in a ``chaos:``-config
     soak alike (the same design as :func:`fault_draw`)."""
     return float(
-        jax.random.uniform(_pair_key(seed, step, peer, CHAOS_TAG_BASE + kind))
+        jax.random.uniform(_pair_key(seed, step, peer, _tags.CHAOS_TAG_BASE + kind))
     )
 
 
@@ -221,7 +225,7 @@ def pool_branch_draw(seed, step, pool_size: int, periodic: bool):
     step = jnp.asarray(step, jnp.int32)
     if periodic or pool_size <= 1:
         return jnp.mod(step, pool_size)
-    return jax.random.randint(_pair_key(seed, step, 0, 2), (), 0, pool_size)
+    return jax.random.randint(_pair_key(seed, step, 0, _tags.TAG_POOL_BRANCH), (), 0, pool_size)
 
 
 def is_involution(perm: np.ndarray) -> bool:

@@ -30,7 +30,6 @@ non-TPU hosts) and as the baseline the ICI path is benchmarked against
 from __future__ import annotations
 
 import socket
-import struct
 import threading
 import time
 import zlib
@@ -53,94 +52,81 @@ from dpwa_tpu.health.scoreboard import PeerState, Scoreboard
 from dpwa_tpu.interpolation import PeerMeta, make_interpolation
 from dpwa_tpu.parallel.schedules import Schedule, build_schedule
 
-# Wire format: request is the 5-byte magic; response is
-#   header: magic(4s) version(B) dtype(B) clock(d) loss(d) nbytes(Q)
-#   then nbytes of raw little-endian vector data.
-_REQ = b"DPWA?"
-_MAGIC = b"DPWA"
-_HDR = struct.Struct("<4sBBddQ")
-_DTYPES = {0: np.dtype("<f4"), 1: np.dtype("<f8"), 2: np.dtype("<u2")}
+# Every magic, struct layout, payload code, and size clamp on the wire
+# comes from the protocol_constants registry (with its back-compat
+# ledger); dpwalint's wire-protocol checker rejects inline literals.
+# The old underscored names are kept as module-level aliases because
+# chaos/recovery/test code imports them from here.
+from dpwa_tpu.parallel import protocol_constants as _pc
+
+# Gossip blob wire: request is the 5-byte magic; response is
+# BLOB_HDR (magic version dtype clock loss nbytes) + nbytes of payload.
+_REQ = _pc.BLOB_REQ
+_MAGIC = _pc.BLOB_MAGIC
+_HDR = _pc.BLOB_HDR
+_DTYPES = {
+    _pc.PAYLOAD_F32: np.dtype("<f4"),
+    _pc.PAYLOAD_F64: np.dtype("<f8"),
+    _pc.PAYLOAD_U16: np.dtype("<u2"),
+}
 try:  # bf16 wire code (protocol.wire_dtype: bf16) — ml_dtypes ships w/ jax
     import ml_dtypes
 
-    _DTYPES[3] = np.dtype(ml_dtypes.bfloat16)
+    _DTYPES[_pc.PAYLOAD_BF16] = np.dtype(ml_dtypes.bfloat16)
 except ImportError:  # pragma: no cover - ml_dtypes is a jax dependency
     ml_dtypes = None
 _DTYPE_CODES = {v: k for k, v in _DTYPES.items()}
-# Code 4 is NOT a flat numpy dtype: int8-chunked payload
-# (u64 n | f32 scales | int8 q — ops/quantize.py), decoded to f32 by
-# fetch_blob.  protocol.wire_dtype: int8.
-_INT8_CHUNKED = 4
-# Code 5: top-k delta payload (u64 n | u32 k | u8 value_code | sorted
-# u32 idx[k] | f32-or-int8 values — ops/quantize.py).  fetch_blob_full
-# returns it as a SPARSE TopkPayload object in the vector slot: only the
-# receiver holds the replica the frame splices into, so densification
-# happens in TcpTransport.fetch against the receiver's own published
-# view.  protocol.wire_codec: topk.
-_TOPK_DELTA = 5
-_PAYLOAD_CODES = (_INT8_CHUNKED, _TOPK_DELTA)
-_MAX_BLOB = 1 << 34  # 16 GiB sanity bound on advertised payload size
+# Codec payloads (int8-chunked, top-k delta) are NOT flat numpy dtypes —
+# see the notes on PAYLOAD_INT8_CHUNKED / PAYLOAD_TOPK_DELTA in
+# protocol_constants.py for their body layouts and decode ownership.
+_INT8_CHUNKED = _pc.PAYLOAD_INT8_CHUNKED
+_TOPK_DELTA = _pc.PAYLOAD_TOPK_DELTA
+_PAYLOAD_CODES = _pc.CODEC_PAYLOAD_CODES
+_MAX_BLOB = _pc.MAX_BLOB_BYTES
 
 # STATE transfer wire (crash recovery, dpwa_tpu/recovery/): a restarted
 # worker bootstraps a donor's full serialized train state over the same
 # one-shot socket discipline as the gossip fetch — request, one framed
-# response, close.  The request is a distinct 5-byte magic (same length
-# as _REQ, so the Rx server reads 5 bytes and dispatches) followed by
-# <Q offset><I max_chunk>; the response is ONE chunk:
-#   header: magic(4s) version(B) generation(I) total(Q) offset(Q)
-#           chunk_len(I) crc32(I)
-# then chunk_len bytes.  One chunk per connection keeps the transfer
-# resumable: a short read just reconnects at the next unacknowledged
-# offset.  ``generation`` increments per publish_state, so a client
-# detects a donor re-publishing mid-transfer (splicing two states would
-# corrupt the bootstrap) and restarts cleanly.
-_STATE_REQ = b"DPWA@"
-_STATE_REQ_BODY = struct.Struct("<QI")
-_STATE_MAGIC = b"DPWS"
-_STATE_HDR = struct.Struct("<4sBIQQII")
-_MAX_STATE_CHUNK = 1 << 26  # 64 MiB server-side clamp on one chunk
+# response, close.  Layout + resumability notes: protocol_constants.py
+# (STATE_HDR_FMT, BACK_COMPAT["state_one_chunk_per_connection"]).
+_STATE_REQ = _pc.STATE_REQ
+_STATE_REQ_BODY = _pc.STATE_REQ_BODY
+_STATE_MAGIC = _pc.STATE_MAGIC
+_STATE_HDR = _pc.STATE_HDR
+_MAX_STATE_CHUNK = _pc.MAX_STATE_CHUNK_BYTES
 
 # RELAY probe wire (epidemic membership, dpwa_tpu/membership/): before a
 # node promotes a suspect to quarantined on its own evidence alone, it
 # asks K drawn healthy peers to header-probe the suspect FOR it — an
 # asymmetric fault (my link to the suspect is down, yours is not) then
-# yields "alive" votes that avert a false quarantine.  The request is a
-# distinct 5-byte magic (same dispatch as _REQ/_STATE_REQ) followed by
-# <H target_index><H target_port><I probe_timeout_ms><B hostlen> + host
-# bytes; the response is magic(4s) version(B) outcome(B) clock(d) where
-# ``outcome`` indexes _RELAY_OUTCOMES — the relay's CLASSIFIED result of
-# its own probe_header_classified against the target.
-_RELAY_REQ = b"DPWA!"
-_RELAY_BODY = struct.Struct("<HHIB")
-_RELAY_MAGIC = b"DPWR"
-_RELAY_HDR = struct.Struct("<4sBBd")
+# yields "alive" votes that avert a false quarantine.  The response's
+# ``outcome`` byte indexes _RELAY_OUTCOMES — the relay's CLASSIFIED
+# result of its own probe_header_classified against the target.
+_RELAY_REQ = _pc.RELAY_REQ
+_RELAY_BODY = _pc.RELAY_BODY
+_RELAY_MAGIC = _pc.RELAY_MAGIC
+_RELAY_HDR = _pc.RELAY_HDR
+# The wire contract is the NAME tuple in protocol_constants; this maps
+# each code onto the health-detector Outcome enum and must stay aligned
+# (asserted below — drift would misclassify relay votes).
 _RELAY_OUTCOMES = (
     Outcome.SUCCESS,
     Outcome.TIMEOUT,
     Outcome.REFUSED,
     Outcome.SHORT_READ,
     Outcome.CORRUPT,
-    # Appended (code 5) by the flowctl plane: a relay may find the target
-    # alive but shedding.  Old readers reject code 5 as corrupt, which is
-    # the safe direction — they never vouch for a shedding peer.
     Outcome.BUSY,
 )
-# Server-side clamp on the relayed probe budget: a malicious requester
-# must not be able to pin a relay's Rx thread with a huge timeout.
-_MAX_RELAY_TIMEOUT_MS = 500
+assert tuple(_RELAY_OUTCOMES) == _pc.RELAY_OUTCOME_NAMES
+_MAX_RELAY_TIMEOUT_MS = _pc.MAX_RELAY_TIMEOUT_MS
 
 # BUSY shed frame (flowctl admission, dpwa_tpu/flowctl/): when the Rx
 # server refuses work — connection cap, token bucket, in-flight-bytes
-# ceiling — it answers this tiny frame instead of silently dropping:
-#   magic(4s)="DPWB" version(B) retry_hint_ms(H)
-# 7 bytes, deliberately SHORTER than the 30-byte _HDR: an old fetcher
-# blocked in its header read hits EOF when the server closes and lands
-# in its existing short_read classification (wire compatible both
-# directions), while a flowctl-aware fetcher peeks the 4-byte magic,
-# reads the remaining 3, and records the low-weight ``busy`` outcome
-# that soft-degrades the peer instead of quarantining it.
-_BUSY_MAGIC = b"DPWB"
-_BUSY_HDR = struct.Struct("<4sBH")
+# ceiling — it answers this tiny frame instead of silently dropping.
+# Why it is deliberately SHORTER than the blob header:
+# BACK_COMPAT["busy_nack_short_frame"] in protocol_constants.py.
+_BUSY_MAGIC = _pc.BUSY_MAGIC
+_BUSY_HDR = _pc.BUSY_HDR
 
 
 def _busy_frame(retry_hint_ms: int = 0) -> bytes:
@@ -1253,6 +1239,7 @@ class _OverlappedExchange:
             self.partner != transport.me
             and transport.schedule.participates(step, transport.me)
         )
+        # dpwalint: double_buffered(_got) -- handoff by join ordering: the fetch thread is the only writer, and finish() joins it before reading
         self._got = None
         self._thread: Optional[threading.Thread] = None
 
@@ -1361,10 +1348,13 @@ class TcpTransport:
         # the overlapped path), _weigh_remote reads it AFTER the fetch is
         # joined, so the handoff is ordered.  1.0 (fully trusted) is a
         # bit-exact no-op on alpha.
+        # dpwalint: double_buffered(_pending_trust_scale) -- written by the fetch leg before finish() joins it; _weigh_remote reads strictly after the join
         self._pending_trust_scale = 1.0
         # Local replica view for screening + the zero-energy guard:
         # stashed by publish() (publish always precedes fetch in a round).
+        # dpwalint: double_buffered(_local_vec) -- swap-on-publish: _publish rebinds a fresh array, readers see the old or new ref, never a torn write; straddling prefetches re-screen via _last_clock
         self._local_vec: Optional[np.ndarray] = None
+        # dpwalint: double_buffered(_local_norm) -- rebound alongside _local_vec under the same swap-on-publish discipline
         self._local_norm: Optional[float] = None
         self.interp = make_interpolation(
             config.interpolation,
@@ -1396,7 +1386,12 @@ class TcpTransport:
             )
         # Per-publish wire accounting: actual on-wire payload bytes vs
         # the dense f32 size, behind the ``compression_ratio`` health
-        # column and bench.py's codec sweep.
+        # column and bench.py's codec sweep.  Guarded by _stats_lock:
+        # the training thread tallies while the healthz / metrics-scrape
+        # threads read multi-key snapshots (unlocked, a scrape could see
+        # frames from one publish and bytes from another — or hit a dict
+        # mutated mid-iteration).
+        self._stats_lock = threading.Lock()
         self._wire_tally = {"frames": 0, "wire_bytes": 0, "dense_bytes": 0}
         # Double-buffered prefetch pipeline (protocol.overlap_prefetch):
         # round t+1's partner fetch streams on a background slot while
@@ -1573,12 +1568,18 @@ class TcpTransport:
             )
         # Bookkeeping for metrics/adapters: last fetch outcome and the
         # last round's partner resolution (schedule vs. health remap).
+        # dpwalint: double_buffered(last_fetch) -- rebound as one fresh dict per fetch; readers take the whole ref (stale-but-consistent telemetry)
         self.last_fetch: dict = {}
         self.last_round: dict = {}
         # Recovery bookkeeping: the clock we last published (for the
         # re-admission freshness check) and a pending re-sync advice
         # record the adapter pops when a readmitted peer's clock shows
-        # WE are the stale replica.
+        # WE are the stale replica.  _last_clock is guarded by
+        # _clock_lock: the training thread writes it in _publish while a
+        # prefetch/overlap daemon leg may concurrently read it through
+        # _link_blocked (chaos partitions are keyed on the publish
+        # clock).
+        self._clock_lock = threading.Lock()
         self._last_clock = 0.0
         self.resync_advice: Optional[dict] = None
         if self._chaos_engine is not None:
@@ -1663,7 +1664,8 @@ class TcpTransport:
         # the shipped copy before the collective).  int8 is quantized
         # with stochastic rounding keyed on (seed, clock, me) and
         # dequantized by the FETCHING side (ops/quantize.py).
-        self._last_clock = float(clock)
+        with self._clock_lock:
+            self._last_clock = float(clock)
         f32_vec = None  # contiguous-f32 view of vec, stashed below
         if (
             self.trust is not None
@@ -1732,11 +1734,13 @@ class TcpTransport:
                             trace_id=tid)
 
     def _note_published(self, wire_bytes: int, dense_bytes: int) -> None:
-        t = self._wire_tally
-        t["frames"] += 1
-        t["wire_bytes"] += wire_bytes
-        t["dense_bytes"] += dense_bytes
+        with self._stats_lock:
+            t = self._wire_tally
+            t["frames"] += 1
+            t["wire_bytes"] += wire_bytes
+            t["dense_bytes"] += dense_bytes
 
+    # dpwalint: thread_root(overlap-fetch)
     def fetch(
         self,
         peer_index: int,
@@ -2175,8 +2179,10 @@ class TcpTransport:
         agree on the same round key."""
         if self._chaos_engine is None:
             return False
+        with self._clock_lock:
+            clock = self._last_clock
         return self._chaos_engine.link_blocked(
-            int(self._last_clock), self.me, peer_index
+            int(clock), self.me, peer_index
         )
 
     def _indirect_probe(self, suspect: int, step: int) -> None:
@@ -2252,11 +2258,13 @@ class TcpTransport:
                     )
                 sb.record_probe(sched, outcome, round=step)
                 ok = outcome == Outcome.SUCCESS
+                with self._clock_lock:
+                    local_clock = self._last_clock
                 if (
                     ok
                     and remote_clock is not None
                     and self.config.recovery.enabled
-                    and remote_clock - self._last_clock
+                    and remote_clock - local_clock
                     > self.config.recovery.max_clock_lag
                 ):
                     # Re-admission freshness check: the peer came back
@@ -2267,7 +2275,7 @@ class TcpTransport:
                     self.resync_advice = {
                         "peer": sched,
                         "remote_clock": float(remote_clock),
-                        "local_clock": float(self._last_clock),
+                        "local_clock": float(local_clock),
                         "step": int(step),
                     }
             if sb.is_quarantined(sched, step):
@@ -2327,6 +2335,7 @@ class TcpTransport:
         advice, self.resync_advice = self.resync_advice, None
         return advice
 
+    # dpwalint: thread_root(healthz)
     def health_snapshot(self) -> dict:
         """JSON-ready per-peer health state (scoreboard + detector
         EWMAs, plus per-peer trust columns and a top-level ``trust``
@@ -2367,6 +2376,7 @@ class TcpTransport:
             snap["incidents"] = self.incidents.snapshot()
         return snap
 
+    # dpwalint: thread_root(healthz)
     def obs_snapshot(self) -> dict:
         """JSON-ready observability sub-document (healthz ``obs`` key,
         metrics' ``disagreement_*`` columns): the sketch-based ring
@@ -2378,6 +2388,7 @@ class TcpTransport:
             out["trace"] = self.tracer.stage_summary()
         return out
 
+    # dpwalint: thread_root(healthz)
     def wire_snapshot(self) -> dict:
         """JSON-ready wire-plane state: which codec is publishing, the
         actual on-wire vs dense f32 byte tallies behind the
@@ -2385,7 +2396,8 @@ class TcpTransport:
         — the overlap accounting (``occupancy`` = fetch in-flight time
         over entry-to-entry round wall; ``hidden_frac`` = the fraction
         of fetch wall-time the caller never waited on)."""
-        t = self._wire_tally
+        with self._stats_lock:
+            t = dict(self._wire_tally)
         codec = "topk" if self._wire_topk else self.config.protocol.wire_dtype
         out = {
             "codec": codec,
@@ -2402,7 +2414,8 @@ class TcpTransport:
             out["topk_fraction"] = self.config.protocol.topk_fraction
             out["topk_values"] = self.config.protocol.topk_values
         if self._prefetch_on:
-            o = self._overlap
+            with self._stats_lock:
+                o = dict(self._overlap)
             out["overlap"] = {
                 "rounds": o["rounds"],
                 "prefetched": o["prefetched"],
@@ -2840,13 +2853,14 @@ class TcpTransport:
         a partition that opened after launch still refuses the payload
         at consume (:meth:`_prefetch_take`)."""
         t_entry = time.monotonic()
-        o = self._overlap
-        if self._pipe_last_entry is not None:
-            # Entry-to-entry wall clock — the denominator of the
-            # overlap-occupancy column (compute + exchange, everything).
-            o["round_s"] += t_entry - self._pipe_last_entry
-        self._pipe_last_entry = t_entry
-        o["rounds"] += 1
+        with self._stats_lock:
+            o = self._overlap
+            if self._pipe_last_entry is not None:
+                # Entry-to-entry wall clock — the denominator of the
+                # overlap-occupancy column (compute + exchange, everything).
+                o["round_s"] += t_entry - self._pipe_last_entry
+            self._pipe_last_entry = t_entry
+            o["rounds"] += 1
         tr = self.tracer
         rt = tr is not None and tr.begin_round(step)
         try:
@@ -2956,7 +2970,6 @@ class TcpTransport:
         stream is never abandoned while a hung leg cannot wedge the
         round — a lapsed join skips the merge like any failed fetch."""
         slot, self._prefetch_slot = self._prefetch_slot, None
-        o = self._overlap
         tr = self.tracer
         timing = tr is not None and tr.active
         if slot is None or slot["step"] != step:
@@ -2969,9 +2982,11 @@ class TcpTransport:
             raw = self._wire_fetch(partner, step=step)
             dt = time.monotonic() - t0
             # A synchronous fill is all join-wait: nothing was hidden.
-            o["fetch_s"] += dt
-            o["join_wait_s"] += dt
-            o["inflight_s"] += dt
+            with self._stats_lock:
+                o = self._overlap
+                o["fetch_s"] += dt
+                o["join_wait_s"] += dt
+                o["inflight_s"] += dt
             if timing:
                 tr.mark("join_wait", dt)
                 tr.set(prefetched=False)
@@ -2982,14 +2997,16 @@ class TcpTransport:
         th = slot["thread"]
         if th is None:
             return None, sched, partner, remapped
-        o["prefetched"] += 1
+        with self._stats_lock:
+            self._overlap["prefetched"] += 1
         if timing:
             tr.set(prefetched=True, straddled=slot["t_end"][0] == 0.0)
         if slot["t_end"][0] == 0.0:
             # Still streaming as this round's publish landed: the
             # payload straddled a local publish and the consume-time
             # screen (not any launch-time state) is what judges it.
-            o["straddled"] += 1
+            with self._stats_lock:
+                self._overlap["straddled"] += 1
         fc = self.config.flowctl
         base_s = self.config.protocol.timeout_ms / 1000.0
         if fc.enabled:
@@ -3002,13 +3019,15 @@ class TcpTransport:
             / (self.config.protocol.min_wire_mb_per_s * 1e6)
         )
         join_dt = time.monotonic() - t_join
-        o["join_wait_s"] += join_dt
         if timing:
             tr.mark("join_wait", join_dt)
         t_end = slot["t_end"][0] or time.monotonic()
         span = max(t_end - slot["t_start"], 0.0)
-        o["fetch_s"] += span
-        o["inflight_s"] += span
+        with self._stats_lock:
+            o = self._overlap
+            o["join_wait_s"] += join_dt
+            o["fetch_s"] += span
+            o["inflight_s"] += span
         if not slot["box"]:
             # Join backstop lapsed: the daemon leg keeps running but
             # this round moves on without a merge.

@@ -24,14 +24,17 @@ replica vector.)
 from __future__ import annotations
 
 import json
-import struct
 import zlib
 from typing import Any, List, Optional, Tuple
 
 import numpy as np
 
-_PACK_MAGIC = b"DPST"
-_PACK_LEN = struct.Struct("<I")
+from dpwa_tpu.parallel import protocol_constants as _pc
+
+# Registered in the wire-constant registry: the packed blob is what the
+# DPWS state frames carry, so its framing is part of the wire contract.
+_PACK_MAGIC = _pc.STATE_PACK_MAGIC
+_PACK_LEN = _pc.STATE_PACK_LEN
 _MAX_HEADER = 1 << 24  # 16 MiB of JSON metadata is already absurd
 
 

@@ -444,7 +444,8 @@ class TrustManager:
                 "armed": fill >= self.config.min_window,
                 "window_fill": fill,
                 "baselines": {
-                    s: b.snapshot() for s, b in self._baselines.items()
+                    s: b.snapshot()
+                    for s, b in sorted(self._baselines.items())
                 },
                 "peers": peers,
             }
@@ -452,8 +453,8 @@ class TrustManager:
                 # Non-dense codec windows ride a separate key so a
                 # dense-only run's snapshot stays byte-identical.
                 out["codec_baselines"] = {
-                    c: {s: b.snapshot() for s, b in bl.items()}
-                    for c, bl in self._codec_baselines.items()
+                    c: {s: b.snapshot() for s, b in sorted(bl.items())}
+                    for c, bl in sorted(self._codec_baselines.items())
                     if c != "dense"
                 }
             return out

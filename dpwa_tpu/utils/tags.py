@@ -1,0 +1,110 @@
+"""Central registry of threefry control-tag allocations.
+
+Every host-side control decision (participation, faults, partner pools,
+relay probes, chaos, replica sketches …) draws from a counter-based
+threefry stream keyed by ``schedules._pair_key(seed, step, pair_id, tag)``.
+The ``tag`` is what keeps the streams independent: two draws that share a
+tag share a stream, and a collision silently correlates decisions that
+the convergence analysis assumes are independent.  This module is the
+single place tags are allocated — registering the same integer twice
+raises at import time, and ``dpwalint``'s determinism checker rejects any
+raw tag literal that does not come from here.
+
+Layout of the tag space:
+
+- ``0 .. 9``   allocated control-plane draws (below).
+- ``10 .. 15`` free — claim the next one HERE, never inline.
+- ``16 ..``    chaos fault-kind streams: ``CHAOS_TAG_BASE + kind`` where
+  ``kind`` is one of the ``CHAOS_KIND_*`` indices below.  Keeping the
+  chaos kinds far clear of the control tags means new control draws can
+  claim 10..15 without colliding with fault kinds.
+
+The int8 stochastic-rounding stream in ``ops/quantize.py`` is keyed on a
+separate ``fold_in(fold_in(key, step), sender)`` chain (no control tag)
+and deliberately does not live in this space.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+_TAG_REGISTRY: Dict[int, str] = {}
+
+
+def _register(name: str, value: int) -> int:
+    """Allocate control tag ``value`` to ``name``; collision = error."""
+    if value in _TAG_REGISTRY:
+        raise ValueError(
+            "threefry control-tag collision: tag %d already registered as"
+            " %r, cannot also register %r"
+            % (value, _TAG_REGISTRY[value], name)
+        )
+    _TAG_REGISTRY[value] = name
+    return value
+
+
+# Control-plane draws (one tag per independent decision stream).
+TAG_PARTICIPATION = _register("participation_draw", 0)
+TAG_FAULT = _register("fault_draw", 1)
+TAG_POOL_BRANCH = _register("pool_branch_draw", 2)
+TAG_FALLBACK = _register("fallback_draw", 3)
+TAG_BACKOFF_JITTER = _register("backoff_jitter_draw", 4)
+TAG_DONOR = _register("bootstrap_donor_draw", 5)
+TAG_RELAY_PROBE = _register("relay_probe_draw", 6)
+TAG_HEAL_DONOR = _register("heal_donor_draw", 7)
+TAG_DEGRADE_SHED = _register("degrade_shed_draw", 8)
+TAG_SKETCH = _register("replica_sketch_draw", 9)
+
+# Chaos fault-kind streams occupy CHAOS_TAG_BASE + kind.
+CHAOS_TAG_BASE = 16
+
+_CHAOS_KIND_REGISTRY: Dict[int, str] = {}
+
+
+def _register_chaos_kind(name: str, kind: int) -> int:
+    """Allocate chaos kind ``kind``; collides against both registries."""
+    if kind in _CHAOS_KIND_REGISTRY:
+        raise ValueError(
+            "chaos fault-kind collision: kind %d already registered as"
+            " %r, cannot also register %r"
+            % (kind, _CHAOS_KIND_REGISTRY[kind], name)
+        )
+    _CHAOS_KIND_REGISTRY[kind] = name
+    # The kind's absolute tag must not shadow a control tag either.
+    _register("chaos:" + name, CHAOS_TAG_BASE + kind)
+    return kind
+
+
+# Wire faults (health/chaos.py _PRIORITY order is behavioral priority,
+# not tag order).
+CHAOS_KIND_DROP = _register_chaos_kind("drop", 0)
+CHAOS_KIND_DELAY = _register_chaos_kind("delay", 1)
+CHAOS_KIND_THROTTLE = _register_chaos_kind("throttle", 2)
+CHAOS_KIND_TRUNCATE = _register_chaos_kind("truncate", 3)
+CHAOS_KIND_CORRUPT = _register_chaos_kind("corrupt", 4)
+# Drawn partitions: kind 5 decides whether a time block is split (drawn
+# once per block, peer key 0); kind 6 assigns each peer a side.
+CHAOS_KIND_PARTITION = _register_chaos_kind("partition", 5)
+CHAOS_KIND_PARTITION_SIDE = _register_chaos_kind("partition_side", 6)
+# Byzantine content faults (served frame stays wire-valid; only the
+# vector content lies — see health/chaos.py byzantine_frame).
+CHAOS_KIND_BYZ_SIGN = _register_chaos_kind("byz_sign", 7)
+CHAOS_KIND_BYZ_SCALE = _register_chaos_kind("byz_scale", 8)
+CHAOS_KIND_BYZ_REPLAY = _register_chaos_kind("byz_replay", 9)
+CHAOS_KIND_BYZ_ZERO = _register_chaos_kind("byz_zero", 10)
+# Flowctl shaping (slow-peer chaos): STALL decides whether this
+# (round, peer) stalls mid-frame, STALL_LEN draws the stall length as a
+# fraction of ``stall_ms_max`` — both independent of the wire-fault
+# draws, so a trickled peer can ALSO stall, like a real overloaded box.
+CHAOS_KIND_STALL = _register_chaos_kind("stall", 11)
+CHAOS_KIND_STALL_LEN = _register_chaos_kind("stall_len", 12)
+
+
+def registered_tags() -> Dict[int, str]:
+    """A copy of the full tag → name allocation map (chaos included)."""
+    return dict(_TAG_REGISTRY)
+
+
+def registered_chaos_kinds() -> Dict[int, str]:
+    """A copy of the chaos kind → name allocation map."""
+    return dict(_CHAOS_KIND_REGISTRY)

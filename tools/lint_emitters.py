@@ -1,36 +1,17 @@
 #!/usr/bin/env python
-"""Static lint: every JSONL emit site uses a registered record kind.
+"""Back-compat shim: the emit-site lint now lives in dpwalint.
 
-tools/schema_check.py validates files AFTER a run; this pass closes the
-other half of the loop by walking the SOURCE TREE with ``ast`` and
-checking every place a record could be born:
-
-- dict literals with a ``"record"`` key whose value is a string
-  literal — the kind must be in ``schema_check.RECORD_KINDS``;
-- ``record="..."`` keyword arguments in any call (the
-  ``MetricsLogger.log(step, record="health", ...)`` idiom);
-- ``log_event(step, "<kind>", ...)`` / ``self._event("<kind>", ...)``
-  calls and dict literals with an ``"event"`` key — the kind must be in
-  ``schema_check.EVENT_KINDS``.
-
-Sites with dynamic kinds (a variable, an f-string, ``fields.pop(...)``)
-are skipped — they are re-emission plumbing, and the records they
-forward were already checked at their literal birth site.  The point is
-that ADDING a new record/event kind without registering its schema
-fails tier-1 (tests/test_static_checks.py) instead of silently
-producing unvalidatable JSONL.
-
-Usage::
-
-    python tools/lint_emitters.py              # lint dpwa_tpu/ tools/ bench.py
-    python tools/lint_emitters.py path [...]   # lint specific files/dirs
-    python tools/lint_emitters.py --json
+The pass itself moved to :mod:`dpwa_tpu.analysis.emit_kinds` (the
+``emit-kind`` rule), sharing the dpwalint runner, suppression grammar,
+and ratchet baseline with the other repo checkers — run
+``python tools/dpwalint.py`` for the full suite.  This module keeps the
+old entry points (``lint``/``lint_file``/``main``, the schema_check
+registry re-exports) so existing callers and tests keep working.
 """
 
 from __future__ import annotations
 
 import argparse
-import ast
 import json
 import os
 import sys
@@ -46,122 +27,40 @@ except ImportError:  # run as a loose script outside the repo root
     sys.path.insert(0, _HERE)
     from schema_check import EVENT_KINDS, RECORD_KINDS  # noqa: F401
 
+from dpwa_tpu.analysis.core import iter_py_files, load_files  # noqa: E402
+from dpwa_tpu.analysis.emit_kinds import EmitKindsChecker  # noqa: E402
+
 DEFAULT_TARGETS = ("dpwa_tpu", "tools", "bench.py")
 
-# Call names whose FIRST string-literal positional argument is an event
-# kind (self._event("kind", ...), metrics.log_event(step, "kind", ...)).
-_EVENT_CALLS = ("log_event", "_event")
 
-
-def _str_const(node: ast.AST) -> Optional[str]:
-    if isinstance(node, ast.Constant) and isinstance(node.value, str):
-        return node.value
-    return None
-
-
-class _EmitVisitor(ast.NodeVisitor):
-    def __init__(self, path: str):
-        self.path = path
-        self.errors: List[dict] = []
-
-    def _err(self, node: ast.AST, msg: str) -> None:
-        self.errors.append(
-            {"file": self.path, "line": node.lineno, "error": msg}
-        )
-
-    def _check_record(self, node: ast.AST, kind: str) -> None:
-        if kind not in RECORD_KINDS:
-            self._err(
-                node,
-                f"unregistered record kind {kind!r} "
-                "(register a schema in tools/schema_check.py)",
-            )
-
-    def _check_event(self, node: ast.AST, kind: str) -> None:
-        if kind not in EVENT_KINDS:
-            self._err(
-                node,
-                f"unregistered event kind {kind!r} "
-                "(add it to schema_check.EVENT_KINDS)",
-            )
-
-    def visit_Dict(self, node: ast.Dict) -> None:
-        for key, value in zip(node.keys, node.values):
-            k = _str_const(key) if key is not None else None
-            if k == "record":
-                v = _str_const(value)
-                if v is not None:
-                    self._check_record(value, v)
-            elif k == "event":
-                v = _str_const(value)
-                if v is not None:
-                    self._check_event(value, v)
-        self.generic_visit(node)
-
-    def visit_Call(self, node: ast.Call) -> None:
-        for kw in node.keywords:
-            if kw.arg == "record":
-                v = _str_const(kw.value)
-                if v is not None:
-                    self._check_record(kw.value, v)
-            elif kw.arg == "event":
-                v = _str_const(kw.value)
-                if v is not None:
-                    self._check_event(kw.value, v)
-        func = node.func
-        name = None
-        if isinstance(func, ast.Attribute):
-            name = func.attr
-        elif isinstance(func, ast.Name):
-            name = func.id
-        if name in _EVENT_CALLS:
-            for arg in node.args:
-                v = _str_const(arg)
-                if v is not None:
-                    self._check_event(arg, v)
-                    break  # first string literal is the kind
-        self.generic_visit(node)
+def _to_legacy(findings) -> List[dict]:
+    return [
+        {"file": f.path, "line": f.line, "error": f.message}
+        for f in findings
+    ]
 
 
 def lint_file(path: str) -> List[dict]:
-    try:
-        with open(path, "r", encoding="utf-8") as fh:
-            src = fh.read()
-        tree = ast.parse(src, filename=path)
-    except (OSError, SyntaxError) as e:
-        return [{"file": path, "line": 0, "error": f"unparseable: {e}"}]
-    visitor = _EmitVisitor(path)
-    visitor.visit(tree)
-    return visitor.errors
-
-
-def iter_py_files(target: str):
-    if os.path.isfile(target):
-        if target.endswith(".py"):
-            yield target
-        return
-    for dirpath, dirnames, filenames in os.walk(target):
-        dirnames[:] = [
-            d for d in dirnames
-            if d not in ("__pycache__", ".git", "artifacts")
-        ]
-        for fn in sorted(filenames):
-            if fn.endswith(".py"):
-                yield os.path.join(dirpath, fn)
+    return lint([path])
 
 
 def lint(targets) -> List[dict]:
-    errors: List[dict] = []
-    for target in targets:
-        for path in iter_py_files(target):
-            errors.extend(lint_file(path))
+    files = load_files(iter_py_files(targets))
+    errors = _to_legacy(EmitKindsChecker().check(files))
+    for f in files:
+        if f.parse_error is not None:
+            errors.append({
+                "file": f.path,
+                "line": f.parse_error.line,
+                "error": f"unparseable: {f.parse_error.message}",
+            })
     return errors
 
 
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         description="Lint JSONL emit sites against the registered "
-        "record/event kinds."
+        "record/event kinds (shim over tools/dpwalint.py)."
     )
     ap.add_argument(
         "paths", nargs="*",
