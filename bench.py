@@ -569,6 +569,161 @@ def bench_wire(d: int, iters: int, timeout_ms: int = 10000) -> dict:
     return out
 
 
+# Held-peer counts for the serve-leg capacity sweep (ISSUE 10): the
+# C10K-style question "how many concurrently held connections can the Rx
+# server carry while still serving a fresh fetch?", asked at ring sizes
+# up to the 256-peer target.
+SERVE_SWEEP = (16, 64, 256)
+
+
+def bench_serve(frame_floats: int, fps_seconds: float) -> dict:
+    """Rx serve leg: threaded thread-per-connection vs reactor event loop.
+
+    Two sub-measurements per server, both against the SAME default
+    operating envelope each server ships with (threaded:
+    ``max_connections=32``; reactor: ``reactor_max_connections=1024``)
+    — the comparison is between deployable configurations, not between
+    artificially equalized ones:
+
+    - **frames/sec**: 16 fetcher threads hammer one published
+      ``frame_floats``-float blob for ``fps_seconds``; sustained
+      served-frame throughput.
+    - **capacity sweep**: for each N in ``SERVE_SWEEP``, N simulated
+      peers connect and HOLD their connections (no bytes sent — the
+      idle phase of a slow peer), then one fresh probe fetch runs.  A
+      point is *sustained* when all N holds stay admitted AND the probe
+      is served.  ``capacity_conns`` is the largest sustained N; the
+      thread-per-connection server tops out at its thread cap while the
+      reactor carries the whole sweep on one loop thread.
+
+    Token pacing is opened up (everything arrives from 127.0.0.1, so
+    the per-host bucket would otherwise throttle the bench itself, not
+    model reality); connection caps and eviction stay live.
+    """
+    from dpwa_tpu.config import FlowctlConfig
+    from dpwa_tpu.parallel.reactor import ReactorPeerServer
+    from dpwa_tpu.parallel.tcp import PeerServer, fetch_blob_ex
+
+    import socket as _socket
+
+    fc = FlowctlConfig(token_rate=1e9, token_burst=1e9)
+    makers = {
+        "threaded": lambda: PeerServer("127.0.0.1", 0, flowctl=fc),
+        "reactor": lambda: ReactorPeerServer("127.0.0.1", 0, flowctl=fc),
+    }
+    vec = np.zeros(frame_floats, np.float32)
+
+    def frames_leg(make) -> dict:
+        srv = make()
+        try:
+            srv.publish(vec, 1.0, 0.0)
+            nworkers = 16
+            stop_at = time.perf_counter() + fps_seconds
+            counts = [0] * nworkers
+            errors = [0] * nworkers
+
+            def worker(i: int) -> None:
+                while time.perf_counter() < stop_at:
+                    res = fetch_blob_ex("127.0.0.1", srv.port, 2000)
+                    if res[0] is not None:
+                        counts[i] += 1
+                    else:
+                        errors[i] += 1
+
+            threads = [
+                threading.Thread(target=worker, args=(i,))
+                for i in range(nworkers)
+            ]
+            t0 = time.perf_counter()
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join()
+            wall = time.perf_counter() - t0
+            return {
+                "frames": sum(counts),
+                "fetch_errors": sum(errors),
+                "wall_s": round(wall, 3),
+                "frames_per_s": round(sum(counts) / max(wall, 1e-9), 1),
+            }
+        finally:
+            srv.close()
+
+    def held_count(socks) -> int:
+        """Connections the server still holds open: a shed connection has
+        a busy frame (or plain EOF/RST) waiting, a held one has nothing."""
+        held = 0
+        for s in socks:
+            s.setblocking(False)
+            try:
+                s.recv(16)  # bytes or b"" -> shed/closed
+            except (BlockingIOError, InterruptedError):
+                held += 1
+            except OSError:
+                pass  # reset -> shed
+        return held
+
+    def capacity_leg(make) -> dict:
+        points = {}
+        capacity = 0
+        for n in SERVE_SWEEP:
+            srv = make()
+            socks = []
+            try:
+                srv.publish(vec, 1.0, 0.0)
+                for _ in range(n):
+                    try:
+                        socks.append(
+                            _socket.create_connection(
+                                ("127.0.0.1", srv.port), timeout=2.0
+                            )
+                        )
+                    except OSError:
+                        break
+                # Let accept + admission settle (the reactor drains
+                # accepts in 64-connection batches per loop tick).
+                time.sleep(0.3)
+                held = held_count(socks)
+                probe = fetch_blob_ex("127.0.0.1", srv.port, 2000)
+                probe_ok = probe[0] is not None
+                sustained = held == n and probe_ok
+                points[str(n)] = {
+                    "held": held,
+                    "probe_ok": probe_ok,
+                    "sustained": sustained,
+                }
+                if sustained:
+                    capacity = max(capacity, n)
+            finally:
+                for s in socks:
+                    try:
+                        s.close()
+                    except OSError:
+                        pass
+                srv.close()
+        return {"points": points, "capacity_conns": capacity}
+
+    servers = {}
+    for name, make in makers.items():
+        log(f"serve leg [{name}]: frames/sec x{fps_seconds:.1f}s ...")
+        res = frames_leg(make)
+        log(f"serve leg [{name}]: capacity sweep {list(SERVE_SWEEP)} ...")
+        res.update(capacity_leg(make))
+        servers[name] = res
+
+    thr_cap = servers["threaded"]["capacity_conns"]
+    rx_cap = servers["reactor"]["capacity_conns"]
+    return {
+        "frame_bytes": frame_floats * 4,
+        "fps_seconds": fps_seconds,
+        "sweep": list(SERVE_SWEEP),
+        "servers": servers,
+        "capacity_ratio": (
+            round(rx_cap / thr_cap, 2) if thr_cap else None
+        ),
+    }
+
+
 # ---------------------------------------------------------------------------
 # Watchdog'd subprocess orchestration (main process never imports JAX).
 # ---------------------------------------------------------------------------
@@ -692,6 +847,28 @@ def main() -> None:
         "--skip-wire", action="store_true",
         help="skip the wire-codec sweep leg",
     )
+    ap.add_argument(
+        "--serve-frame-floats", type=int, default=16 * 1024,
+        help="blob length (floats) served in the Rx serve leg (~64KB)",
+    )
+    ap.add_argument(
+        "--serve-seconds", type=float, default=1.2,
+        help="duration of each server's frames/sec sub-leg",
+    )
+    ap.add_argument(
+        "--serve-leg", action="store_true",
+        help="(internal) run only the Rx serve leg in this process",
+    )
+    ap.add_argument(
+        "--skip-serve", action="store_true",
+        help="skip the Rx serve leg (threaded vs reactor)",
+    )
+    ap.add_argument(
+        "--confirm-timeout", type=float, default=DEAD_CONFIRM_TIMEOUT_S,
+        help="capped single-probe timeout once the backend dead-streak "
+        "has tripped (the cheap re-confirmation instead of the full "
+        "probe budget)",
+    )
     args = ap.parse_args()
 
     if args.device_leg:
@@ -705,6 +882,10 @@ def main() -> None:
     if args.wire_leg:
         sweep = bench_wire(args.wire_size, args.wire_iters)
         print("WIRE_SWEEP " + json.dumps(sweep), flush=True)
+        return
+    if args.serve_leg:
+        res = bench_serve(args.serve_frame_floats, args.serve_seconds)
+        print("SERVE_LEG " + json.dumps(res), flush=True)
         return
 
     # --- TCP baseline.  Subprocess pinned to the CPU backend: the transport
@@ -773,6 +954,51 @@ def main() -> None:
                     f"medians {spans.get('stage_median_ms')}"
                 )
 
+    # --- Rx serve leg (ISSUE 10): threaded vs reactor frames/sec +
+    # held-connection capacity sweep, in the same scrubbed CPU subprocess
+    # pattern (the server modules import numpy/flowctl only, but the
+    # transport package __init__ touches jax).  Runs BEFORE the backend
+    # probe so a dead tunnel's probe budget never starves it of wall time.
+    serve = None
+    if not args.skip_serve:
+        log(
+            f"serve leg: frame={args.serve_frame_floats * 4 / 1024:.0f}KB "
+            f"x{args.serve_seconds:.1f}s, sweep {list(SERVE_SWEEP)} ..."
+        )
+        serve_cmd = [
+            sys.executable, os.path.abspath(__file__), "--serve-leg",
+            "--serve-frame-floats", str(args.serve_frame_floats),
+            "--serve-seconds", str(args.serve_seconds),
+        ]
+        try:
+            proc = subprocess.run(
+                serve_cmd, capture_output=True, text=True,
+                timeout=args.device_timeout, env=cpu_env,
+            )
+            sys.stderr.write(proc.stderr or "")
+            if proc.returncode != 0:
+                log(f"serve leg failed rc={proc.returncode}")
+            else:
+                for line in proc.stdout.splitlines():
+                    if line.startswith("SERVE_LEG "):
+                        serve = json.loads(line.split(None, 1)[1])
+        except subprocess.TimeoutExpired:
+            log(f"serve leg HUNG past {args.device_timeout:.0f}s — killed")
+        except json.JSONDecodeError:
+            log("serve leg produced an unparseable SERVE_LEG line")
+        if serve is not None:
+            sv = serve.get("servers", {})
+            thr = sv.get("threaded", {})
+            rx = sv.get("reactor", {})
+            log(
+                "serve leg: reactor "
+                f"{rx.get('frames_per_s')} f/s vs threaded "
+                f"{thr.get('frames_per_s')} f/s; capacity "
+                f"{rx.get('capacity_conns')} vs "
+                f"{thr.get('capacity_conns')} held conns "
+                f"({serve.get('capacity_ratio')}x)"
+            )
+
     # --- Backend probe, then the watchdog'd device leg with CPU fallback.
     # A fresh cached verdict (artifacts/backend_verdict.json) skips the
     # probe entirely — reruns inside the freshness window go straight to
@@ -798,12 +1024,12 @@ def main() -> None:
             # stale-verdict path used to re-burn every ~12h round.
             log(
                 f"backend dead {streak} consecutive probe(s) — single "
-                f"{DEAD_CONFIRM_TIMEOUT_S:.0f}s confirmation probe, "
+                f"{args.confirm_timeout:.0f}s confirmation probe, "
                 "no retry (DPWA_BENCH_REPROBE=1 for a full probe)"
             )
             platform, _hung = probe_backend(
                 min(
-                    DEAD_CONFIRM_TIMEOUT_S,
+                    args.confirm_timeout,
                     args.probe_timeout,
                     args.probe_budget,
                 )
@@ -896,6 +1122,8 @@ def main() -> None:
     }
     if wire_sweep is not None:
         out["wire_sweep"] = wire_sweep
+    if serve is not None:
+        out["serve"] = serve
 
     # A live run that could only reach CPU does not erase a chip number the
     # round DID capture: experiments/chip_watch.py re-probes the wedge-prone

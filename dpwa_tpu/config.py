@@ -42,6 +42,11 @@ Schema::
                                 #   t+1's partner fetch streams while round
                                 #   t's decode/screen/merge runs; payloads
                                 #   that straddle a local publish re-screen
+      rx_server: threaded       # threaded (thread-per-connection Rx) |
+                                #   reactor (single-threaded selectors
+                                #   event loop, docs/transport.md; wire
+                                #   behavior identical, chaos still
+                                #   forces the threaded server)
     interpolation:
       type: constant            # constant | clock | loss
       factor: 0.5               # constant alpha (0.5 == (local+remote)/2)
@@ -164,6 +169,10 @@ Schema::
       degrade_shed_fraction: 0.5  # fraction of rounds deterministically
                                 #   remapped away from a DEGRADED partner
       max_connections: 32       # serving: global concurrent-conn cap
+                                #   (threaded Rx: bounds worker threads)
+      reactor_max_connections: 1024  # serving cap under rx_server:
+                                #   reactor — a connection there costs a
+                                #   registered socket, not a thread
       token_rate: 100.0         # serving: requests/s refill per remote
       token_burst: 200.0        # serving: token bucket depth per remote
       max_inflight_bytes: 268435456  # serving: payload bytes in flight
@@ -303,6 +312,15 @@ class ProtocolConfig:
     # re-screened against the fresh replica before merging.  Off by
     # default: the sequential path is the bit-identity reference.
     overlap_prefetch: bool = False
+    # Which Rx server serves this node's published frames (TCP
+    # transport).  "threaded" is the thread-per-connection PeerServer;
+    # "reactor" is the single-threaded selectors event loop
+    # (dpwa_tpu/parallel/reactor.py, docs/transport.md) whose admitted
+    # connections cost a registered socket instead of a worker thread —
+    # the large-N serving path.  Wire behavior is byte-identical.
+    # chaos.enabled still forces the threaded chaos wrapper: fault
+    # injection needs per-connection control of a blocking serve loop.
+    rx_server: str = "threaded"
 
     def __post_init__(self) -> None:
         if not 0.0 <= self.fetch_probability <= 1.0:
@@ -338,6 +356,8 @@ class ProtocolConfig:
                 f"pool_size must be >= 1 (or null for auto), "
                 f"got {self.pool_size}"
             )
+        if self.rx_server not in ("threaded", "reactor"):
+            raise ValueError(f"unknown rx_server {self.rx_server!r}")
 
     def resolved_pool_size(self, n_peers: int) -> int:
         """The random-schedule pool size in effect for ``n_peers``."""
@@ -870,6 +890,11 @@ class FlowctlConfig:
     degrade_shed_fraction: float = 0.5
     # Serving-side admission.
     max_connections: int = 32
+    # Connection cap in effect under ``protocol.rx_server: reactor``:
+    # the threaded cap bounds worker THREADS, the reactor's bounds
+    # registered sockets (a few KB each), so it defaults 32× higher.
+    # Every other admission knob is shared between the two servers.
+    reactor_max_connections: int = 1024
     token_rate: float = 100.0
     token_burst: float = 200.0
     max_inflight_bytes: int = 1 << 28
@@ -906,6 +931,11 @@ class FlowctlConfig:
         if self.max_connections < 1:
             raise ValueError(
                 f"max_connections must be >= 1, got {self.max_connections}"
+            )
+        if self.reactor_max_connections < 1:
+            raise ValueError(
+                f"reactor_max_connections must be >= 1, "
+                f"got {self.reactor_max_connections}"
             )
         if self.token_rate <= 0:
             raise ValueError(

@@ -211,6 +211,11 @@ class MetricsLogger:
         - ``disagreement_rms`` / ``disagreement_rel`` / ``sketch_peers``
           — the obs plane's sketch-based ring-disagreement estimate
           (present only when ``obs.sketch`` is on);
+        - ``reactor_loop_lag_ms`` / ``reactor_ready_depth`` /
+          ``reactor_open`` / ``reactor_evicted`` /
+          ``reactor_busy_shed`` — the reactor Rx scheduler's loop and
+          connection accounting (present only under
+          ``protocol.rx_server: reactor``);
 
         plus attempt/success/quarantine counters.  Obeys ``every`` like
         every other record; written immediately (health snapshots are
@@ -286,6 +291,19 @@ class MetricsLogger:
                     overlap_prefetched=overlap.get("prefetched"),
                     overlap_straddled=overlap.get("straddled"),
                 )
+        reactor = snapshot.get("reactor")
+        if reactor is not None:
+            # Reactor scheduler columns (absent under the threaded Rx
+            # server, keeping those records byte-identical): the event
+            # loop's saturation signal plus its connection accounting.
+            extra = dict(
+                extra,
+                reactor_loop_lag_ms=reactor.get("loop_lag_ms"),
+                reactor_ready_depth=reactor.get("ready_depth"),
+                reactor_open=reactor.get("open"),
+                reactor_evicted=reactor.get("evicted"),
+                reactor_busy_shed=reactor.get("busy_shed"),
+            )
         obs = snapshot.get("obs")
         if obs is not None:
             # Observability columns (absent without the obs plane,

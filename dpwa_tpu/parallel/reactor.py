@@ -1,0 +1,822 @@
+"""Reactor-core Rx server: one event-loop thread serves every peer.
+
+:class:`ReactorPeerServer` is the ``selectors``-based replacement for
+the thread-per-connection :class:`~dpwa_tpu.parallel.tcp.PeerServer`,
+selected by ``protocol.rx_server: reactor``.  Ring size under the
+threaded server is capped by thread explosion long before wire
+bandwidth matters — every admitted connection costs a worker thread —
+while here an admitted connection costs one registered socket plus a
+small state machine, so a single process can serve 256-peer rings
+(ROADMAP: fleet / sharded-gossip scale) without spawning anything.
+
+Wire behavior is byte-for-byte the threaded server's: the reactor
+reuses ``tcp.py``'s frame builders and the frozen constants in
+:mod:`~dpwa_tpu.parallel.protocol_constants` (the wire-freeze checker
+keeps it that way), so old fetchers cannot tell the servers apart.
+
+Per-connection state machine (one-shot protocol — request in, one
+framed response out, close)::
+
+    REQ ── DPWA? ──────────────────────────▶ WRITE (blob | DPWB busy)
+     │──── DPWA@ ──▶ STATE_BODY ───────────▶ WRITE (one DPWS chunk)
+     │──── DPWA! ──▶ RELAY_BODY ─▶ RELAY_HOST ─▶ RELAY_WAIT ─▶ WRITE
+     └──── anything else ──────────────────▶ close (garbage request)
+
+Each readable/writable callback runs the plane-hook pipeline the
+threaded handler ran inline: decode (frame grammar above) → flowctl
+admission (token bucket + connection cap at accept, in-flight-bytes
+ceiling at serve, DPWB shed on refusal) → membership digest / trust
+screen (both ride the published frame: the transport bakes the DPWM /
+DPWT trailers into the payload at publish time, so serving them is the
+same buffered write) → serve/merge handoff (the one-shot response).
+Token buckets, busy shedding, and slow-loris eviction thereby become
+*scheduler* decisions: a hashed timer wheel holds every connection's
+effective deadline — ``base + ingested_bytes * per_byte`` during the
+request read, idle-refreshed during writes — and the loop evicts
+expired connections instead of each worker thread policing its own
+socket timeout.
+
+Threads: the event loop itself, plus ONE helper thread for relay
+probes (``DPWA!`` asks us to synchronously probe a third peer, up to
+``MAX_RELAY_TIMEOUT_MS`` of blocking the loop cannot afford); probe
+completions post back through a queue and a self-pipe wakeup.  That is
+O(1) threads regardless of ring size, vs O(connections) threaded.
+
+The eventual zero-copy landing zone is ``native/rx_server.cpp`` — the
+same reactor shape with the GIL out of the serve path entirely (see
+docs/transport.md).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import queue
+import selectors
+import socket
+import threading
+import time
+import zlib
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from dpwa_tpu.config import FlowctlConfig
+from dpwa_tpu.flowctl import AdmissionController
+from dpwa_tpu.health.detector import Outcome
+
+# The threaded server's module owns the frame builders and the aliases
+# into protocol_constants; reusing them (never re-deriving) is what
+# makes "byte-for-byte identical responses" true by construction.
+from dpwa_tpu.parallel import tcp as _tcp
+
+# Connection phases (strings, compared by identity in the hot loop).
+_PH_REQ = "req"
+_PH_STATE_BODY = "state_body"
+_PH_RELAY_BODY = "relay_body"
+_PH_RELAY_HOST = "relay_host"
+_PH_RELAY_WAIT = "relay_wait"
+_PH_WRITE = "write"
+
+# Phases whose deadline expiry counts as a slow-loris EVICTION (the
+# threaded server's note_eviction fires only for the request/STATE-body
+# read; a stalled relay body or write lands in its silent OSError
+# path).  Keeping that split keeps flowctl counters identical.
+_INGEST_PHASES = (_PH_REQ, _PH_STATE_BODY)
+
+_ACCEPT_BATCH = 64  # accepts drained per readiness event
+_RECV_CHUNK = 65536
+_SHED_TIMEOUT_S = 0.5  # budget for the best-effort DPWB busy reply
+_RELAY_SLACK_S = 5.0  # queue slack on top of the clamped probe budget
+
+
+class _Conn:
+    """One accepted connection's state machine (loop-thread only)."""
+
+    __slots__ = (
+        "sock", "host", "admitted", "phase", "inbuf", "need", "outbuf",
+        "sent", "base_deadline", "deadline", "per_byte", "ingested",
+        "write_timeout", "reserved", "is_blob", "trace_id", "t0",
+        "relay", "seq", "slot", "closed",
+    )
+
+    def __init__(self, sock: socket.socket, host: str, admitted: bool):
+        self.sock = sock
+        self.host = host
+        self.admitted = admitted
+        self.phase = _PH_REQ
+        self.inbuf = bytearray()
+        self.need = len(_tcp._REQ)
+        self.outbuf: Optional[memoryview] = None
+        self.sent = 0
+        self.base_deadline = 0.0
+        self.deadline = 0.0
+        self.per_byte = 0.0
+        self.ingested = 0
+        self.write_timeout = 0.0
+        self.reserved = 0  # bytes held against the in-flight ceiling
+        self.is_blob = False
+        self.trace_id: Optional[str] = None
+        self.t0 = 0.0
+        self.relay: Optional[Tuple[int, int, int]] = None
+        self.seq = 0
+        self.slot = -1  # timer-wheel slot, -1 = not filed
+        self.closed = False
+
+
+class _TimerWheel:
+    """Hashed timer wheel with lazy re-filing.
+
+    Connections are filed by ``deadline // granularity`` modulo the
+    slot count; a slot firing re-checks each member's CURRENT deadline
+    and re-files the not-yet-due (deadlines refreshed by ingest/write
+    progress never have to touch the wheel on the hot path — the stale
+    entry is corrected when its slot comes around)."""
+
+    def __init__(self, granularity: float = 0.05, nslots: int = 128):
+        self.granularity = granularity
+        self.nslots = nslots
+        self.slots: List[set] = [set() for _ in range(nslots)]
+        self.tick = 0  # next absolute tick to process
+
+    def start(self, now: float) -> None:
+        self.tick = int(now / self.granularity)
+
+    def file(self, conn: _Conn, min_tick: Optional[int] = None) -> None:
+        idx = max(
+            int(conn.deadline / self.granularity),
+            self.tick if min_tick is None else min_tick,
+        )
+        slot = idx % self.nslots
+        if conn.slot == slot:
+            return
+        self.unfile(conn)
+        conn.slot = slot
+        self.slots[slot].add(conn)
+
+    def unfile(self, conn: _Conn) -> None:
+        if conn.slot >= 0:
+            self.slots[conn.slot].discard(conn)
+            conn.slot = -1
+
+    def expired(self, now: float) -> List[_Conn]:
+        out: List[_Conn] = []
+        target = int(now / self.granularity)
+        while self.tick <= target:
+            slot = self.slots[self.tick % self.nslots]
+            if slot:
+                for conn in list(slot):
+                    if conn.deadline <= now:
+                        slot.discard(conn)
+                        conn.slot = -1
+                        out.append(conn)
+                    else:
+                        # Refreshed or far-future (wrapped) deadline.
+                        # Re-file STRICTLY AFTER the tick being
+                        # processed: its member snapshot is already
+                        # taken, so landing back in it would defer the
+                        # deadline a full wheel revolution.
+                        slot.discard(conn)
+                        conn.slot = -1
+                        self.file(conn, min_tick=self.tick + 1)
+            self.tick += 1
+        return out
+
+
+class ReactorPeerServer:
+    """Single-threaded event-loop Rx server (``protocol.rx_server:
+    reactor``).  Public surface mirrors :class:`tcp.PeerServer`:
+    ``publish`` / ``publish_state`` / ``close`` / ``port`` /
+    ``admission`` / ``relay_guard`` / ``obs_serve_hook``."""
+
+    # Same optional hooks as the threaded server (docs there).
+    relay_guard = None
+    obs_serve_hook = None
+
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        flowctl: Optional[FlowctlConfig] = None,
+    ):
+        self._lock = threading.Lock()
+        self._payload: Optional[bytes] = None  # pre-framed header+data
+        self._payload_trace_id: Optional[str] = None
+        self._state: Optional[bytes] = None
+        self._state_gen = 0
+        self.flowctl = flowctl if flowctl is not None else FlowctlConfig()
+        if self.flowctl.enabled:
+            # Same admission semantics as threaded, but the connection
+            # cap is lifted to reactor_max_connections: the threaded
+            # cap bounds worker THREADS, this one bounds registered
+            # sockets.  Token pacing, the in-flight-bytes ceiling, and
+            # eviction accounting are shared knob-for-knob.
+            self.admission: Optional[AdmissionController] = (
+                AdmissionController(
+                    dataclasses.replace(
+                        self.flowctl,
+                        max_connections=(
+                            self.flowctl.reactor_max_connections
+                        ),
+                    )
+                )
+            )
+        else:
+            self.admission = None
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self.port = self._sock.getsockname()[1]
+        # Deep backlog: a 256-peer ring round-start is an accept BURST,
+        # and unlike the threaded server the loop drains it in batches
+        # rather than one thread spawn at a time.
+        self._sock.listen(256)
+        self._sock.setblocking(False)
+        self._sel = selectors.DefaultSelector()
+        # Self-pipe: the relay worker (and close()) nudge the sleeping
+        # selector awake without waiting out its poll granularity.
+        self._wake_r, self._wake_w = socket.socketpair()
+        self._wake_r.setblocking(False)
+        self._relay_jobs: queue.SimpleQueue = queue.SimpleQueue()
+        self._relay_done: queue.SimpleQueue = queue.SimpleQueue()
+        self._relay_pending: Dict[int, _Conn] = {}  # loop thread only
+        self._relay_seq = itertools.count(1)
+        self._wheel = _TimerWheel()
+        self._stats_lock = threading.Lock()
+        self._stats = {
+            "accepted": 0,
+            "open": 0,
+            "peak_open": 0,
+            "evicted": 0,
+            "busy_shed": 0,
+            "frames": 0,
+            "relay_pending": 0,
+            "loop_lag_ms": 0.0,
+            "ready_depth": 0,
+        }
+        self._stop = threading.Event()
+        self._relay_thread = threading.Thread(
+            target=self._relay_worker,
+            name=f"dpwa-rx-relay:{self.port}",
+            daemon=True,
+        )
+        self._relay_thread.start()
+        self._thread = threading.Thread(
+            target=self._run,
+            name=f"dpwa-rx-reactor:{self.port}",
+            daemon=True,
+        )
+        self._thread.start()
+
+    # --- publish surface (identical to the threaded server) ---
+
+    def publish(
+        self,
+        vec: np.ndarray,
+        clock: float,
+        loss: float,
+        code: Optional[int] = None,
+        digest: Optional[bytes] = None,
+        obs: Optional[bytes] = None,
+        trace_id: Optional[str] = None,
+    ) -> None:
+        payload = _tcp._frame(vec, clock, loss, code, digest, obs)
+        with self._lock:
+            self._payload = payload
+            self._payload_trace_id = trace_id
+
+    def publish_state(self, blob: bytes) -> None:
+        with self._lock:
+            self._state = bytes(blob)
+            self._state_gen = (self._state_gen + 1) & 0xFFFFFFFF
+
+    # --- observability surface ---
+
+    def reactor_snapshot(self) -> dict:
+        """JSON-ready scheduler state: the payload behind the
+        ``dpwa_reactor_*`` gauges, healthz's ``reactor`` sub-document,
+        and metrics' ``reactor_*`` columns."""
+        with self._stats_lock:
+            s = dict(self._stats)
+        return {
+            "open": s["open"],
+            "peak_open": s["peak_open"],
+            "accepted": s["accepted"],
+            "evicted": s["evicted"],
+            "busy_shed": s["busy_shed"],
+            "frames": s["frames"],
+            "relay_pending": s["relay_pending"],
+            "loop_lag_ms": round(s["loop_lag_ms"], 3),
+            "ready_depth": s["ready_depth"],
+        }
+
+    def close(self) -> None:
+        self._stop.set()
+        self._relay_jobs.put(None)  # unpark the relay worker
+        try:
+            self._wake_w.send(b"\0")  # unpark the selector
+        except OSError:
+            pass
+        self._thread.join(timeout=2.0)
+        self._relay_thread.join(timeout=2.0)
+        for sock in (self._sock, self._wake_w, self._wake_r):
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    # --- event loop ---
+
+    # dpwalint: thread_root(reactor)
+    def _run(self) -> None:
+        sel = self._sel
+        try:
+            sel.register(self._sock, selectors.EVENT_READ, None)
+            sel.register(self._wake_r, selectors.EVENT_READ, "wake")
+        except (OSError, ValueError):
+            return
+        self._wheel.start(time.monotonic())
+        granularity = self._wheel.granularity
+        while not self._stop.is_set():
+            try:
+                events = sel.select(granularity)
+            except OSError:
+                break
+            t0 = time.monotonic()
+            depth = 0
+            for key, mask in events:
+                data = key.data
+                if data is None:
+                    self._on_accept(t0)
+                elif data == "wake":
+                    self._drain_wake()
+                else:
+                    depth += 1
+                    self._on_event(data, mask)
+            self._drain_relay_done()
+            now = time.monotonic()
+            for conn in self._wheel.expired(now):
+                self._on_deadline(conn, now)
+            # Loop lag = time this iteration spent processing its ready
+            # batch; under an overloaded loop it grows toward the poll
+            # period and beyond, which is the saturation signal.
+            lag_ms = (time.monotonic() - t0) * 1000.0
+            with self._stats_lock:
+                st = self._stats
+                st["loop_lag_ms"] += 0.1 * (lag_ms - st["loop_lag_ms"])
+                st["ready_depth"] = depth
+        self._shutdown()
+
+    def _shutdown(self) -> None:
+        for key in list(self._sel.get_map().values()):
+            if isinstance(key.data, _Conn):
+                self._close_conn(key.data)
+        try:
+            self._sel.close()
+        except (OSError, RuntimeError):
+            pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def _drain_wake(self) -> None:
+        try:
+            while self._wake_r.recv(4096):
+                pass
+        except (BlockingIOError, InterruptedError):
+            pass
+        except OSError:
+            pass
+
+    # --- accept + admission (plane hook #1: flowctl) ---
+
+    def _on_accept(self, now: float) -> None:
+        for _ in range(_ACCEPT_BATCH):
+            try:
+                sock, addr = self._sock.accept()
+            except (BlockingIOError, InterruptedError):
+                return
+            except OSError:
+                return
+            host = addr[0] if addr else ""
+            try:
+                sock.setblocking(False)
+            except OSError:
+                continue
+            if self.admission is not None:
+                ok, retry_ms = self.admission.admit(host)
+                if not ok:
+                    self._shed(sock, host, retry_ms, now)
+                    continue
+            conn = _Conn(sock, host, admitted=self.admission is not None)
+            fc = self.flowctl
+            conn.base_deadline = now + fc.request_timeout_ms / 1000.0
+            conn.deadline = conn.base_deadline
+            conn.write_timeout = fc.request_timeout_ms / 1000.0
+            if fc.enabled and fc.min_ingest_bytes_per_s > 0:
+                conn.per_byte = 1.0 / fc.min_ingest_bytes_per_s
+            if not self._register(conn, selectors.EVENT_READ):
+                continue
+            with self._stats_lock:
+                st = self._stats
+                st["accepted"] += 1
+                st["open"] += 1
+                st["peak_open"] = max(st["peak_open"], st["open"])
+
+    def _shed(
+        self, sock: socket.socket, host: str, retry_ms: int, now: float
+    ) -> None:
+        """Busy-shed an unadmitted connection: queue the tiny DPWB
+        frame as a normal write (best-effort, short budget) — the
+        threaded server's _shed with the blocking send replaced by the
+        scheduler."""
+        conn = _Conn(sock, host, admitted=False)
+        conn.phase = _PH_WRITE
+        conn.outbuf = memoryview(_tcp._busy_frame(retry_ms))
+        conn.write_timeout = _SHED_TIMEOUT_S
+        conn.deadline = now + _SHED_TIMEOUT_S
+        if not self._register(conn, selectors.EVENT_WRITE):
+            return
+        with self._stats_lock:
+            st = self._stats
+            st["busy_shed"] += 1
+            st["open"] += 1
+            st["peak_open"] = max(st["peak_open"], st["open"])
+        self._on_writable(conn)  # common case: one immediate send
+
+    def _register(self, conn: _Conn, mask: int) -> bool:
+        try:
+            self._sel.register(conn.sock, mask, conn)
+        except (OSError, ValueError, KeyError):
+            try:
+                conn.sock.close()
+            except OSError:
+                pass
+            if conn.admitted and self.admission is not None:
+                self.admission.release(conn.host)
+            return False
+        self._wheel.file(conn)
+        return True
+
+    # --- readiness dispatch ---
+
+    def _on_event(self, conn: _Conn, mask: int) -> None:
+        if conn.closed:
+            return
+        if mask & selectors.EVENT_READ:
+            self._on_readable(conn)
+        if not conn.closed and mask & selectors.EVENT_WRITE:
+            self._on_writable(conn)
+
+    def _on_readable(self, conn: _Conn) -> None:
+        try:
+            data = conn.sock.recv(_RECV_CHUNK)
+        except (BlockingIOError, InterruptedError):
+            return
+        except OSError:
+            self._close_conn(conn)
+            return
+        if not data:
+            # EOF: mid-request it is the client abandoning us; during
+            # RELAY_WAIT it means nobody is left to answer.
+            self._close_conn(conn)
+            return
+        if conn.phase == _PH_WRITE or conn.phase == _PH_RELAY_WAIT:
+            # Bytes past the request are ignored, exactly like the
+            # threaded handler that simply never reads them.
+            return
+        conn.ingested += len(data)
+        conn.inbuf += data
+        now = time.monotonic()
+        if conn.per_byte > 0.0 and conn.phase in _INGEST_PHASES:
+            # Slow-loris discipline (flowctl): cumulative deadline
+            # extended per byte at the minimum ingest rate — the
+            # reactor form of _recv_exact's accounting.
+            conn.deadline = (
+                conn.base_deadline + conn.ingested * conn.per_byte
+            )
+        else:
+            # No flowctl (or a relay body): plain idle timeout,
+            # refreshed on progress — the threaded socket timeout.
+            conn.deadline = now + conn.write_timeout
+        self._advance(conn, now)
+
+    def _advance(self, conn: _Conn, now: float) -> None:
+        """Run the decode pipeline as far as the buffered bytes allow
+        (plane hook #2: frame grammar decode + dispatch)."""
+        while not conn.closed and len(conn.inbuf) >= conn.need:
+            if conn.phase == _PH_REQ:
+                req = bytes(conn.inbuf[: conn.need])
+                del conn.inbuf[: conn.need]
+                if req == _tcp._REQ:
+                    self._serve_blob(conn, now)
+                    return
+                if req == _tcp._STATE_REQ:
+                    conn.phase = _PH_STATE_BODY
+                    conn.need = _tcp._STATE_REQ_BODY.size
+                    continue
+                if req == _tcp._RELAY_REQ:
+                    conn.phase = _PH_RELAY_BODY
+                    conn.need = _tcp._RELAY_BODY.size
+                    continue
+                # Garbage request: close, same as the threaded return.
+                self._close_conn(conn)
+                return
+            if conn.phase == _PH_STATE_BODY:
+                body = bytes(conn.inbuf[: conn.need])
+                del conn.inbuf[: conn.need]
+                offset, max_chunk = _tcp._STATE_REQ_BODY.unpack(body)
+                self._serve_state(conn, offset, max_chunk, now)
+                return
+            if conn.phase == _PH_RELAY_BODY:
+                body = bytes(conn.inbuf[: conn.need])
+                del conn.inbuf[: conn.need]
+                target, port, timeout_ms, hostlen = (
+                    _tcp._RELAY_BODY.unpack(body)
+                )
+                conn.relay = (int(target), int(port), int(timeout_ms))
+                if hostlen:
+                    conn.phase = _PH_RELAY_HOST
+                    conn.need = int(hostlen)
+                    continue
+                self._start_relay(conn, "127.0.0.1", now)
+                return
+            if conn.phase == _PH_RELAY_HOST:
+                raw = bytes(conn.inbuf[: conn.need])
+                del conn.inbuf[: conn.need]
+                self._start_relay(
+                    conn, raw.decode("ascii", "replace"), now
+                )
+                return
+            return
+
+    # --- serve handoff (plane hook #5) ---
+
+    def _serve_blob(self, conn: _Conn, now: float) -> None:
+        """Queue the published frame (header + payload + optional DPWM
+        digest + DPWT obs trailers, baked in at publish time — plane
+        hooks #3/#4 ride the buffer) under the in-flight ceiling."""
+        with self._lock:
+            payload = self._payload
+            trace_id = self._payload_trace_id
+        if payload is None:
+            self._close_conn(conn)  # nothing published yet: clean EOF
+            return
+        adm = self.admission
+        if adm is not None and not adm.reserve_bytes(len(payload)):
+            self._queue_busy(conn, self.flowctl.busy_retry_ms, now)
+            return
+        conn.reserved = len(payload)
+        conn.is_blob = True
+        conn.trace_id = trace_id
+        conn.t0 = now
+        self._queue_write(conn, payload, now)
+
+    def _queue_busy(self, conn: _Conn, retry_ms: int, now: float) -> None:
+        with self._stats_lock:
+            self._stats["busy_shed"] += 1
+        self._queue_write(conn, _tcp._busy_frame(retry_ms), now)
+
+    def _serve_state(
+        self, conn: _Conn, offset: int, max_chunk: int, now: float
+    ) -> None:
+        """One DPWS chunk per connection — byte-identical to the
+        threaded _handle_state (empty blob = well-formed total=0)."""
+        with self._lock:
+            blob = self._state if self._state is not None else b""
+            gen = self._state_gen
+        total = len(blob)
+        off = min(max(offset, 0), total)
+        n = min(max(max_chunk, 0), total - off, _tcp._MAX_STATE_CHUNK)
+        chunk = blob[off : off + n]
+        header = _tcp._STATE_HDR.pack(
+            _tcp._STATE_MAGIC, 1, gen, total, off, len(chunk),
+            zlib.crc32(chunk),
+        )
+        self._queue_write(conn, header + chunk, now)
+
+    # --- relay probes (the one blocking verb, offloaded) ---
+
+    def _start_relay(self, conn: _Conn, host: str, now: float) -> None:
+        target, port, timeout_ms = conn.relay
+        timeout_ms = min(max(timeout_ms, 1), _tcp._MAX_RELAY_TIMEOUT_MS)
+        guard = self.relay_guard
+        if guard is not None and guard(target):
+            self._relay_reply(conn, Outcome.REFUSED, None, now)
+            return
+        conn.phase = _PH_RELAY_WAIT
+        # EVENT_READ stays registered: an EOF while we probe means the
+        # requester is gone and the answer can be dropped.
+        conn.seq = next(self._relay_seq)
+        self._relay_pending[conn.seq] = conn
+        conn.deadline = now + timeout_ms / 1000.0 + _RELAY_SLACK_S
+        self._wheel.file(conn)
+        with self._stats_lock:
+            self._stats["relay_pending"] += 1
+        self._relay_jobs.put((conn.seq, host, port, timeout_ms))
+
+    def _relay_worker(self) -> None:
+        """The single relay helper thread: blocking header probes run
+        here so the loop never does; completions post back via queue +
+        self-pipe."""
+        while True:
+            job = self._relay_jobs.get()
+            if job is None:
+                return
+            seq, host, port, timeout_ms = job
+            try:
+                outcome, clock = _tcp.probe_header_classified(
+                    host, port, timeout_ms
+                )
+            except Exception:
+                outcome, clock = None, None  # loop closes the conn
+            self._relay_done.put((seq, outcome, clock))
+            try:
+                self._wake_w.send(b"\0")
+            except OSError:
+                return
+
+    def _drain_relay_done(self) -> None:
+        while True:
+            try:
+                seq, outcome, clock = self._relay_done.get_nowait()
+            except queue.Empty:
+                return
+            with self._stats_lock:
+                self._stats["relay_pending"] -= 1
+            conn = self._relay_pending.pop(seq, None)
+            if conn is None or conn.closed:
+                continue
+            if outcome is None:
+                self._close_conn(conn)
+                continue
+            self._relay_reply(conn, outcome, clock, time.monotonic())
+
+    def _relay_reply(
+        self,
+        conn: _Conn,
+        outcome: Outcome,
+        clock: Optional[float],
+        now: float,
+    ) -> None:
+        frame = _tcp._RELAY_HDR.pack(
+            _tcp._RELAY_MAGIC,
+            1,
+            _tcp._RELAY_OUTCOMES.index(outcome),
+            float(clock) if clock is not None else -1.0,
+        )
+        self._queue_write(conn, frame, now)
+
+    # --- buffered writes ---
+
+    def _queue_write(self, conn: _Conn, data: bytes, now: float) -> None:
+        conn.phase = _PH_WRITE
+        conn.outbuf = memoryview(data)
+        conn.sent = 0
+        conn.deadline = now + conn.write_timeout
+        self._wheel.file(conn)
+        try:
+            self._sel.modify(conn.sock, selectors.EVENT_WRITE, conn)
+        except (OSError, ValueError, KeyError):
+            self._close_conn(conn)
+            return
+        self._on_writable(conn)  # short responses finish in one call
+
+    def _on_writable(self, conn: _Conn) -> None:
+        buf = conn.outbuf
+        if buf is None:
+            return
+        progressed = False
+        while conn.sent < len(buf):
+            try:
+                n = conn.sock.send(buf[conn.sent :])
+            except (BlockingIOError, InterruptedError):
+                break
+            except OSError:
+                self._close_conn(conn)
+                return
+            if n <= 0:
+                break
+            conn.sent += n
+            progressed = True
+        if conn.sent >= len(buf):
+            if conn.is_blob:
+                with self._stats_lock:
+                    self._stats["frames"] += 1
+            # One-shot protocol: response out, connection done (the
+            # close also releases reserved bytes and fires the serve
+            # span hook, the threaded worker's ``finally``).
+            self._close_conn(conn)
+            return
+        if progressed:
+            # A draining peer keeps its connection; a stalled one hits
+            # the unrefreshed deadline on the wheel.
+            conn.deadline = time.monotonic() + conn.write_timeout
+
+    # --- deadlines + teardown ---
+
+    def _on_deadline(self, conn: _Conn, now: float) -> None:
+        if conn.closed or conn.deadline > now:
+            return
+        evict = (
+            conn.phase in _INGEST_PHASES and self.flowctl.enabled
+        )
+        if evict and self.admission is not None:
+            # Slow-loris eviction: identical accounting to the
+            # threaded socket.timeout → note_eviction path.
+            self.admission.note_eviction()
+        self._close_conn(conn, timed_out=True)
+
+    def _close_conn(self, conn: _Conn, timed_out: bool = False) -> None:
+        if conn.closed:
+            return
+        conn.closed = True
+        self._wheel.unfile(conn)
+        if conn.seq:
+            self._relay_pending.pop(conn.seq, None)
+        try:
+            self._sel.unregister(conn.sock)
+        except (OSError, ValueError, KeyError):
+            pass
+        try:
+            conn.sock.close()
+        except OSError:
+            pass
+        adm = self.admission
+        if conn.reserved and adm is not None:
+            adm.release_bytes(conn.reserved)
+        hook = self.obs_serve_hook
+        if conn.is_blob and hook is not None and conn.trace_id is not None:
+            try:
+                hook(
+                    conn.trace_id,
+                    conn.reserved,
+                    time.monotonic() - conn.t0,
+                )
+            except Exception:
+                pass  # observability must never break a serve
+        if conn.admitted and adm is not None:
+            adm.release(conn.host)
+        with self._stats_lock:
+            st = self._stats
+            st["open"] -= 1
+            if timed_out:
+                st["evicted"] += 1
+
+
+def register_metrics(registry, server: ReactorPeerServer) -> None:
+    """Expose the reactor scheduler on /metrics as ``dpwa_reactor_*``."""
+    from dpwa_tpu.obs.prometheus import Family
+
+    def _collect():
+        snap = server.reactor_snapshot()
+        return [
+            Family(
+                "dpwa_reactor_loop_lag_ms",
+                "gauge",
+                "EWMA of event-loop iteration processing time.",
+            ).sample(snap["loop_lag_ms"]),
+            Family(
+                "dpwa_reactor_ready_depth",
+                "gauge",
+                "Ready connections dispatched in the last iteration.",
+            ).sample(snap["ready_depth"]),
+            Family(
+                "dpwa_reactor_open_connections",
+                "gauge",
+                "Connections currently registered with the loop.",
+            ).sample(snap["open"]),
+            Family(
+                "dpwa_reactor_peak_connections",
+                "gauge",
+                "High-water mark of concurrently open connections.",
+            ).sample(snap["peak_open"]),
+            Family(
+                "dpwa_reactor_accepted_total",
+                "counter",
+                "Connections admitted past flowctl at accept.",
+            ).sample(snap["accepted"]),
+            Family(
+                "dpwa_reactor_evicted_total",
+                "counter",
+                "Connections closed by a timer-wheel deadline.",
+            ).sample(snap["evicted"]),
+            Family(
+                "dpwa_reactor_busy_shed_total",
+                "counter",
+                "DPWB busy frames sent (admission + byte-ceiling sheds).",
+            ).sample(snap["busy_shed"]),
+            Family(
+                "dpwa_reactor_frames_served_total",
+                "counter",
+                "Published blob frames fully written to a peer.",
+            ).sample(snap["frames"]),
+            Family(
+                "dpwa_reactor_relay_pending",
+                "gauge",
+                "Relay probes in flight on the helper thread.",
+            ).sample(snap["relay_pending"]),
+        ]
+
+    registry.register(_collect)

@@ -1494,6 +1494,17 @@ class TcpTransport:
                 spec.host, spec.port, self._chaos_engine,
                 flowctl=config.flowctl,
             )
+        elif config.protocol.rx_server == "reactor":
+            # Single-threaded event-loop Rx (docs/transport.md): same
+            # wire bytes and admission semantics as PeerServer, with
+            # the connection cap lifted to flowctl.reactor_max_
+            # connections.  Deferred import: reactor.py imports this
+            # module for the frame builders.
+            from dpwa_tpu.parallel.reactor import ReactorPeerServer
+
+            self.server = ReactorPeerServer(
+                spec.host, spec.port, flowctl=config.flowctl
+            )
         elif (
             config.recovery.enabled
             or config.flowctl.enabled
@@ -1512,10 +1523,14 @@ class TcpTransport:
             self.server = make_peer_server(
                 spec.host, spec.port, flowctl=config.flowctl
             )
-        if self.tracer is not None and isinstance(self.server, PeerServer):
-            # Serve-side spans: only the Python Rx server can time its
-            # sends (obs.trace forces it above).  Under chaos the serve
-            # path bypasses _serve_blob, so chaos runs trace the fetcher
+        if self.tracer is not None and hasattr(
+            self.server, "obs_serve_hook"
+        ):
+            # Serve-side spans: only the Python Rx servers (threaded
+            # PeerServer and the reactor — both expose the hook attr)
+            # can time their sends (obs.trace forces them above).
+            # Under chaos the serve path bypasses _serve_blob and the
+            # wrapper has no hook, so chaos runs trace the fetcher
             # side only.
             self.server.obs_serve_hook = self.tracer.note_serve
         self._ports = {
@@ -2366,6 +2381,11 @@ class TcpTransport:
                     }
                 )
             snap["flowctl"] = fsnap
+        reactor_snap = getattr(self.server, "reactor_snapshot", None)
+        if reactor_snap is not None:
+            # Present exactly when the reactor serves this node, so
+            # threaded runs keep their health records byte-identical.
+            snap["reactor"] = reactor_snap()
         if self._wire_topk or self._prefetch_on:
             # Gated on the new planes being ON: a dense sequential run
             # keeps its health records byte-identical to PR 5.
@@ -2476,6 +2496,12 @@ class TcpTransport:
             )
 
             _reg_adm(registry, admission)
+        if hasattr(self.server, "reactor_snapshot"):
+            from dpwa_tpu.parallel.reactor import (
+                register_metrics as _reg_reactor,
+            )
+
+            _reg_reactor(registry, self.server)
 
         def _wire():
             snap = self.wire_snapshot()

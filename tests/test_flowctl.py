@@ -37,6 +37,26 @@ from dpwa_tpu.parallel.tcp import (
     fetch_blob_full,
     probe_header_classified,
 )
+from dpwa_tpu.parallel.reactor import ReactorPeerServer
+
+# Serving-side shed/evict semantics must hold on BOTH Rx servers
+# (protocol.rx_server switch, docs/transport.md).  The reactor enforces
+# its own connection cap (reactor_max_connections), so tests pinning a
+# tiny cap mirror it onto both fields.
+_RX_SERVERS = pytest.mark.parametrize(
+    "rx", ["threaded", "reactor"]
+)
+
+
+def make_server(rx, flowctl):
+    if rx == "reactor":
+        import dataclasses
+
+        flowctl = dataclasses.replace(
+            flowctl, reactor_max_connections=flowctl.max_connections
+        )
+        return ReactorPeerServer("127.0.0.1", 0, flowctl=flowctl)
+    return PeerServer("127.0.0.1", 0, flowctl=flowctl)
 
 
 def make_ring(n, **cfg_kwargs):
@@ -321,10 +341,10 @@ def test_probe_header_classifies_busy():
 # ---------------------------------------------------------------------------
 
 
-def test_server_sheds_busy_at_the_connection_cap():
-    srv = PeerServer(
-        "127.0.0.1", 0,
-        flowctl=FlowctlConfig(max_connections=1, request_timeout_ms=3000),
+@_RX_SERVERS
+def test_server_sheds_busy_at_the_connection_cap(rx):
+    srv = make_server(
+        rx, FlowctlConfig(max_connections=1, request_timeout_ms=3000)
     )
     try:
         srv.publish(np.arange(8, dtype=np.float32), 1.0, 0.5)
@@ -358,12 +378,11 @@ def test_server_sheds_busy_at_the_connection_cap():
         srv.close()
 
 
-def test_server_evicts_slow_loris_request():
-    srv = PeerServer(
-        "127.0.0.1", 0,
-        flowctl=FlowctlConfig(
-            request_timeout_ms=300, min_ingest_bytes_per_s=1e6
-        ),
+@_RX_SERVERS
+def test_server_evicts_slow_loris_request(rx):
+    srv = make_server(
+        rx,
+        FlowctlConfig(request_timeout_ms=300, min_ingest_bytes_per_s=1e6),
     )
     try:
         srv.publish(np.arange(8, dtype=np.float32), 1.0, 0.5)
@@ -384,10 +403,10 @@ def test_server_evicts_slow_loris_request():
         srv.close()
 
 
-def test_server_sheds_blob_past_inflight_bytes_ceiling():
-    srv = PeerServer(
-        "127.0.0.1", 0,
-        flowctl=FlowctlConfig(max_inflight_bytes=16),  # smaller than a frame
+@_RX_SERVERS
+def test_server_sheds_blob_past_inflight_bytes_ceiling(rx):
+    srv = make_server(
+        rx, FlowctlConfig(max_inflight_bytes=16)  # smaller than a frame
     )
     try:
         srv.publish(np.arange(64, dtype=np.float32), 1.0, 0.5)
@@ -684,10 +703,9 @@ def test_fuzzed_frames_are_always_classified_within_budget():
             srv.close()
 
 
-def test_fuzzed_requests_never_kill_the_server():
-    srv = PeerServer(
-        "127.0.0.1", 0, flowctl=FlowctlConfig(request_timeout_ms=300)
-    )
+@_RX_SERVERS
+def test_fuzzed_requests_never_kill_the_server(rx):
+    srv = make_server(rx, FlowctlConfig(request_timeout_ms=300))
     rng = np.random.default_rng(0xBEEF)
     try:
         srv.publish(np.arange(8, dtype=np.float32), 1.0, 0.5)

@@ -50,6 +50,7 @@ from dpwa_tpu.membership.digest import (
     header_entry_count,
 )
 from dpwa_tpu.metrics import MetricsLogger
+from dpwa_tpu.parallel.reactor import ReactorPeerServer
 from dpwa_tpu.parallel.tcp import (
     _HDR,
     PeerServer,
@@ -61,6 +62,13 @@ from dpwa_tpu.parallel.tcp import (
     probe_header,
     probe_header_classified,
     relay_probe,
+)
+
+# The digest trailer and relay verb must read identically off both Rx
+# servers (protocol.rx_server switch, docs/transport.md).
+_RX_SERVERS = pytest.mark.parametrize(
+    "server_cls", [PeerServer, ReactorPeerServer],
+    ids=["threaded", "reactor"],
 )
 
 
@@ -348,7 +356,8 @@ def test_would_quarantine_predicts_threshold_crossing():
 # ---------------------------------------------------------------------------
 
 
-def test_frame_without_digest_still_parses():
+@_RX_SERVERS
+def test_frame_without_digest_still_parses(server_cls):
     """Regression: pre-membership frames (no trailer) must stay fully
     readable, including by a digest-wanting reader."""
     srv = PeerServer("127.0.0.1", 0)
@@ -368,7 +377,8 @@ def test_frame_without_digest_still_parses():
         srv.close()
 
 
-def test_frame_with_digest_is_backward_compatible():
+@_RX_SERVERS
+def test_frame_with_digest_is_backward_compatible(server_cls):
     """A digest-carrying frame reads identically through every OLD
     reader (fetch_blob / fetch_blob_ex / probe_header ignore the
     trailer), and the new reader recovers the exact digest bytes."""
@@ -425,7 +435,8 @@ def test_truncate_fault_cuts_the_vector_not_the_trailer():
 # ---------------------------------------------------------------------------
 
 
-def test_relay_probe_vouches_for_live_target():
+@_RX_SERVERS
+def test_relay_probe_vouches_for_live_target(server_cls):
     target = PeerServer("127.0.0.1", 0)
     relay = PeerServer("127.0.0.1", 0)
     try:
@@ -442,7 +453,8 @@ def test_relay_probe_vouches_for_live_target():
         relay.close()
 
 
-def test_relay_probe_reports_dead_target():
+@_RX_SERVERS
+def test_relay_probe_reports_dead_target(server_cls):
     relay = PeerServer("127.0.0.1", 0)
     try:
         relay_outcome, probe_outcome, clock = relay_probe(
@@ -456,7 +468,8 @@ def test_relay_probe_reports_dead_target():
         relay.close()
 
 
-def test_relay_guard_refuses_blocked_targets():
+@_RX_SERVERS
+def test_relay_guard_refuses_blocked_targets(server_cls):
     """A partitioned relay must not vouch across the split: the guard
     hook answers REFUSED without probing."""
     target = PeerServer("127.0.0.1", 0)

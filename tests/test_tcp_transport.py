@@ -13,6 +13,16 @@ from dpwa_tpu.parallel.tcp import (
     fetch_blob,
     make_peer_server,
 )
+from dpwa_tpu.parallel.reactor import ReactorPeerServer
+
+# Core transport semantics must hold on both Rx servers — the threaded
+# thread-per-connection PeerServer and the event-loop reactor behind the
+# ``protocol.rx_server`` switch (docs/transport.md).
+_RX_SERVERS = pytest.mark.parametrize(
+    "server_cls", [PeerServer, ReactorPeerServer],
+    ids=["threaded", "reactor"],
+)
+_RX_CONFIGS = pytest.mark.parametrize("rx", ["threaded", "reactor"])
 
 
 def test_native_rx_server_parity_with_python_server():
@@ -103,8 +113,9 @@ def close_all(ts):
         t.close()
 
 
-def test_publish_fetch_roundtrip():
-    server = PeerServer("127.0.0.1", 0)
+@_RX_SERVERS
+def test_publish_fetch_roundtrip(server_cls):
+    server = server_cls("127.0.0.1", 0)
     try:
         vec = np.arange(1000, dtype=np.float32)
         server.publish(vec, clock=7.0, loss=0.25)
@@ -117,8 +128,9 @@ def test_publish_fetch_roundtrip():
         server.close()
 
 
-def test_fetch_before_publish_returns_none_payload_safely():
-    server = PeerServer("127.0.0.1", 0)
+@_RX_SERVERS
+def test_fetch_before_publish_returns_none_payload_safely(server_cls):
+    server = server_cls("127.0.0.1", 0)
     try:
         # Nothing published yet: the Rx thread sends nothing and the client
         # times out cleanly instead of crashing.
@@ -134,8 +146,9 @@ def test_fetch_dead_peer_times_out():
     assert got is None
 
 
-def test_publish_overwrites():
-    server = PeerServer("127.0.0.1", 0)
+@_RX_SERVERS
+def test_publish_overwrites(server_cls):
+    server = server_cls("127.0.0.1", 0)
     try:
         server.publish(np.zeros(4, np.float32), 0, 0)
         server.publish(np.ones(4, np.float32), 1, 0)
@@ -146,8 +159,9 @@ def test_publish_overwrites():
         server.close()
 
 
-def test_float64_and_bf16_roundtrip():
-    server = PeerServer("127.0.0.1", 0)
+@_RX_SERVERS
+def test_float64_and_bf16_roundtrip(server_cls):
+    server = server_cls("127.0.0.1", 0)
     try:
         vec = np.linspace(0, 1, 17, dtype=np.float64)
         server.publish(vec, 0, 0)
@@ -158,8 +172,9 @@ def test_float64_and_bf16_roundtrip():
         server.close()
 
 
-def test_two_peer_lockstep_exchange_is_half_merge():
-    ts = make_ring(2, factor=0.5)
+@_RX_CONFIGS
+def test_two_peer_lockstep_exchange_is_half_merge(rx):
+    ts = make_ring(2, factor=0.5, rx_server=rx)
     try:
         # Nonzero on both sides: an all-zero replica served to a nonzero
         # peer is now rejected as zero-energy (recovery guard).
@@ -202,8 +217,9 @@ def test_exchange_survives_dead_partner():
         ts[0].close()
 
 
-def test_four_peer_ring_concurrent_exchange():
-    ts = make_ring(4, schedule="ring")
+@_RX_CONFIGS
+def test_four_peer_ring_concurrent_exchange(rx):
+    ts = make_ring(4, schedule="ring", rx_server=rx)
     try:
         # 1-based values: an all-zero replica would be rejected as
         # zero-energy by the recovery guard's norm-ratio floor.

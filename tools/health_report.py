@@ -40,6 +40,12 @@ ratio, the number of sparse (top-k) fetches consumed, and — when the
 prefetch pipeline contributed — the overlap occupancy and
 hidden-fetch-fraction trajectory.
 
+``--reactor`` prints the reactor Rx scheduler digest
+(docs/transport.md): the event-loop lag trajectory (final/max EWMA ms),
+the deepest ready batch, the open-connection high-water mark, and the
+timer-wheel eviction / busy-shed totals — present only for runs under
+``protocol.rx_server: reactor``.
+
 Usage::
 
     python tools/health_report.py metrics.jsonl [more.jsonl ...]
@@ -48,6 +54,7 @@ Usage::
     python tools/health_report.py --trust metrics.jsonl
     python tools/health_report.py --flowctl metrics.jsonl
     python tools/health_report.py --wire metrics.jsonl
+    python tools/health_report.py --reactor metrics.jsonl
 """
 
 from __future__ import annotations
@@ -156,6 +163,16 @@ def summarize(
         "hidden_frac_final": None,
         "prefetched": None,
         "straddled": None,
+    }
+
+    reactor: Dict[str, Any] = {
+        "seen": False,  # any reactor_* column in the records
+        "loop_lag_final_ms": None,
+        "loop_lag_max_ms": None,  # worst EWMA seen = saturation mark
+        "ready_depth_max": None,
+        "open_max": None,
+        "evicted_final": None,
+        "busy_shed_final": None,
     }
 
     membership: Dict[str, Any] = {
@@ -348,6 +365,29 @@ def summarize(
                     )
                     wire["prefetched"] = rec.get("overlap_prefetched")
                     wire["straddled"] = rec.get("overlap_straddled")
+            lag = rec.get("reactor_loop_lag_ms")
+            if lag is not None:
+                reactor["seen"] = True
+                reactor["loop_lag_final_ms"] = lag
+                if (
+                    reactor["loop_lag_max_ms"] is None
+                    or lag > reactor["loop_lag_max_ms"]
+                ):
+                    reactor["loop_lag_max_ms"] = lag
+                depth = rec.get("reactor_ready_depth")
+                if depth is not None and (
+                    reactor["ready_depth_max"] is None
+                    or depth > reactor["ready_depth_max"]
+                ):
+                    reactor["ready_depth_max"] = depth
+                opened = rec.get("reactor_open")
+                if opened is not None and (
+                    reactor["open_max"] is None
+                    or opened > reactor["open_max"]
+                ):
+                    reactor["open_max"] = opened
+                reactor["evicted_final"] = rec.get("reactor_evicted")
+                reactor["busy_shed_final"] = rec.get("reactor_busy_shed")
             continue
         if "outcome" not in rec and "sched_partner" not in rec:
             continue  # not an exchange record (loss-only, etc.)
@@ -415,6 +455,7 @@ def summarize(
         "trust": trust,
         "flowctl": flowctl,
         "wire": wire,
+        "reactor": reactor,
     }
 
 
@@ -514,6 +555,28 @@ def _print_wire(summary: Dict[str, Any]) -> None:
             f"prefetched {w.get('prefetched')} rounds "
             f"({w.get('straddled')} straddled a local publish)"
         )
+
+
+def _print_reactor(summary: Dict[str, Any]) -> None:
+    r = summary.get("reactor", {})
+    print()
+    print("# reactor")
+    if not r.get("seen"):
+        print(
+            "  no reactor records in input (threaded rx_server, or the "
+            "reactor columns predate this run?)"
+        )
+        return
+    print(
+        f"  loop lag (EWMA ms): final {r.get('loop_lag_final_ms')}, "
+        f"max {r.get('loop_lag_max_ms')}; ready-batch depth max "
+        f"{r.get('ready_depth_max')}"
+    )
+    print(
+        f"  connections: open max {r.get('open_max')}; evicted "
+        f"{r.get('evicted_final')}; busy frames shed "
+        f"{r.get('busy_shed_final')}"
+    )
 
 
 def _print_table(summary: Dict[str, Any]) -> None:
@@ -667,6 +730,13 @@ def main(argv=None) -> int:
         help="print the wire-plane digest (publishing codec, compression "
         "ratio, sparse fetch counts, prefetch overlap occupancy)",
     )
+    ap.add_argument(
+        "--reactor",
+        action="store_true",
+        help="print the reactor Rx scheduler digest (event-loop lag, "
+        "ready-batch depth, connection highs, evictions, busy sheds; "
+        "docs/transport.md)",
+    )
     args = ap.parse_args(argv)
     summary = summarize(args.paths, split_step=args.split_step)
     if args.json:
@@ -680,6 +750,8 @@ def main(argv=None) -> int:
             _print_flowctl(summary)
         if args.wire:
             _print_wire(summary)
+        if args.reactor:
+            _print_reactor(summary)
     return 0
 
 

@@ -115,6 +115,13 @@ _HEALTH_GROUPS: Dict[str, Dict[str, tuple]] = {
         "disagreement_rel": _NUM + (type(None),),
         "sketch_peers": (int,),
     },
+    "reactor": {
+        "reactor_loop_lag_ms": _NUM,
+        "reactor_ready_depth": (int,),
+        "reactor_open": (int,),
+        "reactor_evicted": (int,),
+        "reactor_busy_shed": (int,),
+    },
 }
 
 _TRACE_ROUND_REQUIRED: Dict[str, tuple] = {
