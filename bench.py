@@ -324,8 +324,40 @@ def bench_device(d: int, n_peers: int, iters: int) -> float:
     return n_peers * 2 * d * 4 / per_iter / 1e9
 
 
-def bench_tcp(d: int, iters: int, timeout_ms: int = 10000) -> float:
-    """Reference-equivalent baseline: 2 peers, localhost TCP, CPU merge."""
+TCP_LEG_CPU_BUDGET = 2
+
+
+def pin_cpu_budget(n: int = TCP_LEG_CPU_BUDGET) -> bool:
+    """Pin THIS process to a fixed budget of ``n`` CPUs.
+
+    The TCP baseline is the denominator of ``vs_baseline``, and an
+    unpinned leg wanders with scheduler placement (two transport
+    threads plus the interpreter migrating across a big box produce
+    run-to-run swings far larger than any real transport change).  The
+    leg runs in its own subprocess (``--tcp-leg``), so the pin cannot
+    leak into the device legs.  Returns True when the budget is in
+    effect; False on platforms without ``sched_setaffinity``."""
+    try:
+        cpus = sorted(os.sched_getaffinity(0))
+    except (AttributeError, OSError):
+        return False
+    if len(cpus) <= n:
+        return True  # already at or below budget
+    try:
+        os.sched_setaffinity(0, set(cpus[:n]))
+    except OSError:
+        return False
+    return True
+
+
+def bench_tcp(
+    d: int, iters: int, timeout_ms: int = 10000, repeats: int = 3
+) -> float:
+    """Reference-equivalent baseline: 2 peers, localhost TCP, CPU merge.
+
+    Runs ``repeats`` independent measurement passes of ``iters``
+    exchanges each and reports the median of the per-pass medians —
+    one noisy pass (GC, a cron wakeup) cannot drag the headline."""
     from dpwa_tpu.config import make_local_config
     from dpwa_tpu.parallel.tcp import TcpTransport
 
@@ -346,31 +378,103 @@ def bench_tcp(d: int, iters: int, timeout_ms: int = 10000) -> float:
         for i, t in enumerate(ts):
             t.exchange(vecs[i], 0, 0, 0)
 
-        durations = []
-        for it in range(iters):
-            for i, t in enumerate(ts):
-                t.publish(vecs[i], it, 0)
-            results = [None, None]
+        medians = []
+        for rep in range(max(1, repeats)):
+            durations = []
+            for it in range(iters):
+                step = 1 + rep * iters + it
+                for i, t in enumerate(ts):
+                    t.publish(vecs[i], step, 0)
+                results = [None, None]
 
-            def run(i):
-                results[i] = ts[i].exchange(vecs[i], it, 0, 0)
+                def run(i):
+                    results[i] = ts[i].exchange(vecs[i], step, 0, 0)
 
-            t0 = time.perf_counter()
-            threads = [
-                threading.Thread(target=run, args=(i,)) for i in range(2)
-            ]
-            for th in threads:
-                th.start()
-            for th in threads:
-                th.join()
-            durations.append(time.perf_counter() - t0)
-            assert results[0][1] != 0.0, "TCP exchange failed"
-        dt = float(np.median(durations))
+                t0 = time.perf_counter()
+                threads = [
+                    threading.Thread(target=run, args=(i,))
+                    for i in range(2)
+                ]
+                for th in threads:
+                    th.start()
+                for th in threads:
+                    th.join()
+                durations.append(time.perf_counter() - t0)
+                assert results[0][1] != 0.0, "TCP exchange failed"
+            medians.append(float(np.median(durations)))
+        dt = float(np.median(medians))
         # Per peer per exchange: receive d*4 bytes + write the merge d*4.
         return 2 * d * 4 / dt / 1e9
     finally:
         for t in ts:
             t.close()
+
+
+TCP_GATE_WINDOW = 8
+TCP_GATE_REL_TOL = 0.5
+
+
+def tcp_gate(
+    history: list,
+    current_gbps,
+    window: int = TCP_GATE_WINDOW,
+    rel_tol: float = TCP_GATE_REL_TOL,
+) -> dict:
+    """Regression gate for the TCP baseline (pure; tests/test_fleet.py).
+
+    ``history`` is the parsed ``artifacts/bench_history.jsonl`` entries;
+    the gate takes the last ``window`` runs that recorded a live
+    ``tcp_baseline_gbps``, medians them, and classifies the current
+    measurement against a symmetric relative band.  The verdict is
+    recorded in the output (not a hard failure): a "regressed" TCP
+    baseline silently *inflates* ``vs_baseline``, so the 21x-127x
+    headline is only trusted when the gate says "ok"."""
+    samples = [
+        float(e["tcp_baseline_gbps"])
+        for e in history
+        if isinstance(e, dict)
+        and e.get("record") == "bench"
+        and isinstance(e.get("tcp_baseline_gbps"), (int, float))
+        and not isinstance(e.get("tcp_baseline_gbps"), bool)
+    ][-int(window):]
+    median = float(np.median(samples)) if samples else None
+    gate = {
+        "samples": len(samples),
+        "window": int(window),
+        "rel_tol": float(rel_tol),
+        "median_gbps": round(median, 3) if median is not None else None,
+        "current_gbps": (
+            round(float(current_gbps), 3)
+            if current_gbps is not None else None
+        ),
+    }
+    if current_gbps is None or len(samples) < 2:
+        gate["verdict"] = "no_data"
+        return gate
+    cur = float(current_gbps)
+    if cur < median * (1.0 - rel_tol):
+        gate["verdict"] = "regressed"
+    elif cur > median * (1.0 + rel_tol):
+        gate["verdict"] = "improved"
+    else:
+        gate["verdict"] = "ok"
+    return gate
+
+
+def read_bench_history(path: str, max_lines: int = 512) -> list:
+    """Parse the tail of ``bench_history.jsonl``; [] when absent."""
+    entries: list = []
+    try:
+        with open(path, encoding="utf-8") as f:
+            lines = f.readlines()[-max_lines:]
+    except OSError:
+        return entries
+    for ln in lines:
+        try:
+            entries.append(json.loads(ln))
+        except json.JSONDecodeError:
+            continue
+    return entries
 
 
 WIRE_SWEEP_CODECS = (
@@ -803,6 +907,11 @@ def main() -> None:
     )
     ap.add_argument("--tcp-iters", type=int, default=5)
     ap.add_argument(
+        "--tcp-repeats", type=int, default=3,
+        help="independent TCP-leg measurement passes; the reported "
+        "baseline is the median of the per-pass medians",
+    )
+    ap.add_argument(
         "--tcp-size", type=int, default=0,
         help="TCP vector length (defaults to --size)",
     )
@@ -876,7 +985,13 @@ def main() -> None:
         print(f"DEVICE_GBPS {gbps:.6f}", flush=True)
         return
     if args.tcp_leg:
-        gbps = bench_tcp(args.tcp_size or args.size, args.tcp_iters)
+        pinned = pin_cpu_budget(TCP_LEG_CPU_BUDGET)
+        if not pinned:
+            log("tcp leg: CPU pinning unavailable; baseline is unpinned")
+        gbps = bench_tcp(
+            args.tcp_size or args.size, args.tcp_iters,
+            repeats=args.tcp_repeats,
+        )
         print(f"TCP_GBPS {gbps:.6f}", flush=True)
         return
     if args.wire_leg:
@@ -904,7 +1019,11 @@ def main() -> None:
     )
     tcp_gbps = run_leg(
         "--tcp-leg",
-        ["--tcp-size", str(tcp_d), "--tcp-iters", str(args.tcp_iters)],
+        [
+            "--tcp-size", str(tcp_d),
+            "--tcp-iters", str(args.tcp_iters),
+            "--tcp-repeats", str(args.tcp_repeats),
+        ],
         "TCP_GBPS", args.device_timeout, cpu_env,
     )
     if tcp_gbps is not None:
@@ -1198,15 +1317,27 @@ def main() -> None:
                 "first_alive_utc": first_alive,
             }
 
+    # TCP-baseline regression gate (against runs BEFORE this one): a
+    # drifting denominator silently inflates vs_baseline, so every run
+    # records where today's baseline sits against the recent medians.
+    history_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "artifacts", "bench_history.jsonl",
+    )
+    out["tcp_gate"] = tcp_gate(read_bench_history(history_path), tcp_gbps)
+    if out["tcp_gate"]["verdict"] not in ("ok", "no_data"):
+        log(
+            f"tcp gate: baseline {out['tcp_gate']['verdict']} "
+            f"(current {out['tcp_gate']['current_gbps']} vs median "
+            f"{out['tcp_gate']['median_gbps']} GB/s) — vs_baseline is "
+            "suspect this run"
+        )
+
     print(json.dumps(out), flush=True)
 
     # Cumulative history: one line per run so the perf trajectory is
     # machine-readable across PRs (schema: record="bench" envelope,
     # payload = this run's parsed result, tools/schema_check.py).
-    history_path = os.path.join(
-        os.path.dirname(os.path.abspath(__file__)),
-        "artifacts", "bench_history.jsonl",
-    )
     try:
         os.makedirs(os.path.dirname(history_path), exist_ok=True)
         with open(history_path, "a", encoding="utf-8") as f:

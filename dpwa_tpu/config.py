@@ -124,6 +124,11 @@ Schema::
       relay_timeout_ms: 250     # budget per relay probe round-trip
       dead_after_quarantines: 3 # declare a peer dead after this many
                                 #   consecutive failed re-admissions
+      dead_gossip_rounds: 16    # disseminate a dead claim this many
+                                #   rounds, then EVICT the peer's
+                                #   per-peer state (scoreboard, trust,
+                                #   flowctl) and drop it from the digest
+                                #   until it refutes (0 = never evict)
       quorum_fraction: 0.5      # degraded mode when the connected
                                 #   component falls below this fraction
       degraded_alpha_scale: 1.0 # damp interpolation alpha while degraded
@@ -691,6 +696,14 @@ class MembershipConfig:
     # probes is disseminated as ``dead`` (still probed locally — dead is
     # a gossip label, not a tombstone).
     dead_after_quarantines: int = 3
+    # Churn hardening (docs/fleet.md): a peer the combined view holds
+    # DEAD for this many further rounds is *evicted* — its scoreboard /
+    # trust / flowctl per-peer state is pruned, it leaves the membership
+    # digest (bounding digest growth under heavy join/leave), and the
+    # partner remap never draws it.  A rejoiner refutes the dead claim
+    # with a fresher incarnation and is re-admitted from scratch.
+    # 0 disables eviction (legacy unbounded behavior).
+    dead_gossip_rounds: int = 16
     # Degraded mode when |connected component| / n_peers falls BELOW
     # this fraction (strictly below: a 2-node ring losing one peer sits
     # exactly at 0.5 and is a peer failure, not a partition).
@@ -724,6 +737,11 @@ class MembershipConfig:
             raise ValueError(
                 f"dead_after_quarantines must be >= 1, "
                 f"got {self.dead_after_quarantines}"
+            )
+        if self.dead_gossip_rounds < 0:
+            raise ValueError(
+                f"dead_gossip_rounds must be >= 0, "
+                f"got {self.dead_gossip_rounds}"
             )
         if not 0.0 <= self.quorum_fraction <= 1.0:
             raise ValueError(

@@ -1,0 +1,30 @@
+"""Elastic-fleet churn orchestration (docs/fleet.md).
+
+Deterministic churn schedules (:mod:`~dpwa_tpu.fleet.schedule`) driven
+over real per-node control planes (:mod:`~dpwa_tpu.fleet.orchestrator`):
+continuous joins/leaves, autoscale cohort arrivals, rolling restarts,
+and mixed partition+byzantine+straggler chaos windows, emitting the
+frozen-schema ``fleet`` JSONL stream that ``tools/fleet_report.py``
+digests."""
+
+from dpwa_tpu.fleet.orchestrator import (  # noqa: F401
+    EpisodeResult,
+    FleetOrchestrator,
+    SimNode,
+)
+from dpwa_tpu.fleet.schedule import (  # noqa: F401
+    ChaosWindow,
+    ChurnEvents,
+    ChurnSchedule,
+    ChurnSpec,
+)
+
+__all__ = [
+    "ChaosWindow",
+    "ChurnEvents",
+    "ChurnSchedule",
+    "ChurnSpec",
+    "EpisodeResult",
+    "FleetOrchestrator",
+    "SimNode",
+]

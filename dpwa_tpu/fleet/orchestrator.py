@@ -1,0 +1,519 @@
+"""Plane-level elastic-fleet simulator: churn the CONTROL planes at 256.
+
+``tests/fleet_worker.py`` proved one Rx server can feed a 256-peer ring;
+this module proves the *decision planes* survive 256 peers CHURNING.  It
+deliberately simulates the wire (an exchange is a numpy average + an
+Outcome string) while running the REAL control-plane objects per node —
+:class:`~dpwa_tpu.health.scoreboard.Scoreboard`,
+:class:`~dpwa_tpu.membership.manager.MembershipManager` (real digests
+through ``encode``/``merge``), and the observer's
+:class:`~dpwa_tpu.obs.incidents.IncidentPlane` — because those are where
+the O(N)-forever assumptions lived (ROADMAP "Elastic fleet churn").  256
+full TCP transports would measure socket limits; this measures the
+eviction/readmission/digest machinery that PR 11 hardens.
+
+Single-threaded by construction: one loop drives every node in sorted
+peer order, every control decision is a threefry draw keyed on round
+counters (:mod:`dpwa_tpu.fleet.schedule`), and wall time is only ever
+*reported* (``wall_s``) — never consulted — so the churn record stream
+is bit-identical across reruns of a seed.
+
+Emits the frozen-schema ``fleet`` JSONL stream (tools/schema_check.py):
+
+- ``kind: churn`` — one per non-quiet round; deterministic fields only
+  (the bit-identity anchor tests replay);
+- ``kind: round`` — one per round; adds measured fields (``wall_s``,
+  ``rel_rms``) that vary run to run;
+- ``kind: episode`` — one per run; convergence + incident summary
+  (``tools/fleet_report.py`` joins it with trace/incident streams).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from dpwa_tpu.config import (
+    ChaosConfig,
+    HealthConfig,
+    ObsConfig,
+    MembershipConfig,
+)
+from dpwa_tpu.fleet.schedule import ChurnSchedule, ChurnSpec
+from dpwa_tpu.health.chaos import ChaosEngine
+from dpwa_tpu.health.detector import Outcome
+from dpwa_tpu.health.scoreboard import Scoreboard
+from dpwa_tpu.membership.manager import MembershipManager
+from dpwa_tpu.obs.incidents import IncidentPlane
+from dpwa_tpu.parallel.schedules import Schedule, _ring_pull
+from dpwa_tpu.recovery.bootstrap import choose_donor
+
+
+class SimNode:
+    """One fleet member: a numpy replica plus its real control planes.
+
+    ``boot`` builds FRESH Scoreboard/MembershipManager instances — a
+    rejoiner has no memory of its past life except the monotonically
+    bumped incarnation (which is what lets it refute stale DEAD claims,
+    docs/membership.md)."""
+
+    def __init__(self, peer: int, n_peers: int, seed: int):
+        self.peer = int(peer)
+        self.n_peers = int(n_peers)
+        self.seed = int(seed)
+        self.alive = False
+        self.boots = 0
+        self.next_incarnation = 0
+        self.vec: Optional[np.ndarray] = None
+        self.board: Optional[Scoreboard] = None
+        self.membership: Optional[MembershipManager] = None
+
+    def boot(
+        self,
+        vec: np.ndarray,
+        health: HealthConfig,
+        member: MembershipConfig,
+    ) -> None:
+        self.board = Scoreboard(
+            self.n_peers, self.peer, config=health, seed=self.seed
+        )
+        self.membership = MembershipManager(
+            self.n_peers,
+            self.peer,
+            self.board,
+            config=member,
+            seed=self.seed,
+        )
+        self.membership.incarnation = self.next_incarnation
+        self.next_incarnation += 1
+        self.vec = np.array(vec, dtype=np.float64, copy=True)
+        self.alive = True
+        self.boots += 1
+
+    def stop(self) -> None:
+        """Departure: the process is gone.  The replica is kept frozen
+        (a restarting supervisor may resurrect the box) but the control
+        planes are dropped — a rejoiner gets fresh ones."""
+        self.alive = False
+        self.board = None
+        self.membership = None
+
+
+@dataclasses.dataclass
+class EpisodeResult:
+    """What :meth:`FleetOrchestrator.run` hands back (and logs)."""
+
+    records: List[dict]
+    episode: dict
+
+    @property
+    def churn_records(self) -> List[dict]:
+        return [r for r in self.records if r.get("kind") == "churn"]
+
+
+class FleetOrchestrator:
+    """Drive one elastic-churn episode over ``n_peers`` simulated nodes.
+
+    The observer (``spec.protected[0]``, default peer 0) is never
+    churned; its scoreboard/membership/incident planes are the ones the
+    episode summary reads — one stable vantage point, the way a soak's
+    operator watches one node's /healthz."""
+
+    def __init__(
+        self,
+        n_peers: int,
+        spec: ChurnSpec,
+        dim: int = 32,
+        health: Optional[HealthConfig] = None,
+        membership: Optional[MembershipConfig] = None,
+        chaos: Optional[ChaosConfig] = None,
+        incidents: Optional[ObsConfig] = None,
+        path: Optional[str] = None,
+        initial_live: Optional[int] = None,
+    ):
+        self.n_peers = int(n_peers)
+        self.spec = spec
+        self.seed = int(spec.seed)
+        self.dim = int(dim)
+        self.health = health if health is not None else HealthConfig()
+        self.membership_cfg = (
+            membership if membership is not None else MembershipConfig()
+        )
+        # Fault DRAW probabilities for chaos windows; the window's kind
+        # list gates which draws take effect (schedule.py).
+        self.chaos_cfg = (
+            chaos
+            if chaos is not None
+            else ChaosConfig(
+                enabled=True,
+                seed=self.seed,
+                delay_probability=0.5,
+                throttle_probability=0.25,
+                byzantine_sign_probability=0.3,
+                byzantine_scale_probability=0.2,
+                byzantine_zero_probability=0.1,
+            )
+        )
+        self.schedule = ChurnSchedule(spec, self.n_peers)
+        self.observer = spec.protected[0] if spec.protected else 0
+        self._path = path
+        self._file = (
+            open(path, "a", encoding="utf-8") if path else None
+        )
+        self.records: List[dict] = []
+        # One engine per SERVING peer: fault draws are (seed, round,
+        # server)-keyed, exactly like the wire chaos harness.
+        self._engines = [
+            ChaosEngine(self.chaos_cfg, peer=p)
+            for p in range(self.n_peers)
+        ]
+        # Gossip pairing: the one-sided pull ring the TCP transport uses
+        # (remap_partner gives the health-aware fallback).
+        self._sched = Schedule(
+            pool=np.stack(
+                [_ring_pull(self.n_peers, 0), _ring_pull(self.n_peers, 1)]
+            ),
+            n_peers=self.n_peers,
+            fetch_probability=1.0,
+            seed=self.seed,
+            name="ring",
+            mode="pull",
+        )
+        self.nodes = [
+            SimNode(p, self.n_peers, self.seed)
+            for p in range(self.n_peers)
+        ]
+        n_live = (
+            self.n_peers if initial_live is None else int(initial_live)
+        )
+        for p in range(n_live):
+            self.nodes[p].boot(
+                self._init_vec(p), self.health, self.membership_cfg
+            )
+        inc_cfg = incidents
+        if inc_cfg is None:
+            inc_cfg = ObsConfig()
+        self.incidents = IncidentPlane(
+            self.observer, self.n_peers, inc_cfg, path=None
+        )
+        # Convergence bookkeeping: (event round, peer) -> resolved round.
+        self._leave_pending: Dict[int, int] = {}  # peer -> left round
+        self._join_pending: Dict[int, int] = {}  # peer -> joined round
+        self._leave_convergence: List[int] = []
+        self._join_convergence: List[int] = []
+
+    # ------------------------------------------------------------------
+    # Node lifecycle
+    # ------------------------------------------------------------------
+
+    def _init_vec(self, peer: int) -> np.ndarray:
+        """Deterministic per-peer initial replica (seeded, no wall
+        clock): distinct vectors so rel_rms measures real convergence."""
+        rng = np.random.default_rng([self.seed, peer])
+        return rng.standard_normal(self.dim)
+
+    def _live(self) -> List[int]:
+        return [n.peer for n in self.nodes if n.alive]
+
+    def _departed(self) -> List[int]:
+        return [n.peer for n in self.nodes if not n.alive]
+
+    def _donor_vec(self, joiner: int, round_: int) -> np.ndarray:
+        """Bootstrap the joiner's replica from a deterministically
+        elected live donor (the PR 2 donor draw), falling back to the
+        joiner's frozen/initial replica when nobody can serve."""
+        healthy = [n.alive for n in self.nodes]
+        donor = choose_donor(
+            joiner, self.n_peers, round_, self.seed, healthy
+        )
+        if donor is not None and self.nodes[donor].vec is not None:
+            return self.nodes[donor].vec
+        node = self.nodes[joiner]
+        if node.vec is not None:
+            return node.vec
+        return self._init_vec(joiner)
+
+    def _boot_peer(self, peer: int, round_: int) -> None:
+        self.nodes[peer].boot(
+            self._donor_vec(peer, round_),
+            self.health,
+            self.membership_cfg,
+        )
+        # A rejoin before ring-wide eviction cancels the pending leave:
+        # there is no ghost left to evict, so the departure is no longer
+        # a convergence event (it would otherwise sit "unresolved"
+        # forever and poison the episode summary).
+        self._leave_pending.pop(peer, None)
+        self._join_pending.setdefault(peer, int(round_))
+
+    # ------------------------------------------------------------------
+    # One gossip exchange (plane-level wire)
+    # ------------------------------------------------------------------
+
+    def _blocked(
+        self, src: int, dst: int, group: Tuple[int, ...]
+    ) -> bool:
+        """Whether the active partition window cuts the src<->dst link
+        (links inside either side stay up)."""
+        if not group:
+            return False
+        return (src in group) != (dst in group)
+
+    def _fetch_outcome(
+        self,
+        fetcher: SimNode,
+        target: int,
+        round_: int,
+        chaos_kinds: Tuple[str, ...],
+        group: Tuple[int, ...],
+    ) -> str:
+        """Classify one fetch the way the transport's wire path would."""
+        if self._blocked(fetcher.peer, target, group):
+            return Outcome.TIMEOUT
+        node = self.nodes[target]
+        if not node.alive:
+            return Outcome.TIMEOUT
+        if chaos_kinds:
+            plan = self._engines[target].plan(round_)
+            if "byzantine" in chaos_kinds and plan.byzantine != "none":
+                # The trust plane screens the lying frame: classified
+                # poisoned, payload discarded (docs/trust.md).
+                return Outcome.POISONED
+            if "straggler" in chaos_kinds and (
+                plan.kind in ("delay", "throttle") or plan.stall_s > 0.0
+            ):
+                return Outcome.SLOW
+        return Outcome.SUCCESS
+
+    # ------------------------------------------------------------------
+    # The round loop
+    # ------------------------------------------------------------------
+
+    def run(self, rounds: int) -> EpisodeResult:
+        outcome_totals: Dict[str, int] = {}
+        max_digest = 0
+        max_wall = 0.0
+        alerts_total: Dict[str, int] = {}
+        incidents_opened = 0
+        for r in range(int(rounds)):
+            t0 = time.perf_counter()
+            ev = self.schedule.events(r, self._live(), self._departed())
+            group = self.schedule.partition_group(r)
+            # -- churn application ------------------------------------
+            for p in ev.leaves:
+                self.nodes[p].stop()
+                self._leave_pending.setdefault(p, r)
+                self._join_pending.pop(p, None)
+            for p in ev.joins:
+                self._boot_peer(p, r)
+            for p in ev.cohort:
+                self._boot_peer(p, r)
+            for p in ev.restart:
+                # Rolling restart: down and back within the round, state
+                # restored through the donor path (the supervisor's
+                # crash->bootstrap cycle compressed to one round).
+                self.nodes[p].stop()
+                self._boot_peer(p, r)
+            live = self._live()
+            # -- gossip exchanges -------------------------------------
+            digests: Dict[int, bytes] = {}
+            exchanges = 0
+            failures = 0
+            obs_outcome: Optional[str] = None
+            obs_partner: Optional[int] = None
+            round_outcomes: Dict[str, int] = {}
+            for f in sorted(live):
+                node = self.nodes[f]
+                partner = self._sched.partner(r, f)
+                if partner != f and node.board.is_quarantined(
+                    partner, r
+                ):
+                    partner = self._sched.remap_partner(
+                        r, f, partner, node.board.healthy_mask(r)
+                    )
+                if partner == f:
+                    continue
+                outcome = self._fetch_outcome(
+                    node, partner, r, ev.chaos, group
+                )
+                latency = 0.05 if outcome == Outcome.SLOW else 0.005
+                node.board.record(
+                    partner, outcome, latency_s=latency, round=r
+                )
+                round_outcomes[outcome] = (
+                    round_outcomes.get(outcome, 0) + 1
+                )
+                if outcome in (Outcome.SUCCESS, Outcome.SLOW):
+                    exchanges += 1
+                    node.vec = 0.5 * (
+                        node.vec + self.nodes[partner].vec
+                    )
+                    blob = digests.get(partner)
+                    if blob is None:
+                        blob = digests[partner] = self.nodes[
+                            partner
+                        ].membership.encode(r)
+                        max_digest = max(max_digest, len(blob))
+                    node.membership.merge(blob, r)
+                else:
+                    failures += 1
+                if f == self.observer:
+                    obs_outcome = outcome
+                    obs_partner = partner
+            # -- probes (readmission + evicted-ghost reprobe) ---------
+            for f in sorted(live):
+                node = self.nodes[f]
+                for q in range(self.n_peers):
+                    if q == f or not node.board.probe_due(q, r):
+                        continue
+                    ok = self.nodes[q].alive and not self._blocked(
+                        f, q, group
+                    )
+                    node.board.record_probe(q, ok, round=r)
+            # -- membership round end ---------------------------------
+            for f in sorted(live):
+                self.nodes[f].membership.end_round(r)
+            # -- observer planes --------------------------------------
+            obs = self.nodes[self.observer]
+            obs_events: List[dict] = []
+            for f in sorted(live):
+                events = self.nodes[f].membership.pop_events()
+                if f == self.observer:
+                    obs_events = events
+            rel_rms = self._rel_rms(live)
+            wall = time.perf_counter() - t0
+            max_wall = max(max_wall, wall)
+            view = obs.membership.view_snapshot()
+            inc = self.incidents.observe_round(
+                r,
+                outcome=obs_outcome,
+                peer=obs_partner,
+                board=obs.board.snapshot(r),
+                events=obs_events,
+                rel_rms=rel_rms,
+                wall_s=wall,
+                partition_state=view.get("partition_state"),
+                component=view.get("component"),
+            )
+            for kind in inc["alerts"]:
+                alerts_total[kind] = alerts_total.get(kind, 0) + 1
+            if inc["opened"]:
+                incidents_opened += 1
+            for k, v in sorted(round_outcomes.items()):
+                outcome_totals[k] = outcome_totals.get(k, 0) + v
+            self._settle_convergence(r)
+            # -- records ----------------------------------------------
+            evicted = obs.board.evicted_peers()
+            if not ev.quiet:
+                self._emit(
+                    {
+                        "record": "fleet",
+                        "kind": "churn",
+                        "round": r,
+                        "leaves": list(ev.leaves),
+                        "joins": list(ev.joins),
+                        "cohort": list(ev.cohort),
+                        "restart": list(ev.restart),
+                        "chaos": list(ev.chaos),
+                        "live": len(live),
+                        "evicted": evicted,
+                    }
+                )
+            self._emit(
+                {
+                    "record": "fleet",
+                    "kind": "round",
+                    "round": r,
+                    "live": len(live),
+                    "exchanges": exchanges,
+                    "failures": failures,
+                    "outcomes": dict(sorted(round_outcomes.items())),
+                    "rel_rms": round(rel_rms, 9),
+                    "wall_s": round(wall, 6),
+                    "digest_bytes": max_digest,
+                    "evicted": len(evicted),
+                    "alerts": inc["alerts"],
+                }
+            )
+        episode = self._finish(int(rounds), outcome_totals, max_digest,
+                               max_wall, alerts_total, incidents_opened)
+        return EpisodeResult(records=self.records, episode=episode)
+
+    # ------------------------------------------------------------------
+    # Summaries
+    # ------------------------------------------------------------------
+
+    def _rel_rms(self, live: Sequence[int]) -> float:
+        """Relative RMS disagreement of live replicas (the sketch
+        board's convergence figure, computed exactly here)."""
+        if len(live) < 2:
+            return 0.0
+        vecs = np.stack([self.nodes[p].vec for p in sorted(live)])
+        mean = vecs.mean(axis=0)
+        num = float(np.sqrt(np.mean((vecs - mean) ** 2)))
+        den = float(np.sqrt(np.mean(mean**2))) + 1e-12
+        return num / den
+
+    def _settle_convergence(self, r: int) -> None:
+        """Resolve pending leave/join events against the OBSERVER's
+        view: a leave converges when the observer evicts the ghost, a
+        join when the observer's mask admits the rejoiner."""
+        obs = self.nodes[self.observer]
+        if obs.board is None:
+            return
+        evicted = set(obs.board.evicted_peers())
+        mask = obs.board.healthy_mask(r)
+        for p in sorted(self._leave_pending):
+            if p in evicted:
+                self._leave_convergence.append(r - self._leave_pending[p])
+                del self._leave_pending[p]
+        for p in sorted(self._join_pending):
+            if self.nodes[p].alive and p < len(mask) and mask[p]:
+                self._join_convergence.append(r - self._join_pending[p])
+                del self._join_pending[p]
+
+    def _finish(
+        self,
+        rounds: int,
+        outcome_totals: Dict[str, int],
+        max_digest: int,
+        max_wall: float,
+        alerts_total: Dict[str, int],
+        incidents_opened: int,
+    ) -> dict:
+        live = self._live()
+        obs = self.nodes[self.observer]
+        episode = {
+            "record": "fleet",
+            "kind": "episode",
+            "rounds": rounds,
+            "n_peers": self.n_peers,
+            "seed": self.seed,
+            "final_live": len(live),
+            "final_rel_rms": round(self._rel_rms(live), 9),
+            "outcomes": dict(sorted(outcome_totals.items())),
+            "max_digest_bytes": max_digest,
+            "max_wall_s": round(max_wall, 6),
+            "evicted": obs.board.evicted_peers(),
+            "leave_convergence_rounds": sorted(self._leave_convergence),
+            "join_convergence_rounds": sorted(self._join_convergence),
+            "unresolved_leaves": sorted(self._leave_pending),
+            "unresolved_joins": sorted(self._join_pending),
+            "alerts": dict(sorted(alerts_total.items())),
+            "incidents_opened": incidents_opened,
+        }
+        self._emit(episode)
+        if self._file is not None:
+            self._file.close()
+            self._file = None
+        return episode
+
+    def _emit(self, rec: dict) -> None:
+        self.records.append(rec)
+        if self._file is not None:
+            self._file.write(json.dumps(rec, sort_keys=True) + "\n")
+            self._file.flush()

@@ -1,0 +1,222 @@
+"""Deterministic churn schedules: WHO joins/leaves/restarts WHEN.
+
+A churn schedule is a pure function of ``(seed, round, peer)`` threefry
+draws (:mod:`dpwa_tpu.parallel.schedules` — tags registered in
+:mod:`dpwa_tpu.utils.tags`), so a fixed seed replays the identical
+elasticity episode bit-for-bit, the same counter-based-RNG discipline
+every other control decision in this repo follows.  Four event families
+(docs/fleet.md has the grammar):
+
+- **leaves** — each live peer independently departs with
+  ``leave_probability`` per round (``churn_leave_draw``), floored so the
+  fleet never shrinks below ``min_live``;
+- **joins** — each departed peer independently returns with
+  ``join_probability`` per round (``churn_join_draw``);
+- **cohorts** — every ``cohort_every`` rounds an autoscale-style batch
+  arrival admits up to ``cohort_max`` departed peers at once
+  (``churn_cohort_draw`` sizes the batch);
+- **restarts** — every ``restart_every`` rounds one live peer is
+  rolling-restarted (leave + rejoin in the same round, state restored
+  from a donor — ``churn_restart_draw`` picks the victim).
+
+Plus **chaos windows**: round intervals ``[start, stop)`` during which
+named fault classes (``partition`` / ``byzantine`` / ``straggler``,
+concurrently — the *mixed* windows ROADMAP asks for) are active.  The
+schedule only names the active classes; the orchestrator maps them onto
+:class:`~dpwa_tpu.health.chaos.ChaosEngine` draws.
+
+The draws are keyed on ``(seed, round, peer)`` alone — NOT on the
+evolving live set — so event decisions for any peer can be replayed
+without replaying the whole episode; the live/departed sets merely
+select which draws are consulted.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence, Tuple
+
+from dpwa_tpu.parallel.schedules import (
+    churn_cohort_draw,
+    churn_join_draw,
+    churn_leave_draw,
+    churn_restart_draw,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class ChaosWindow:
+    """Rounds ``[start, stop)`` with the named fault classes active.
+
+    ``kinds`` ⊆ {"partition", "byzantine", "straggler"}; ``group`` is
+    the partition's minority side (peer ids) when "partition" is in
+    ``kinds`` — explicit, so a test can assert exactly which links were
+    cut."""
+
+    start: int
+    stop: int
+    kinds: Tuple[str, ...]
+    group: Tuple[int, ...] = ()
+
+    def active(self, round_: int) -> bool:
+        return self.start <= round_ < self.stop
+
+
+_KNOWN_KINDS = frozenset({"partition", "byzantine", "straggler"})
+
+
+@dataclasses.dataclass(frozen=True)
+class ChurnSpec:
+    """The schedule's knobs (one YAML-able block; docs/fleet.md)."""
+
+    seed: int = 0
+    leave_probability: float = 0.0
+    join_probability: float = 0.0
+    cohort_every: int = 0  # 0 = no cohort arrivals
+    cohort_max: int = 0
+    restart_every: int = 0  # 0 = no rolling restarts
+    min_live: int = 2
+    protected: Tuple[int, ...] = (0,)  # never churned (the observer)
+    chaos_windows: Tuple[ChaosWindow, ...] = ()
+
+    def __post_init__(self) -> None:
+        if not 0.0 <= self.leave_probability <= 1.0:
+            raise ValueError(
+                f"leave_probability must be in [0, 1], "
+                f"got {self.leave_probability}"
+            )
+        if not 0.0 <= self.join_probability <= 1.0:
+            raise ValueError(
+                f"join_probability must be in [0, 1], "
+                f"got {self.join_probability}"
+            )
+        if self.min_live < 1:
+            raise ValueError(f"min_live must be >= 1, got {self.min_live}")
+        for w in self.chaos_windows:
+            unknown = set(w.kinds) - _KNOWN_KINDS
+            if unknown:
+                raise ValueError(
+                    f"unknown chaos window kinds {sorted(unknown)}; "
+                    f"known: {sorted(_KNOWN_KINDS)}"
+                )
+            if "partition" in w.kinds and not w.group:
+                raise ValueError(
+                    "a partition chaos window needs an explicit group"
+                )
+
+
+@dataclasses.dataclass(frozen=True)
+class ChurnEvents:
+    """One round's resolved churn (every field already sorted)."""
+
+    round: int
+    leaves: Tuple[int, ...]
+    joins: Tuple[int, ...]
+    cohort: Tuple[int, ...]
+    restart: Tuple[int, ...]  # () or (peer,)
+    chaos: Tuple[str, ...]  # active fault classes, sorted
+
+    @property
+    def quiet(self) -> bool:
+        return not (
+            self.leaves or self.joins or self.cohort or self.restart
+            or self.chaos
+        )
+
+
+class ChurnSchedule:
+    """Resolve :class:`ChurnSpec` draws against a live/departed split."""
+
+    def __init__(self, spec: ChurnSpec, n_peers: int):
+        self.spec = spec
+        self.n_peers = int(n_peers)
+
+    def partition_group(self, round_: int) -> Tuple[int, ...]:
+        """The minority side of the partition active at ``round_``
+        (empty when none is)."""
+        for w in self.spec.chaos_windows:
+            if w.active(round_) and "partition" in w.kinds:
+                return tuple(sorted(w.group))
+        return ()
+
+    def events(
+        self,
+        round_: int,
+        live: Sequence[int],
+        departed: Sequence[int],
+    ) -> ChurnEvents:
+        """This round's churn given the CURRENT live/departed split.
+
+        Deterministic: iteration is over sorted peer ids and every
+        decision is a threefry draw keyed on ``(seed, round, peer)``."""
+        spec = self.spec
+        protected = set(spec.protected)
+        live_sorted = sorted(live)
+        departed_sorted = sorted(departed)
+
+        leaves = []
+        if spec.leave_probability > 0.0:
+            # The min_live floor caps departures in peer-id order, so
+            # the cap itself is deterministic too.
+            allowed = max(0, len(live_sorted) - spec.min_live)
+            for p in live_sorted:
+                if p in protected or allowed <= 0:
+                    continue
+                if (
+                    float(churn_leave_draw(spec.seed, round_, p))
+                    < spec.leave_probability
+                ):
+                    leaves.append(p)
+                    allowed -= 1
+
+        joins = []
+        if spec.join_probability > 0.0:
+            for p in departed_sorted:
+                if (
+                    float(churn_join_draw(spec.seed, round_, p))
+                    < spec.join_probability
+                ):
+                    joins.append(p)
+
+        cohort = []
+        if (
+            spec.cohort_every > 0
+            and round_ > 0
+            and round_ % spec.cohort_every == 0
+        ):
+            pool = [p for p in departed_sorted if p not in joins]
+            n_max = min(spec.cohort_max, len(pool))
+            k = churn_cohort_draw(spec.seed, round_, n_max)
+            cohort = pool[:k]
+
+        restart = []
+        if (
+            spec.restart_every > 0
+            and round_ > 0
+            and round_ % spec.restart_every == 0
+        ):
+            candidates = [
+                p
+                for p in live_sorted
+                if p not in protected and p not in leaves
+            ]
+            if candidates:
+                idx = churn_restart_draw(spec.seed, round_, len(candidates))
+                restart = [candidates[idx]]
+
+        chaos = sorted(
+            {
+                k
+                for w in spec.chaos_windows
+                if w.active(round_)
+                for k in w.kinds
+            }
+        )
+        return ChurnEvents(
+            round=int(round_),
+            leaves=tuple(leaves),
+            joins=tuple(joins),
+            cohort=tuple(cohort),
+            restart=tuple(restart),
+            chaos=tuple(chaos),
+        )

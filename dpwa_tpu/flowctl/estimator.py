@@ -93,6 +93,14 @@ class DeadlineEstimator:
             self._hedges += 1
             self._peer_counts(peer)["hedges"] += 1
 
+    def evict_peer(self, peer: int) -> None:
+        """Drop ``peer``'s latency window and counters (membership
+        eviction — docs/fleet.md): a rejoiner warms up from scratch
+        under the static ``timeout_ms``, exactly like a cold peer."""
+        with self._lock:
+            self._window.pop(peer, None)
+            self._counts.pop(peer, None)
+
     def note_hedge_win(self, peer: int) -> None:
         """The hedge against ``peer`` won the race (fallback's payload
         merged; ``peer``'s fetch was cancelled and classified slow)."""

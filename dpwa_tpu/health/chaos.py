@@ -42,14 +42,22 @@ schedule-locked to rounds, not to wall time.  Usable from tests
 from __future__ import annotations
 
 import dataclasses
+import selectors
+import socket
+import struct
 import threading
 import time
 from collections import deque
-from typing import Deque, Optional, Tuple
+from typing import Deque, Dict, List, Optional, Tuple
 
 import numpy as np
 
 from dpwa_tpu.config import ChaosConfig
+# Safe at module level: reactor -> tcp -> health.detector/scoreboard
+# never re-enters this module (health/__init__ deliberately does NOT
+# import chaos, and tcp imports chaos lazily inside TcpTransport).
+from dpwa_tpu.parallel.reactor import ReactorPeerServer as _ReactorBase
+from dpwa_tpu.parallel.reactor import _Conn as _ReactorConn
 from dpwa_tpu.parallel.schedules import chaos_draw
 # Fault-kind indices onto the chaos_draw tag space (CHAOS_TAG_BASE + k)
 # are allocated in the central tag registry — collision = import error.
@@ -521,3 +529,303 @@ class ChaosPeerServer:
 
     def close(self) -> None:
         self._srv.close()
+
+
+class _WriteShaper:
+    """Chaos timing for one reactor response, enforced from the event
+    loop — the reactor cannot sleep, so the threaded path's blocking
+    shapes become a per-connection byte-allowance function:
+
+    - a **start gate** (``start_t``): no bytes before it (the threaded
+      accept-delay / delay sleeps);
+    - an optional **mid-frame stall**: burst to the first third, freeze
+      ``stall_s``, then release (the ``slow``-classification shape of
+      :func:`_send_shaped`);
+    - a **linear allowance** at ``bps`` (throttle/trickle pacing — the
+      50 ms chunk cadence of :func:`_send_paced` falls out of the
+      loop's poll granularity).
+
+    Content bytes are NEVER touched here; identity with the threaded
+    path is carried by the shared pure mutators above."""
+
+    __slots__ = ("start_t", "bps", "stall_cut", "stall_s", "stall_until")
+
+    def __init__(
+        self,
+        start_t: float,
+        bps: float = 0.0,
+        stall_cut: int = 0,
+        stall_s: float = 0.0,
+    ):
+        self.start_t = start_t
+        self.bps = bps
+        self.stall_cut = stall_cut
+        self.stall_s = stall_s
+        self.stall_until: Optional[float] = None
+
+    def limit(self, sent: int, now: float, total: int) -> int:
+        """How many bytes (absolute offset) may be on the wire at
+        ``now``.  Monotone in ``now``; mutates only the stall anchor
+        (set the first time the burst reaches the cut)."""
+        if now < self.start_t:
+            return 0
+        if self.stall_cut:
+            if sent < self.stall_cut:
+                return self.stall_cut
+            if self.stall_until is None:
+                self.stall_until = now + self.stall_s
+                return sent
+            if now < self.stall_until:
+                return sent
+            if self.bps > 0.0:
+                return self.stall_cut + max(
+                    1, int((now - self.stall_until) * self.bps)
+                )
+            return total
+        if self.bps > 0.0:
+            return max(1, int((now - self.start_t) * self.bps))
+        return total
+
+    def next_wake(self, now: float) -> float:
+        """When the gated writer should be re-driven."""
+        if now < self.start_t:
+            return self.start_t
+        if self.stall_until is not None and now < self.stall_until:
+            return self.stall_until
+        return now + 0.05
+
+
+class ChaosReactorPeerServer(_ReactorBase):
+    """Chaos injection under the event-loop Rx server
+    (``protocol.rx_server: reactor`` + ``chaos.enabled``).
+
+    Content faults — byzantine sign/scale/zero/replay, corrupt,
+    truncate, drop, down windows, partitions — go through the SAME pure
+    frame mutators as :class:`ChaosPeerServer` (:func:`mutate_frame`,
+    :func:`byzantine_frame`), so for any (seed, round, peer) the served
+    bytes are identical between the two servers; tests/test_fleet.py
+    pins that byte-identity.  Timing faults (delay, accept-delay,
+    throttle, trickle, stall) cannot sleep on the loop thread, so they
+    are enforced by :class:`_WriteShaper` gates on the buffered-write
+    path at the loop's 50 ms poll granularity — same observable
+    classifications (timeout, slow, bandwidth-abandon) as the threaded
+    shapes, coarser edges."""
+
+    def __init__(
+        self, host: str, port: int, engine: ChaosEngine, flowctl=None
+    ):
+        self.engine = engine
+        self._round = 0
+        # Framed payloads by publish round, for byzantine stale-replay
+        # (same bank as ChaosPeerServer — docs there).
+        self._history: Deque[Tuple[int, bytes]] = deque(maxlen=64)
+        # Loop-thread only: active shapers and their parked conns
+        # awaiting a gate release ((wake_time, conn) pairs, flushed
+        # every loop iteration).  Created BEFORE super().__init__ —
+        # that call starts the loop thread.
+        self._shapers: Dict[_ReactorConn, _WriteShaper] = {}
+        self._deferred: List[Tuple[float, _ReactorConn]] = []
+        # Relay probes from this node honor the injected partition too
+        # (instance attr shadows the base class hook).
+        self.relay_guard = (
+            lambda target: engine.link_blocked(
+                self._round, engine.peer, target
+            )
+        )
+        super().__init__(host, port, flowctl=flowctl)
+
+    # --- publish: round tracking + replay bank ---
+
+    def publish(
+        self, vec, clock, loss, code=None, digest=None, obs=None,
+        trace_id=None,
+    ) -> None:
+        self._round = int(clock)
+        super().publish(
+            vec, clock, loss, code, digest, obs=obs, trace_id=trace_id
+        )
+        with self._lock:
+            framed = self._payload
+        if framed is not None:
+            self._history.append((self._round, framed))
+
+    def _replay_frame(self, current: bytes, age: int) -> bytes:
+        stale = [
+            f for r, f in self._history if r <= self._round - age
+        ]
+        if stale:
+            return stale[-1]
+        older = [f for r, f in self._history if r < self._round]
+        return older[0] if older else current
+
+    # --- fault-injecting serve paths (loop thread) ---
+
+    def _abort_conn(self, conn) -> None:
+        """Drop/down teardown with an RST, not a FIN: the threaded
+        handler returns with the request still unread, so ITS close
+        resets — the fetcher must see the same abort either way."""
+        try:
+            conn.sock.setsockopt(
+                socket.SOL_SOCKET,
+                socket.SO_LINGER,
+                # dpwalint: ignore[wire-struct] -- kernel linger layout, not a frame
+                struct.pack("ii", 1, 0),
+            )
+        except OSError:
+            pass
+        self._close_conn(conn)
+
+    def _serve_blob(self, conn, now: float) -> None:
+        plan = self.engine.plan(self._round)
+        if plan.kind in ("down", "drop"):
+            self._abort_conn(conn)
+            return
+        with self._lock:
+            payload = self._payload
+            trace_id = self._payload_trace_id
+        if payload is None:
+            self._close_conn(conn)
+            return
+        # Byzantine content mutation FIRST, wire faults second — same
+        # composition order as the threaded _serve_with_faults.
+        if plan.byzantine == "replay":
+            payload = self._replay_frame(payload, plan.byz_replay_age)
+        elif plan.byzantine != "none":
+            payload = byzantine_frame(
+                payload, plan.byzantine, plan.byz_scale
+            )
+        bps = plan.trickle_bps
+        if plan.kind == "throttle":
+            # Trickle window outranks the drawn throttle rate (docs on
+            # the threaded path).
+            bps = plan.trickle_bps or plan.throttle_bps
+        elif plan.kind != "delay":
+            mutated = mutate_frame(payload, plan.kind)
+            if mutated is None:  # unreachable (drop handled above)
+                self._close_conn(conn)
+                return
+            payload = mutated
+        adm = self.admission
+        if adm is not None and not adm.reserve_bytes(len(payload)):
+            self._queue_busy(conn, self.flowctl.busy_retry_ms, now)
+            return
+        conn.reserved = len(payload)
+        conn.is_blob = True
+        conn.trace_id = trace_id
+        conn.t0 = now
+        start_t = now + plan.accept_delay_s
+        if plan.kind == "delay":
+            start_t += plan.delay_s
+        stall_cut = 0
+        if plan.stall_s > 0.0 and len(payload) > 1:
+            stall_cut = max(1, len(payload) // 3)
+        if start_t > now or bps > 0.0 or stall_cut:
+            self._shapers[conn] = _WriteShaper(
+                start_t, bps, stall_cut, plan.stall_s
+            )
+        self._queue_write(conn, payload, now)
+
+    def _serve_state(self, conn, offset, max_chunk, now: float) -> None:
+        plan = self.engine.plan(self._round)
+        if plan.kind in ("down", "drop"):
+            self._abort_conn(conn)
+            return
+        gate = plan.accept_delay_s
+        if plan.kind == "delay":
+            gate += plan.delay_s
+        if gate > 0.0:
+            self._shapers[conn] = _WriteShaper(now + gate)
+        super()._serve_state(conn, offset, max_chunk, now)
+
+    def _start_relay(self, conn, host: str, now: float) -> None:
+        plan = self.engine.plan(self._round)
+        if plan.kind in ("down", "drop"):
+            self._abort_conn(conn)
+            return
+        gate = plan.accept_delay_s
+        if plan.kind == "delay":
+            gate += plan.delay_s
+        if gate > 0.0:
+            # Gates the eventual reply write (queued by the relay
+            # completion), not the probe itself.
+            self._shapers[conn] = _WriteShaper(now + gate)
+        super()._start_relay(conn, host, now)
+
+    # --- shaped buffered writes ---
+
+    def _on_writable(self, conn) -> None:
+        sh = self._shapers.get(conn)
+        if sh is None:
+            super()._on_writable(conn)
+            return
+        buf = conn.outbuf
+        if buf is None:
+            return
+        now = time.monotonic()
+        limit = min(len(buf), sh.limit(conn.sent, now, len(buf)))
+        progressed = False
+        while conn.sent < limit:
+            try:
+                n = conn.sock.send(buf[conn.sent : limit])
+            except (BlockingIOError, InterruptedError):
+                break
+            except OSError:
+                self._close_conn(conn)
+                return
+            if n <= 0:
+                break
+            conn.sent += n
+            progressed = True
+        if conn.sent >= len(buf):
+            if conn.is_blob:
+                with self._stats_lock:
+                    self._stats["frames"] += 1
+            self._close_conn(conn)
+            return
+        if progressed:
+            conn.deadline = time.monotonic() + conn.write_timeout
+        if conn.sent >= limit:
+            # Gated: park write interest (a writable socket would spin
+            # the 50 ms loop hot) and wake at the next release point.
+            # EVENT_READ stays on so an EOF mid-gate still tears down.
+            wake = sh.next_wake(now)
+            if conn.deadline < wake + conn.write_timeout:
+                # A long delay/stall must not trip the write deadline.
+                conn.deadline = wake + conn.write_timeout
+                self._wheel.file(conn)
+            try:
+                self._sel.modify(conn.sock, selectors.EVENT_READ, conn)
+            except (OSError, ValueError, KeyError):
+                self._close_conn(conn)
+                return
+            self._deferred.append((wake, conn))
+
+    def _drain_relay_done(self) -> None:
+        # Runs once per loop iteration — doubles as the shaped-write
+        # release pump (the loop polls at wheel granularity, bounding
+        # gate precision to ~50 ms).
+        super()._drain_relay_done()
+        if not self._deferred:
+            return
+        now = time.monotonic()
+        ready = [c for t, c in self._deferred if t <= now]
+        if not ready:
+            return
+        self._deferred = [
+            (t, c) for t, c in self._deferred if t > now and not c.closed
+        ]
+        for conn in ready:
+            if conn.closed:
+                continue
+            try:
+                self._sel.modify(
+                    conn.sock, selectors.EVENT_WRITE, conn
+                )
+            except (OSError, ValueError, KeyError):
+                self._close_conn(conn)
+                continue
+            self._on_writable(conn)
+
+    def _close_conn(self, conn, timed_out: bool = False) -> None:
+        self._shapers.pop(conn, None)
+        super()._close_conn(conn, timed_out=timed_out)

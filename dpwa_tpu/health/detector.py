@@ -201,6 +201,11 @@ class FailureDetector:
         rec = self._peers.get(peer)
         return rec.suspicion if rec is not None else 0.0
 
+    def evict(self, peer: int) -> None:
+        """Drop ``peer``'s record entirely (membership eviction): its
+        EWMAs and counters rematerialize from zero if it ever returns."""
+        self._peers.pop(peer, None)
+
     def phi(self, peer: int, elapsed_since_success_s: float) -> float:
         """Phi-accrual suspicion from the latency distribution.
 

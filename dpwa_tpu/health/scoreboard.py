@@ -87,6 +87,12 @@ class Scoreboard:
         self._degraded_at: Dict[int, int] = {}
         self._probe_attempts: Dict[int, int] = {}
         self._probe_successes: Dict[int, int] = {}
+        # Membership-evicted peers (peer -> round evicted).  Every other
+        # per-peer dict is pruned at eviction, and `_state.get(peer,
+        # HEALTHY)` defaults healthy, so this set is what keeps a
+        # departed ghost out of healthy_mask / partner remaps until a
+        # probe or a fresher-incarnation refutation brings it back.
+        self._evicted: Dict[int, int] = {}
         self._round = 0  # highest round observed (fallback clock)
         # Optional membership-view provider (a MembershipManager): when
         # attached, snapshot() folds the epidemic view (incarnations,
@@ -109,6 +115,11 @@ class Scoreboard:
         """Feed one fetch outcome; returns the peer's resulting state."""
         with self._lock:
             r = self._clock(round)
+            if peer in self._evicted:
+                # Stray outcomes against an evicted ghost (a late fetch
+                # completion, a relayed probe) must not regrow its state
+                # — re-admission goes through record_probe/readmit only.
+                return PeerState.QUARANTINED
             suspicion = self.detector.observe(peer, outcome, latency_s, nbytes)
             if self._state.get(peer) != PeerState.QUARANTINED:
                 self._apply_suspicion(peer, outcome, suspicion, r)
@@ -162,6 +173,29 @@ class Scoreboard:
             outcome = Outcome.SUCCESS if success else Outcome.REFUSED
         with self._lock:
             r = self._clock(round)
+            if peer in self._evicted:
+                # Evicted ghosts accumulate NO state on failed probes —
+                # that unboundedness is what eviction exists to stop.  A
+                # successful probe is direct evidence the peer is back:
+                # rebuild it from scratch and tell the membership plane
+                # (scoreboard -> manager lock order is the sanctioned
+                # direction; snapshot() already takes it).
+                if not success:
+                    return
+                del self._evicted[peer]
+                self._state[peer] = PeerState.HEALTHY
+                self._quarantine_streak[peer] = 0
+                self._probe_attempts[peer] = 1
+                self._probe_successes[peer] = 1
+                rec = self.detector.record(peer)
+                rec.suspicion = 0.0
+                rec.failure_streak = 0
+                membership = self._membership
+                if membership is not None and hasattr(
+                    membership, "on_peer_returned"
+                ):
+                    membership.on_peer_returned(peer, r)
+                return
             self._probe_attempts[peer] = self._probe_attempts.get(peer, 0) + 1
             if self._state.get(peer) != PeerState.QUARANTINED:
                 # Symmetric path: probes are evidence, same as fetches.
@@ -208,6 +242,18 @@ class Scoreboard:
         suspicion/quarantine of it).  Returns True when state changed."""
         with self._lock:
             r = self._clock(round)
+            if peer in self._evicted:
+                # A refuted eviction: the peer disseminated a fresher
+                # alive claim, so it rematerializes with a clean record
+                # (the caller — the membership manager — clears its own
+                # eviction bookkeeping).
+                del self._evicted[peer]
+                self._state[peer] = PeerState.HEALTHY
+                self._quarantine_streak[peer] = 0
+                rec = self.detector.record(peer)
+                rec.suspicion = 0.0
+                rec.failure_streak = 0
+                return True
             state = self._state.get(peer, PeerState.HEALTHY)
             if state == PeerState.HEALTHY:
                 return False
@@ -237,6 +283,48 @@ class Scoreboard:
         with self._lock:
             return self._quarantine_streak.get(peer, 0)
 
+    def evict_peer(self, peer: int, round: Optional[int] = None) -> bool:
+        """Prune EVERY per-peer record for a membership-evicted peer.
+
+        Called by the membership manager once a peer has been
+        disseminated dead for ``membership.dead_gossip_rounds`` — the
+        churn-hardening bound on O(N)-forever state (docs/fleet.md).
+        The peer keeps reading as quarantined (see :meth:`state`,
+        :meth:`healthy_mask`) off the one-entry ``_evicted`` map; a
+        periodic probe (:meth:`probe_due`) or a fresher-incarnation
+        refutation readmits it from scratch.  Returns True when newly
+        evicted."""
+        with self._lock:
+            r = self._clock(round)
+            if peer in self._evicted or peer == self.me:
+                return False
+            for d in (
+                self._state,
+                self._release_round,
+                self._quarantine_streak,
+                self._quarantines,
+                self._quarantined_rounds,
+                self._quarantined_at,
+                self._degrades,
+                self._degraded_rounds,
+                self._degraded_at,
+                self._probe_attempts,
+                self._probe_successes,
+            ):
+                d.pop(peer, None)
+            self.detector.evict(peer)
+            self._evicted[peer] = r
+            return True
+
+    def is_evicted(self, peer: int) -> bool:
+        with self._lock:
+            return peer in self._evicted
+
+    def evicted_peers(self) -> List[int]:
+        """Currently evicted peers, ascending."""
+        with self._lock:
+            return sorted(self._evicted)
+
     def suspicion(self, peer: int) -> float:
         with self._lock:
             return self.detector.suspicion(peer)
@@ -255,7 +343,10 @@ class Scoreboard:
         """True while the peer must receive zero fetch attempts."""
         with self._lock:
             self._clock(round)
-            return self._state.get(peer) == PeerState.QUARANTINED
+            return (
+                self._state.get(peer) == PeerState.QUARANTINED
+                or peer in self._evicted
+            )
 
     def is_degraded(self, peer: int, round: Optional[int] = None) -> bool:
         """True while the peer is soft-degraded (load, not death): the
@@ -270,6 +361,14 @@ class Scoreboard:
         probe should decide re-admission."""
         with self._lock:
             r = self._clock(round)
+            evicted_at = self._evicted.get(peer)
+            if evicted_at is not None:
+                # Evicted ghosts get one cheap periodic probe so a
+                # silently returned peer is rediscoverable even after
+                # every node stopped disseminating its dead claim
+                # (nobody gossips about a peer nobody tracks).
+                interval = max(1, self.config.quarantine_max_rounds)
+                return r > evicted_at and (r - evicted_at) % interval == 0
             return (
                 self._state.get(peer) == PeerState.QUARANTINED
                 and r >= self._release_round.get(peer, 0)
@@ -289,6 +388,7 @@ class Scoreboard:
             return [
                 self._state.get(p)
                 not in (PeerState.QUARANTINED, PeerState.DEGRADED)
+                and p not in self._evicted
                 for p in range(self.n_peers)
             ]
 
@@ -352,6 +452,8 @@ class Scoreboard:
 
     def state(self, peer: int) -> str:
         with self._lock:
+            if peer in self._evicted:
+                return PeerState.QUARANTINED
             return self._state.get(peer, PeerState.HEALTHY)
 
     def snapshot(self, round: Optional[int] = None) -> dict:
@@ -367,7 +469,7 @@ class Scoreboard:
             view = membership.view_snapshot() if membership is not None else None
             peers = {}
             for p in range(self.n_peers):
-                if p == self.me:
+                if p == self.me or p in self._evicted:
                     continue
                 state = self._state.get(p, PeerState.HEALTHY)
                 quarantined_rounds = self._quarantined_rounds.get(p, 0)
@@ -399,6 +501,8 @@ class Scoreboard:
                     info["incarnation"] = view["incarnations"].get(p, 0)
                 peers[p] = info
             snap = {"me": self.me, "round": r, "peers": peers}
+            if self._evicted:
+                snap["evicted"] = sorted(self._evicted)
             if view is not None:
                 snap["membership"] = {
                     k: v for k, v in view.items() if k != "incarnations"

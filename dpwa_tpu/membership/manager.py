@@ -37,7 +37,7 @@ opposite orders would deadlock).
 from __future__ import annotations
 
 import threading
-from typing import Any, Dict, List, Optional, Set
+from typing import Any, Callable, Dict, List, Optional, Set
 
 from dpwa_tpu.config import MembershipConfig
 from dpwa_tpu.health.scoreboard import PeerState, Scoreboard
@@ -86,8 +86,23 @@ class MembershipManager:
         self._degraded = False
         # Peers recently back from unreachable: peer -> round it returned.
         self._returned_pending: Dict[int, int] = {}
+        # Churn hardening (docs/fleet.md): round the combined view first
+        # held each peer DEAD, and the peers since *evicted* — pruned
+        # from the scoreboard/trust/flowctl planes and omitted from the
+        # digest, bounding both per-peer state and digest growth under
+        # heavy join/leave.  config.dead_gossip_rounds == 0 disables.
+        self._dead_since: Dict[int, int] = {}
+        self._evicted: Set[int] = set()
+        # Callbacks fired (outside the lock) with the evicted peer id —
+        # the transport registers trust/flowctl pruning here.
+        self._evict_listeners: List[Callable[[int], None]] = []
         self._round = 0
         scoreboard.attach_membership(self)
+
+    def add_evict_listener(self, fn: Callable[[int], None]) -> None:
+        """Register a callback fired once per peer eviction."""
+        with self._lock:
+            self._evict_listeners.append(fn)
 
     # ------------------------------------------------------------------
     # Local evidence -> digest states
@@ -127,10 +142,18 @@ class MembershipManager:
     # ------------------------------------------------------------------
 
     def encode(self, round: int) -> bytes:
-        """The digest to piggyback on this round's published frame."""
+        """The digest to piggyback on this round's published frame.
+
+        Evicted peers are OMITTED: a dead claim disseminates for
+        ``dead_gossip_rounds`` and then leaves the wire, so the digest
+        is O(live + recently-dead) instead of O(everyone ever seen)."""
+        with self._lock:
+            evicted = set(self._evicted)
         # Scoreboard reads happen before taking our lock (lock ordering).
         combined = {
-            p: self._combined(p) for p in range(self.n_peers) if p != self.me
+            p: self._combined(p)
+            for p in range(self.n_peers)
+            if p != self.me and p not in evicted
         }
         with self._lock:
             self._round = max(self._round, int(round))
@@ -206,6 +229,24 @@ class MembershipManager:
                 )
         if refuted:
             with self._lock:
+                for rec in refuted:
+                    peer = rec["peer"]
+                    self._dead_since.pop(peer, None)
+                    if peer in self._evicted:
+                        # A rejoiner outbid its own dead claim: it is a
+                        # member again, rebuilt from scratch by the
+                        # planes that pruned it.
+                        self._evicted.discard(peer)
+                        self._events.append(
+                            {
+                                "event": "peer_rejoined",
+                                "peer": peer,
+                                "via": "refutation",
+                                "incarnation": self._view[
+                                    peer
+                                ].incarnation,
+                            }
+                        )
                 self._events.extend(refuted)
 
     # ------------------------------------------------------------------
@@ -213,16 +254,46 @@ class MembershipManager:
     # ------------------------------------------------------------------
 
     def end_round(self, step: int) -> None:
-        """Recompute the component after this round's exchange."""
+        """Recompute the component after this round's exchange, and age
+        dead claims toward eviction (``config.dead_gossip_rounds``)."""
+        with self._lock:
+            evicted = set(self._evicted)
         combined = {
-            p: self._combined(p) for p in range(self.n_peers) if p != self.me
+            p: self._combined(p)
+            for p in range(self.n_peers)
+            if p != self.me and p not in evicted
         }
         component = {self.me} | {
             p for p, e in combined.items() if e.state <= SUSPECT
         }
+        dead_now = {p for p, e in combined.items() if e.state >= DEAD}
         events: List[dict] = []
+        evictions: List[int] = []
         with self._lock:
             self._round = max(self._round, int(step))
+            if self.config.dead_gossip_rounds > 0:
+                for p in sorted(dead_now):
+                    since = self._dead_since.setdefault(p, int(step))
+                    if int(step) - since >= self.config.dead_gossip_rounds:
+                        evictions.append(p)
+                for p in sorted(self._dead_since):
+                    if p not in dead_now:
+                        del self._dead_since[p]
+                for p in evictions:
+                    self._evicted.add(p)
+                    del self._dead_since[p]
+                    events.append(
+                        {
+                            "event": "peer_dead",
+                            "peer": p,
+                            "dead_rounds": self.config.dead_gossip_rounds,
+                            "evicted": sorted(self._evicted),
+                        }
+                    )
+            # Quorum/heal fractions run over the ring that still EXISTS:
+            # counting permanently departed peers against quorum would
+            # pin a half-churned ring degraded forever.
+            alive_universe = max(1, self.n_peers - len(self._evicted))
             prev = self._component
             if component != prev:
                 events.append(
@@ -244,7 +315,7 @@ class MembershipManager:
                 if p in component and int(step) - r <= RETURN_WINDOW_ROUNDS
             }
             degraded = (
-                len(component) / self.n_peers < self.config.quorum_fraction
+                len(component) / alive_universe < self.config.quorum_fraction
             )
             if degraded and not self._degraded:
                 events.append(
@@ -259,7 +330,7 @@ class MembershipManager:
             pending = set(self._returned_pending)
             if (
                 pending
-                and len(pending) / self.n_peers
+                and len(pending) / alive_universe
                 >= self.config.reconcile_min_fraction
             ):
                 healed = True
@@ -285,6 +356,51 @@ class MembershipManager:
             self._component = component
             self._degraded = degraded
             self._events.extend(events)
+            listeners = list(self._evict_listeners)
+        # Prune the other planes OUTSIDE our lock: the scoreboard (and
+        # the registered trust/flowctl listeners) take their own locks,
+        # and the sanctioned order is theirs-before-ours.
+        for p in evictions:
+            self.scoreboard.evict_peer(p, round=int(step))
+            for fn in listeners:
+                fn(p)
+
+    def on_peer_returned(self, peer: int, round: Optional[int] = None) -> None:
+        """Direct probe evidence that an evicted peer is back.
+
+        Called by ``Scoreboard.record_probe`` WITH the scoreboard lock
+        held (the sanctioned scoreboard-then-manager order, same as
+        ``view_snapshot``) — must not call back into the scoreboard.
+        Clears the eviction and downgrades the stale DEAD view entry to
+        ALIVE at the same incarnation: probe evidence outranks gossip,
+        and the peer's own refutation bumps the incarnation if laggards
+        still disseminate the dead claim."""
+        with self._lock:
+            if peer not in self._evicted:
+                return
+            self._evicted.discard(peer)
+            self._dead_since.pop(peer, None)
+            entry = self._view.get(peer)
+            if entry is not None and entry.state > ALIVE:
+                self._view[peer] = MemberEntry(
+                    state=ALIVE,
+                    incarnation=entry.incarnation,
+                    suspicion=0.0,
+                )
+            self._events.append(
+                {
+                    "event": "peer_rejoined",
+                    "peer": peer,
+                    "via": "probe",
+                    "round": int(round) if round is not None else None,
+                }
+            )
+
+    def evicted_peers(self) -> List[int]:
+        """Currently evicted peers, ascending (the membership view of
+        who has left the ring for good unless they refute)."""
+        with self._lock:
+            return sorted(self._evicted)
 
     # ------------------------------------------------------------------
     # Consumers
@@ -321,7 +437,7 @@ class MembershipManager:
         held — must not call back into the scoreboard (lock ordering),
         so it reports the gossip view, not the local overlay."""
         with self._lock:
-            return {
+            snap = {
                 "incarnation": self.incarnation,
                 "component_id": min(self._component),
                 "component": sorted(self._component),
@@ -331,6 +447,9 @@ class MembershipManager:
                     p: e.incarnation for p, e in sorted(self._view.items())
                 },
             }
+            if self._evicted:
+                snap["evicted"] = sorted(self._evicted)
+            return snap
 
 
 def register_metrics(registry, manager: "MembershipManager") -> None:

@@ -159,6 +159,56 @@ def heal_draw(seed, step, me, n_candidates: int):
     )
 
 
+def churn_leave_draw(seed, round_, peer):
+    """Uniform [0, 1) deciding whether ``peer`` LEAVES the fleet at
+    ``round_`` (tag 10 — the fleet orchestrator's continuous-departure
+    stream, compared against ``ChurnSchedule.leave_probability``).
+
+    Keyed on ``(seed, round, peer)`` like :func:`chaos_draw`, so a churn
+    episode replays bit-identically under a fixed seed — the property the
+    8-peer mini-churn acceptance test asserts across reruns."""
+    return float(
+        jax.random.uniform(_pair_key(seed, round_, peer, _tags.TAG_CHURN_LEAVE))
+    )
+
+
+def churn_join_draw(seed, round_, peer):
+    """Uniform [0, 1) deciding whether a departed ``peer`` REJOINS at
+    ``round_`` (tag 11 — independent of the leave stream, so arrival and
+    departure rates tune without correlation)."""
+    return float(
+        jax.random.uniform(_pair_key(seed, round_, peer, _tags.TAG_CHURN_JOIN))
+    )
+
+
+def churn_cohort_draw(seed, round_, n_max: int):
+    """Size of an autoscale-style cohort arrival at ``round_`` in
+    ``[0, n_max]`` (tag 12, peer key 0 — one draw per round, like the
+    partition-split draw).  0 means no cohort lands this round; the
+    orchestrator admits the ``n`` lowest-indexed departed peers at once,
+    the membership-merge burst a real autoscaler produces."""
+    if n_max <= 0:
+        return 0
+    return int(
+        jax.random.randint(
+            _pair_key(seed, round_, 0, _tags.TAG_CHURN_COHORT), (), 0, n_max + 1
+        )
+    )
+
+
+def churn_restart_draw(seed, round_, n_candidates: int):
+    """Index of the live peer rolling-restarted at ``round_`` (tag 13,
+    peer key 0 — one draw per restart event, over the live-peer list in
+    index order).  Drawn, not round-robin, so restart order decorrelates
+    from ring position while staying replayable."""
+    return int(
+        jax.random.randint(
+            _pair_key(seed, round_, 0, _tags.TAG_CHURN_RESTART),
+            (), 0, n_candidates,
+        )
+    )
+
+
 _CONTROL_DRAWS_WARM = False
 
 
@@ -187,6 +237,10 @@ def warm_control_draws(seed: int = 0, me: int = 0) -> None:
     int(heal_draw(seed, 0, me, 2))
     float(degrade_shed_draw(seed, 0, me))
     float(chaos_draw(seed, 0, me, _tags.CHAOS_KIND_DROP))
+    float(churn_leave_draw(seed, 0, me))
+    float(churn_join_draw(seed, 0, me))
+    churn_cohort_draw(seed, 0, 1)
+    churn_restart_draw(seed, 0, 2)
     _CONTROL_DRAWS_WARM = True
 
 

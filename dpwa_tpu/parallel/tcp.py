@@ -1484,16 +1484,30 @@ class TcpTransport:
         # partitions (the serving side cannot know who is connecting).
         self._chaos_engine = None
         if config.chaos.enabled:
-            # Chaos wraps the PYTHON Rx server (fault injection needs
-            # per-connection control of the serve loop); the import is
-            # deferred because health.chaos imports this module.
-            from dpwa_tpu.health.chaos import ChaosEngine, ChaosPeerServer
+            # Chaos wraps the Rx server (fault injection needs
+            # per-connection control of the serve path); the import is
+            # deferred because health.chaos imports this module.  Both
+            # Rx servers inject: the threaded wrapper rewrites frames in
+            # its serve loop, the reactor subclass rewrites them at
+            # _serve_blob time — same pure mutation functions, so the
+            # served bytes are identical (tests/test_fleet.py pins it).
+            from dpwa_tpu.health.chaos import (
+                ChaosEngine,
+                ChaosPeerServer,
+                ChaosReactorPeerServer,
+            )
 
             self._chaos_engine = ChaosEngine(config.chaos, self.me)
-            self.server = ChaosPeerServer(
-                spec.host, spec.port, self._chaos_engine,
-                flowctl=config.flowctl,
-            )
+            if config.protocol.rx_server == "reactor":
+                self.server = ChaosReactorPeerServer(
+                    spec.host, spec.port, self._chaos_engine,
+                    flowctl=config.flowctl,
+                )
+            else:
+                self.server = ChaosPeerServer(
+                    spec.host, spec.port, self._chaos_engine,
+                    flowctl=config.flowctl,
+                )
         elif config.protocol.rx_server == "reactor":
             # Single-threaded event-loop Rx (docs/transport.md): same
             # wire bytes and admission semantics as PeerServer, with
@@ -1558,6 +1572,16 @@ class TcpTransport:
                 len(config.nodes), self.me, self.scoreboard,
                 config.membership, seed=self.schedule.seed,
             )
+            # Churn hardening: when the manager evicts a dead peer it
+            # prunes the scoreboard itself; the trust EWMAs/windows and
+            # the flowctl deadline windows are pruned through these
+            # listeners so no plane holds O(everyone-ever-seen) state.
+            if self.trust is not None:
+                self.membership.add_evict_listener(self.trust.evict_peer)
+            if self._estimator is not None:
+                self.membership.add_evict_listener(
+                    self._estimator.evict_peer
+                )
         if self.trust is not None and self.scoreboard is not None:
             # Collapsed trust feeds the scoreboard as ``untrusted``
             # probes — the quarantine path for a persistently-suspect

@@ -399,6 +399,31 @@ class TrustManager:
         return verdict, scale, out
 
     # ------------------------------------------------------------------
+    # Eviction (membership churn hardening — docs/fleet.md)
+    # ------------------------------------------------------------------
+
+    def evict_peer(self, peer: int) -> None:
+        """Drop every per-peer record for a membership-evicted peer.
+
+        The global/per-codec baseline windows stay: they describe the
+        honest ring, not the departed peer.  A rejoiner rematerializes
+        at trust 1.0 and immediately opens a first-contact amnesty
+        window (``_observe_contact`` sees it as never screened), which
+        is exactly the cold-start posture a genuinely new peer gets."""
+        with self._lock:
+            for d in (
+                self._trust,
+                self._collapsed,
+                self._last_clock,
+                self._replay_streak,
+                self._counts,
+                self._last_verdict,
+                self._last_seen,
+                self._amnesty_until,
+            ):
+                d.pop(peer, None)
+
+    # ------------------------------------------------------------------
     # Queries
     # ------------------------------------------------------------------
 

@@ -12,8 +12,8 @@ raw tag literal that does not come from here.
 
 Layout of the tag space:
 
-- ``0 .. 9``   allocated control-plane draws (below).
-- ``10 .. 15`` free — claim the next one HERE, never inline.
+- ``0 .. 13`` allocated control-plane draws (below).
+- ``14 .. 15`` free — claim the next one HERE, never inline.
 - ``16 ..``    chaos fault-kind streams: ``CHAOS_TAG_BASE + kind`` where
   ``kind`` is one of the ``CHAOS_KIND_*`` indices below.  Keeping the
   chaos kinds far clear of the control tags means new control draws can
@@ -54,6 +54,14 @@ TAG_RELAY_PROBE = _register("relay_probe_draw", 6)
 TAG_HEAL_DONOR = _register("heal_donor_draw", 7)
 TAG_DEGRADE_SHED = _register("degrade_shed_draw", 8)
 TAG_SKETCH = _register("replica_sketch_draw", 9)
+# Fleet churn-schedule draws (dpwa_tpu/fleet): per-(round, peer) leave /
+# join decisions, per-round cohort-arrival sizing, and the rolling-restart
+# cursor.  Independent streams so a leave-heavy schedule does not skew
+# which peers restart.
+TAG_CHURN_LEAVE = _register("churn_leave_draw", 10)
+TAG_CHURN_JOIN = _register("churn_join_draw", 11)
+TAG_CHURN_COHORT = _register("churn_cohort_draw", 12)
+TAG_CHURN_RESTART = _register("churn_restart_draw", 13)
 
 # Chaos fault-kind streams occupy CHAOS_TAG_BASE + kind.
 CHAOS_TAG_BASE = 16

@@ -733,6 +733,14 @@ def test_fuzzed_requests_never_kill_the_server(rx):
             time.sleep(0.02)
         got, outcome, *_ = fetch_blob_full("127.0.0.1", srv.port, 1000)
         assert outcome == Outcome.SUCCESS
+        # The probe's own slot releases when the server books the close,
+        # a beat after the client sees its payload — settle again.
+        deadline = time.monotonic() + 5.0
+        while (
+            srv.admission.snapshot()["active"] > 0
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.02)
         assert srv.admission.snapshot()["active"] == 0
     finally:
         srv.close()

@@ -9,9 +9,10 @@ Covers the event-loop server's headline claims:
   closed connection and a live loop, never a wedge;
 - a 4-node soak under ``rx_server=reactor`` produces byte-identical
   merge trajectories to the threaded server;
-- chaos always forces the threaded wrapper (fault injection needs
-  per-connection blocking control), so the chaos matrix is untouched
-  by the switch;
+- chaos composes with the reactor: ``rx_server: reactor`` +
+  ``chaos.enabled`` selects the event-loop chaos server, which serves
+  byte-identical faults to the threaded wrapper (the identity matrix
+  lives in tests/test_fleet.py);
 - the observability surface: ``reactor`` sub-document in
   ``health_snapshot()`` and ``dpwa_reactor_*`` families on /metrics.
 
@@ -245,15 +246,30 @@ def test_reactor_soak_is_byte_identical_to_threaded():
     assert _soak("threaded") == _soak("reactor")
 
 
-def test_chaos_always_forces_the_threaded_server():
-    """Fault injection needs per-connection blocking control, so chaos
-    wraps the threaded server regardless of rx_server — the chaos
-    matrix is identical across the switch by construction."""
-    from dpwa_tpu.health.chaos import ChaosPeerServer
+def test_chaos_selects_matching_server_per_rx_backend():
+    """Chaos no longer forces the threaded wrapper: under
+    ``rx_server: reactor`` the event-loop chaos server is selected, so
+    the soak's Rx architecture survives fault injection.  The two
+    servers share the pure frame mutators, making the served fault
+    bytes identical (tests/test_fleet.py pins the matrix)."""
+    from dpwa_tpu.health.chaos import (
+        ChaosPeerServer,
+        ChaosReactorPeerServer,
+    )
 
     cfg = make_local_config(
         2, base_port=0, rx_server="reactor",
         chaos=dict(enabled=True, seed=1),
+    )
+    ts = [TcpTransport(cfg, f"node{i}") for i in range(2)]
+    try:
+        assert all(
+            isinstance(t.server, ChaosReactorPeerServer) for t in ts
+        )
+    finally:
+        close_all(ts)
+    cfg = make_local_config(
+        2, base_port=0, chaos=dict(enabled=True, seed=1),
     )
     ts = [TcpTransport(cfg, f"node{i}") for i in range(2)]
     try:
@@ -298,6 +314,14 @@ def test_reactor_prometheus_families():
     try:
         srv.publish(np.arange(8, dtype=np.float32), 1.0, 0.1)
         assert fetch_blob_ex("127.0.0.1", srv.port, 1000)[0] is not None
+        # Same settle as the 256-peer test: the client sees its payload
+        # a beat before the loop thread books the completed write.
+        deadline = time.monotonic() + 5.0
+        while (
+            srv.reactor_snapshot()["frames"] < 1
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.02)
         reg = MetricsRegistry()
         register_metrics(reg, srv)
         text = reg.render()

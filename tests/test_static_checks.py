@@ -577,6 +577,10 @@ def test_threefry_tags_are_pinned():
         7: "heal_donor_draw",
         8: "degrade_shed_draw",
         9: "replica_sketch_draw",
+        10: "churn_leave_draw",
+        11: "churn_join_draw",
+        12: "churn_cohort_draw",
+        13: "churn_restart_draw",
         16: "chaos:drop",
         17: "chaos:delay",
         18: "chaos:throttle",

@@ -1,0 +1,333 @@
+#!/usr/bin/env python
+"""Join fleet/trace/incident JSONL into a per-episode churn digest.
+
+Stdlib-only companion to the elastic-fleet orchestrator
+(``dpwa_tpu.fleet``, docs/fleet.md).  Feed it the orchestrator's
+``record: "fleet"`` stream plus (optionally) the same run's trace spans
+(``record: "trace"``) and incident-plane streams (``record: "alert"`` /
+``record: "incident"``); it digests:
+
+- **membership convergence** — how many rounds each departure took to
+  be evicted ring-wide and each arrival to be admitted (median / p95 /
+  max, plus any unresolved at episode end);
+- **per-round wall** — p50 / p95 / max of the fleet round records'
+  ``wall_s`` (and of trace round spans when supplied), so a churn
+  episode's slowdown is a number, not an impression;
+- **injected faults vs observed incidents** — the churn records name
+  exactly which chaos classes were active in which round windows; each
+  window is matched against the alerts/incidents observed in (a slack
+  around) it and classified ``detected`` / ``misclassified`` /
+  ``undetected``, which is the falsifiable form of "the incident plane
+  saw the fault we injected".
+
+Usage::
+
+    python tools/fleet_report.py fleet.jsonl
+    python tools/fleet_report.py --json fleet.jsonl incidents.jsonl
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, Iterable, List, Optional
+
+# Injected fault class -> incident classifications that count as a
+# correct detection.  Mirrors dpwa_tpu/obs/incidents.py ALERT_KINDS
+# (kept in sync by tests/test_fleet.py); duplicated so the report stays
+# stdlib-only and usable on a box without the package installed.
+FAULT_EXPECTATIONS: Dict[str, tuple] = {
+    "partition": ("partition",),
+    "byzantine": ("byzantine",),
+    "straggler": ("straggler", "slo_burn"),
+}
+
+# Alert kind -> incident classification (ALERT_KINDS column 2).
+ALERT_CLASS: Dict[str, str] = {
+    "partition": "partition",
+    "partition_flap": "partition",
+    "trust_burst": "byzantine",
+    "peer_failure": "peer_down",
+    "straggler": "straggler",
+    "state_storm": "state_storm",
+    "slo_burn": "slo_burn",
+    "conv_stall": "conv_stall",
+}
+
+# Rounds of slack when matching observations against an injected
+# window: detectors need a few rounds of evidence, and quarantine /
+# incident resolution trails the window's end.
+WINDOW_SLACK = 8
+
+
+def load_records(paths: Iterable[str]) -> Dict[str, List[dict]]:
+    """Parse every file into kind-bucketed record lists."""
+    out: Dict[str, List[dict]] = {
+        "churn": [], "round": [], "episode": [],
+        "trace_round": [], "alert": [], "incident": [],
+    }
+    for path in paths:
+        with open(path, "r", encoding="utf-8") as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                kind = rec.get("record")
+                if kind == "fleet" and rec.get("kind") in (
+                    "churn", "round", "episode"
+                ):
+                    out[rec["kind"]].append(rec)
+                elif kind == "trace" and rec.get("kind") == "round":
+                    out["trace_round"].append(rec)
+                elif kind in ("alert", "incident"):
+                    out[kind].append(rec)
+    return out
+
+
+def _pct(values: List[float], q: float) -> Optional[float]:
+    """Nearest-rank percentile; None on empty (stdlib-only, no numpy)."""
+    if not values:
+        return None
+    vs = sorted(values)
+    idx = min(len(vs) - 1, max(0, int(round(q * (len(vs) - 1)))))
+    return vs[idx]
+
+
+def _wall_stats(walls: List[float]) -> Optional[dict]:
+    if not walls:
+        return None
+    return {
+        "rounds": len(walls),
+        "p50_s": round(_pct(walls, 0.50), 6),
+        "p95_s": round(_pct(walls, 0.95), 6),
+        "max_s": round(max(walls), 6),
+    }
+
+
+def _convergence(rounds: List[int], unresolved: List[int]) -> dict:
+    return {
+        "events": len(rounds) + len(unresolved),
+        "resolved": len(rounds),
+        "unresolved": len(unresolved),
+        "median_rounds": _pct([float(r) for r in rounds], 0.50),
+        "p95_rounds": _pct([float(r) for r in rounds], 0.95),
+        "max_rounds": max(rounds) if rounds else None,
+    }
+
+
+def fault_windows(churn: List[dict]) -> List[dict]:
+    """Fold the churn records' per-round chaos sets into contiguous
+    windows of identical active-class sets."""
+    active = [
+        (int(r["round"]), tuple(r.get("chaos") or ()))
+        for r in sorted(churn, key=lambda r: r.get("round", 0))
+        if r.get("chaos")
+    ]
+    windows: List[dict] = []
+    for rnd, kinds in active:
+        if (
+            windows
+            and windows[-1]["kinds"] == list(kinds)
+            and rnd == windows[-1]["stop"]
+        ):
+            windows[-1]["stop"] = rnd + 1
+        else:
+            windows.append(
+                {"start": rnd, "stop": rnd + 1, "kinds": list(kinds)}
+            )
+    return windows
+
+
+def _observed_classes(
+    window: dict,
+    rounds: List[dict],
+    alerts: List[dict],
+    incidents: List[dict],
+    slack: int = WINDOW_SLACK,
+) -> List[str]:
+    """Incident classifications observed inside (a slack around) the
+    window, from whichever evidence streams were supplied."""
+    lo = window["start"]
+    hi = window["stop"] + slack
+    classes = set()
+    for r in rounds:  # fleet round records carry fired alert kinds
+        if lo <= int(r.get("round", -1)) < hi:
+            for kind in r.get("alerts") or ():
+                cls = ALERT_CLASS.get(kind)
+                if cls:
+                    classes.add(cls)
+    for a in alerts:
+        if lo <= int(a.get("step", -1)) < hi:
+            cls = ALERT_CLASS.get(a.get("kind", ""))
+            if cls:
+                classes.add(cls)
+    for i in incidents:
+        if lo <= int(i.get("opened_step", i.get("step", -1))) < hi:
+            if i.get("kind"):
+                classes.add(i["kind"])
+    return sorted(classes)
+
+
+def match_faults(
+    windows: List[dict],
+    rounds: List[dict],
+    alerts: List[dict],
+    incidents: List[dict],
+    slack: int = WINDOW_SLACK,
+) -> List[dict]:
+    """Classify every injected window: detected / misclassified /
+    undetected, with the evidence alongside."""
+    out = []
+    for w in windows:
+        observed = _observed_classes(w, rounds, alerts, incidents, slack)
+        expected = sorted(
+            {
+                cls
+                for k in w["kinds"]
+                for cls in FAULT_EXPECTATIONS.get(k, ())
+            }
+        )
+        hit = {
+            k for k in w["kinds"]
+            if any(c in observed for c in FAULT_EXPECTATIONS.get(k, ()))
+        }
+        if hit == set(w["kinds"]):
+            verdict = "detected"
+        elif observed:
+            verdict = "misclassified"
+        else:
+            verdict = "undetected"
+        out.append(
+            {
+                **w,
+                "expected_classes": expected,
+                "observed_classes": observed,
+                "verdict": verdict,
+            }
+        )
+    return out
+
+
+def build_report(records: Dict[str, List[dict]]) -> Dict[str, Any]:
+    rounds = sorted(records["round"], key=lambda r: r.get("round", 0))
+    churn = records["churn"]
+    episode = records["episode"][-1] if records["episode"] else {}
+
+    windows = fault_windows(churn)
+    faults = match_faults(
+        windows, rounds, records["alert"], records["incident"]
+    )
+
+    walls = [float(r["wall_s"]) for r in rounds if "wall_s" in r]
+    trace_walls = [
+        float(t["wall"]) for t in records["trace_round"] if "wall" in t
+    ]
+
+    rep: Dict[str, Any] = {
+        "episode": {
+            "rounds": episode.get("rounds", len(rounds)),
+            "n_peers": episode.get("n_peers"),
+            "seed": episode.get("seed"),
+            "final_live": episode.get("final_live"),
+            "final_rel_rms": episode.get("final_rel_rms"),
+            "evicted": episode.get("evicted", []),
+            "max_digest_bytes": episode.get("max_digest_bytes"),
+            "incidents_opened": episode.get("incidents_opened"),
+        },
+        "churn": {
+            "events": len(churn),
+            "leaves": sum(len(r.get("leaves") or ()) for r in churn),
+            "joins": sum(
+                len(r.get("joins") or ()) + len(r.get("cohort") or ())
+                for r in churn
+            ),
+            "restarts": sum(len(r.get("restart") or ()) for r in churn),
+        },
+        "membership_convergence": {
+            "leave": _convergence(
+                episode.get("leave_convergence_rounds", []),
+                episode.get("unresolved_leaves", []),
+            ),
+            "join": _convergence(
+                episode.get("join_convergence_rounds", []),
+                episode.get("unresolved_joins", []),
+            ),
+        },
+        "wall": _wall_stats(walls),
+        "trace_wall": _wall_stats(trace_walls),
+        "faults": faults,
+        "faults_detected": sum(
+            1 for f in faults if f["verdict"] == "detected"
+        ),
+    }
+    return rep
+
+
+def print_report(rep: Dict[str, Any]) -> None:
+    ep = rep["episode"]
+    print(
+        f"episode: {ep['rounds']} rounds, n_peers={ep['n_peers']}, "
+        f"seed={ep['seed']}, final_live={ep['final_live']}, "
+        f"final_rel_rms={ep['final_rel_rms']}"
+    )
+    ch = rep["churn"]
+    print(
+        f"churn: {ch['leaves']} leaves, {ch['joins']} joins, "
+        f"{ch['restarts']} restarts across {ch['events']} eventful rounds"
+    )
+    for name in ("leave", "join"):
+        c = rep["membership_convergence"][name]
+        print(
+            f"{name} convergence: {c['resolved']}/{c['events']} resolved "
+            f"(median {c['median_rounds']}, p95 {c['p95_rounds']}, "
+            f"max {c['max_rounds']} rounds)"
+        )
+    for label, key in (("wall", "wall"), ("trace wall", "trace_wall")):
+        w = rep[key]
+        if w:
+            print(
+                f"{label}: p50 {w['p50_s']}s p95 {w['p95_s']}s "
+                f"max {w['max_s']}s over {w['rounds']} rounds"
+            )
+    print(
+        f"injected fault windows: {len(rep['faults'])} "
+        f"({rep['faults_detected']} detected)"
+    )
+    for f in rep["faults"]:
+        print(
+            f"  rounds {f['start']}..{f['stop']} {f['kinds']}: "
+            f"{f['verdict']} (observed {f['observed_classes']})"
+        )
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Digest a fleet churn episode: membership "
+        "convergence, per-round wall, injected faults vs observed "
+        "incidents."
+    )
+    ap.add_argument(
+        "paths", nargs="+",
+        help="fleet JSONL stream(s), plus optional trace spans and "
+        "incident/alert streams from the same run",
+    )
+    ap.add_argument(
+        "--json", action="store_true", help="machine-readable output"
+    )
+    args = ap.parse_args(argv)
+    rep = build_report(load_records(args.paths))
+    if args.json:
+        json.dump(rep, sys.stdout, indent=2)
+        print()
+    else:
+        print_report(rep)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

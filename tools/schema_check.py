@@ -27,6 +27,11 @@ schemas:
 - ``record: "bench"`` — bench.py's cumulative history entries
   (``artifacts/bench_history.jsonl``): the envelope is pinned, the
   result payload is bench-leg-defined;
+- ``record: "fleet"``, ``kind: "churn" | "round" | "episode"`` — the
+  churn orchestrator's stream (docs/fleet.md): churn records are the
+  deterministic bit-identity anchor (round counters and peer ids
+  only), round records add measured fields, episode records the run
+  summary ``tools/fleet_report.py`` digests — all closed-world;
 - records with no ``record`` key — per-step exchange/training records
   (``MetricsLogger.log`` / ``log_exchange``): ``step`` and ``t`` are
   pinned, the rest is adapter-defined.
@@ -241,6 +246,58 @@ _BENCH_REQUIRED: Dict[str, tuple] = {
     "record": (str,),
 }
 
+# Fleet records carry ``round`` (gossip round), never ``t``: the churn
+# stream is the orchestrator's BIT-IDENTITY anchor (two runs of one
+# seed must produce byte-identical churn records), so wall time never
+# enters it.  Measured fields live on round/episode records only.
+_FLEET_CHURN_REQUIRED: Dict[str, tuple] = {
+    "record": (str,),
+    "kind": (str,),
+    "round": (int,),
+    "leaves": (list,),
+    "joins": (list,),
+    "cohort": (list,),
+    "restart": (list,),
+    "chaos": (list,),
+    "live": (int,),
+    "evicted": (list,),
+}
+
+_FLEET_ROUND_REQUIRED: Dict[str, tuple] = {
+    "record": (str,),
+    "kind": (str,),
+    "round": (int,),
+    "live": (int,),
+    "exchanges": (int,),
+    "failures": (int,),
+    "outcomes": (dict,),
+    "rel_rms": _NUM,
+    "wall_s": _NUM,
+    "digest_bytes": (int,),
+    "evicted": (int,),
+    "alerts": (list,),
+}
+
+_FLEET_EPISODE_REQUIRED: Dict[str, tuple] = {
+    "record": (str,),
+    "kind": (str,),
+    "rounds": (int,),
+    "n_peers": (int,),
+    "seed": (int,),
+    "final_live": (int,),
+    "final_rel_rms": _NUM,
+    "outcomes": (dict,),
+    "max_digest_bytes": (int,),
+    "max_wall_s": _NUM,
+    "evicted": (list,),
+    "leave_convergence_rounds": (list,),
+    "join_convergence_rounds": (list,),
+    "unresolved_leaves": (list,),
+    "unresolved_joins": (list,),
+    "alerts": (dict,),
+    "incidents_opened": (int,),
+}
+
 _EXCHANGE_REQUIRED: Dict[str, tuple] = {
     "step": (int,),
     "t": _NUM,
@@ -252,7 +309,7 @@ _EXCHANGE_REQUIRED: Dict[str, tuple] = {
 RECORD_KINDS = frozenset(
     {
         "health", "trace", "event", "alert", "incident", "flight",
-        "bench",
+        "bench", "fleet",
     }
 )
 EVENT_KINDS = frozenset(
@@ -271,6 +328,8 @@ EVENT_KINDS = frozenset(
         # trust (PR 4)
         "trust_amnesty", "trust_clock_reset", "trust_collapsed",
         "trust_recovered",
+        # churn-hardened membership eviction (PR 11, docs/fleet.md)
+        "peer_dead", "peer_rejoined",
     }
 )
 
@@ -386,6 +445,17 @@ def check_record(rec: dict) -> List[str]:
         return [f"unknown flight kind {fkind!r}"]
     if kind == "bench":
         return _check_fields(rec, _BENCH_REQUIRED)
+    if kind == "fleet":
+        fkind = rec.get("kind")
+        if fkind == "churn":
+            return _check_fields(rec, _FLEET_CHURN_REQUIRED, closed=True)
+        if fkind == "round":
+            return _check_fields(rec, _FLEET_ROUND_REQUIRED, closed=True)
+        if fkind == "episode":
+            return _check_fields(
+                rec, _FLEET_EPISODE_REQUIRED, closed=True
+            )
+        return [f"unknown fleet kind {fkind!r}"]
     if kind is None:
         return _check_fields(rec, _EXCHANGE_REQUIRED)
     return [f"unknown record kind {kind!r}"]
