@@ -461,6 +461,114 @@ def tcp_gate(
     return gate
 
 
+HIER_GATE_WINDOW = 8
+HIER_GATE_REL_TOL = 0.5
+
+
+def bench_hier(
+    total_peers: int,
+    island_sizes,
+    rounds: int,
+    target_rel: float,
+    seed: int = 0,
+) -> dict:
+    """Simulated-island sweep (docs/hierarchy.md): island_size ×
+    island_count at FIXED total peers, against the flat ring baseline.
+
+    Each point drives a :class:`~dpwa_tpu.hier.engine.HierGossipEngine`
+    episode at the same seed/rounds as the flat baseline and reports the
+    wide-area frame multiplier (flat frames / hier frames — the whole
+    point of the hierarchy) plus rounds-to-target, so the record shows
+    whether the frame saving cost any convergence.  Counts come from the
+    engine's frame accounting, not layout arithmetic — measured, never
+    assumed (the wire-sweep discipline)."""
+    from dpwa_tpu.hier.engine import HierGossipEngine
+    from dpwa_tpu.hier.topology import Topology
+
+    flat = HierGossipEngine(total_peers, seed=seed).run(
+        rounds, target_rel=target_rel
+    )
+    legs: dict = {}
+    for size in island_sizes:
+        size = int(size)
+        if size < 2 or total_peers % size or total_peers // size < 2:
+            continue
+        count = total_peers // size
+        res = HierGossipEngine(
+            total_peers, seed=seed, topology=Topology.uniform(count, size)
+        ).run(rounds, target_rel=target_rel)
+        legs[f"{count}x{size}"] = {
+            "island_count": count,
+            "island_size": size,
+            "wide_frames": res["wide_frames"],
+            "intra_frames": res["intra_frames"],
+            "wide_multiplier": round(
+                flat["wide_frames"] / max(res["wide_frames"], 1), 3
+            ),
+            "rounds_to_target": res["rounds_to_target"],
+            "final_rel_rms": round(res["final_rel_rms"], 9),
+        }
+    mults = [leg["wide_multiplier"] for leg in legs.values()]
+    return {
+        "total_peers": int(total_peers),
+        "rounds": int(rounds),
+        "target_rel": float(target_rel),
+        "seed": int(seed),
+        "flat": {
+            "wide_frames": flat["wide_frames"],
+            "rounds_to_target": flat["rounds_to_target"],
+            "final_rel_rms": round(flat["final_rel_rms"], 9),
+        },
+        "legs": legs,
+        "wide_multiplier_min": min(mults) if mults else None,
+    }
+
+
+def hier_gate(
+    history: list,
+    current_mult,
+    window: int = HIER_GATE_WINDOW,
+    rel_tol: float = HIER_GATE_REL_TOL,
+) -> dict:
+    """Regression gate for the hier sweep's WORST wide-frame multiplier
+    (pure; mirrors :func:`tcp_gate`): a refactor that quietly starts
+    fetching wide-area frames for non-leaders shows up here as a
+    "regressed" verdict against the recent history medians."""
+    samples = [
+        float(e["hier"]["wide_multiplier_min"])
+        for e in history
+        if isinstance(e, dict)
+        and e.get("record") == "bench"
+        and isinstance(e.get("hier"), dict)
+        and isinstance(
+            e["hier"].get("wide_multiplier_min"), (int, float)
+        )
+        and not isinstance(e["hier"].get("wide_multiplier_min"), bool)
+    ][-int(window):]
+    median = float(np.median(samples)) if samples else None
+    gate = {
+        "samples": len(samples),
+        "window": int(window),
+        "rel_tol": float(rel_tol),
+        "median_mult": round(median, 3) if median is not None else None,
+        "current_mult": (
+            round(float(current_mult), 3)
+            if current_mult is not None else None
+        ),
+    }
+    if current_mult is None or len(samples) < 2:
+        gate["verdict"] = "no_data"
+        return gate
+    cur = float(current_mult)
+    if cur < median * (1.0 - rel_tol):
+        gate["verdict"] = "regressed"
+    elif cur > median * (1.0 + rel_tol):
+        gate["verdict"] = "improved"
+    else:
+        gate["verdict"] = "ok"
+    return gate
+
+
 def read_bench_history(path: str, max_lines: int = 512) -> list:
     """Parse the tail of ``bench_history.jsonl``; [] when absent."""
     entries: list = []
@@ -973,6 +1081,30 @@ def main() -> None:
         help="skip the Rx serve leg (threaded vs reactor)",
     )
     ap.add_argument(
+        "--hier-leg", action="store_true",
+        help="run ONLY the hierarchical-gossip sweep: island_size x "
+        "island_count at fixed --hier-peers, wide-area frame multiplier "
+        "vs the flat ring + convergence rounds, gated against "
+        "bench_history.jsonl medians",
+    )
+    ap.add_argument(
+        "--hier-peers", type=int, default=64,
+        help="total peers for the hier sweep (islands partition this)",
+    )
+    ap.add_argument(
+        "--hier-rounds", type=int, default=64,
+        help="gossip rounds per hier sweep point",
+    )
+    ap.add_argument(
+        "--hier-target", type=float, default=0.05,
+        help="rel_rms convergence target for rounds_to_target",
+    )
+    ap.add_argument(
+        "--hier-island-sizes", type=str, default="4,8,16",
+        help="comma-separated island sizes to sweep (sizes that do not "
+        "divide --hier-peers are skipped)",
+    )
+    ap.add_argument(
         "--confirm-timeout", type=float, default=DEAD_CONFIRM_TIMEOUT_S,
         help="capped single-probe timeout once the backend dead-streak "
         "has tripped (the cheap re-confirmation instead of the full "
@@ -1001,6 +1133,46 @@ def main() -> None:
     if args.serve_leg:
         res = bench_serve(args.serve_frame_floats, args.serve_seconds)
         print("SERVE_LEG " + json.dumps(res), flush=True)
+        return
+    if args.hier_leg:
+        # Standalone mode (like the other legs, but user-facing): the
+        # engine is numpy + threefry draws, so it runs in-process on the
+        # CPU backend.  Appends its own record="bench" history line so
+        # the hier gate has medians to judge future runs against.
+        sizes = [
+            int(s) for s in args.hier_island_sizes.split(",") if s.strip()
+        ]
+        log(
+            f"hier sweep: {args.hier_peers} peers, sizes {sizes}, "
+            f"{args.hier_rounds} rounds, target {args.hier_target} ..."
+        )
+        hier = bench_hier(
+            args.hier_peers, sizes, args.hier_rounds, args.hier_target
+        )
+        history_path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "artifacts", "bench_history.jsonl",
+        )
+        hier["hier_gate"] = hier_gate(
+            read_bench_history(history_path), hier["wide_multiplier_min"]
+        )
+        if hier["hier_gate"]["verdict"] not in ("ok", "no_data"):
+            log(
+                f"hier gate: multiplier {hier['hier_gate']['verdict']} "
+                f"(current {hier['hier_gate']['current_mult']} vs median "
+                f"{hier['hier_gate']['median_mult']})"
+            )
+        out = {"metric": "hier_wide_frame_multiplier", "hier": hier}
+        print(json.dumps(out), flush=True)
+        try:
+            os.makedirs(os.path.dirname(history_path), exist_ok=True)
+            with open(history_path, "a", encoding="utf-8") as f:
+                f.write(
+                    json.dumps({"record": "bench", "t": time.time(), **out})
+                    + "\n"
+                )
+        except OSError:
+            pass
         return
 
     # --- TCP baseline.  Subprocess pinned to the CPU backend: the transport

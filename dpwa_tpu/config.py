@@ -238,6 +238,23 @@ Schema::
       recorder_rounds: 64       # flight-recorder ring depth (rounds)
       recorder_path: flight-{me}.jsonl  # dump path ("{me}" substituted;
                                 #   null = dpwa-flight-<me>.jsonl in cwd)
+    topology:                   # hierarchical gossip (docs/hierarchy.md);
+                                #   absent block = one flat ring,
+                                #   bit-identical to pre-hierarchy builds
+      islands:                  # partition of nodes: into islands — every
+                                #   node in EXACTLY one island; each island
+                                #   averages internally (ICI ppermute path)
+                                #   and only its elected leader speaks on
+                                #   the wide-area ring
+        - name: rack0           # island id (defaults island<i>)
+          nodes: [node0, node1] # member names from nodes:
+        - name: rack1
+          nodes: [node2, node3]
+      leader_seed: 0            # threefry seed of the leader_draw stream
+                                #   (election + failover succession)
+      intra_rounds: 1           # intra-island averaging sweeps folded in
+                                #   per wide-area round (hypercube phases;
+                                #   1 sweep = exact island mean)
 """
 
 from __future__ import annotations
@@ -1127,6 +1144,89 @@ class InterpolationConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class IslandSpec:
+    """One ``topology.islands`` entry: a named subset of ``nodes:``."""
+
+    name: str
+    nodes: tuple[str, ...] = ()
+
+
+@dataclasses.dataclass(frozen=True)
+class TopologyConfig:
+    """Two-level (island × wide-area) gossip topology; docs/hierarchy.md.
+
+    An empty ``islands`` tuple (the default, and the absent-block case)
+    means the flat single-ring topology — every pre-hierarchy config
+    keeps its exact behavior."""
+
+    islands: tuple[IslandSpec, ...] = ()
+    leader_seed: int = 0
+    intra_rounds: int = 1
+
+    def __post_init__(self) -> None:
+        if self.intra_rounds < 1:
+            raise ValueError(
+                f"topology.intra_rounds must be >= 1, got {self.intra_rounds}"
+            )
+        names = [isl.name for isl in self.islands]
+        if len(set(names)) != len(names):
+            dupes = sorted({n for n in names if names.count(n) > 1})
+            raise ValueError(f"duplicate island names in topology: {dupes}")
+        for isl in self.islands:
+            if not isl.nodes:
+                raise ValueError(
+                    f"topology island {isl.name!r} lists no nodes"
+                )
+            if len(set(isl.nodes)) != len(isl.nodes):
+                dupes = sorted(
+                    {n for n in isl.nodes if isl.nodes.count(n) > 1}
+                )
+                raise ValueError(
+                    f"topology island {isl.name!r} lists node(s) {dupes}"
+                    " more than once"
+                )
+
+    @property
+    def enabled(self) -> bool:
+        """Hierarchical mode on — at least one island is declared."""
+        return bool(self.islands)
+
+    def validate_nodes(self, node_names: Sequence[str]) -> None:
+        """Cross-check the island partition against the ``nodes:`` list.
+
+        Every error names the offending island and node: islands must
+        reference only declared nodes, no node may belong to two
+        islands, and — when the block is enabled — every node must be
+        covered (a super-peer topology with stragglers outside any
+        island has no one to speak for them)."""
+        if not self.enabled:
+            return
+        known = set(node_names)
+        owner: dict[str, str] = {}
+        for isl in self.islands:
+            for node in isl.nodes:
+                if node not in known:
+                    raise ValueError(
+                        f"topology island {isl.name!r} references unknown"
+                        f" node {node!r} (declared nodes:"
+                        f" {sorted(known)})"
+                    )
+                if node in owner:
+                    raise ValueError(
+                        f"node {node!r} appears in both island"
+                        f" {owner[node]!r} and island {isl.name!r} — a"
+                        " node belongs to exactly one island"
+                    )
+                owner[node] = isl.name
+        uncovered = [n for n in node_names if n not in owner]
+        if uncovered:
+            raise ValueError(
+                f"topology islands do not cover node(s) {uncovered} — every"
+                " node must belong to exactly one island"
+            )
+
+
+@dataclasses.dataclass(frozen=True)
 class DpwaConfig:
     nodes: tuple[NodeSpec, ...]
     protocol: ProtocolConfig = ProtocolConfig()
@@ -1138,6 +1238,13 @@ class DpwaConfig:
     trust: TrustConfig = TrustConfig()
     flowctl: FlowctlConfig = FlowctlConfig()
     obs: ObsConfig = ObsConfig()
+    topology: TopologyConfig = TopologyConfig()
+
+    def __post_init__(self) -> None:
+        # Errors here name the offending island/node (satellite fix):
+        # the partition is validated against the ACTUAL nodes: list, not
+        # just internally.
+        self.topology.validate_nodes(self.node_names)
 
     @property
     def n_peers(self) -> int:
@@ -1185,6 +1292,28 @@ def _build_nodes(raw: Sequence[Any]) -> tuple[NodeSpec, ...]:
     return tuple(nodes)
 
 
+def _build_islands(raw: Sequence[Any]) -> tuple[IslandSpec, ...]:
+    islands = []
+    for i, entry in enumerate(raw):
+        if isinstance(entry, Mapping):
+            islands.append(
+                IslandSpec(
+                    name=str(entry.get("name", f"island{i}")),
+                    nodes=tuple(str(n) for n in (entry.get("nodes") or ())),
+                )
+            )
+        elif isinstance(entry, Sequence) and not isinstance(entry, (str, bytes)):
+            # Shorthand: a bare member list gets a positional island name.
+            islands.append(
+                IslandSpec(
+                    name=f"island{i}", nodes=tuple(str(n) for n in entry)
+                )
+            )
+        else:
+            raise TypeError(f"bad topology.islands[{i}] entry: {entry!r}")
+    return tuple(islands)
+
+
 def config_from_dict(raw: Mapping[str, Any]) -> DpwaConfig:
     """Build a :class:`DpwaConfig` from a parsed-YAML mapping."""
     if "nodes" not in raw:
@@ -1198,6 +1327,9 @@ def config_from_dict(raw: Mapping[str, Any]) -> DpwaConfig:
     trust = dict(raw.get("trust") or {})
     flowctl = dict(raw.get("flowctl") or {})
     obs = dict(raw.get("obs") or {})
+    topology = dict(raw.get("topology") or {})
+    if topology.get("islands") is not None:
+        topology["islands"] = _build_islands(topology["islands"])
     for key in (
         "down_windows", "partition_windows", "link_windows",
         "byzantine_peers", "trickle_windows", "accept_delay_windows",
@@ -1215,6 +1347,7 @@ def config_from_dict(raw: Mapping[str, Any]) -> DpwaConfig:
         trust=TrustConfig(**trust),
         flowctl=FlowctlConfig(**flowctl),
         obs=ObsConfig(**obs),
+        topology=TopologyConfig(**topology),
     )
 
 
@@ -1243,6 +1376,7 @@ def make_local_config(
     trust: "TrustConfig | Mapping[str, Any] | None" = None,
     flowctl: "FlowctlConfig | Mapping[str, Any] | None" = None,
     obs: "ObsConfig | Mapping[str, Any] | None" = None,
+    topology: "TopologyConfig | Mapping[str, Any] | None" = None,
     **protocol_kwargs: Any,
 ) -> DpwaConfig:
     """Programmatic config for tests/benchmarks: n local peers on 127.0.0.1.
@@ -1264,6 +1398,11 @@ def make_local_config(
         flowctl = FlowctlConfig(**flowctl)
     if isinstance(obs, Mapping):
         obs = ObsConfig(**obs)
+    if isinstance(topology, Mapping):
+        topology = dict(topology)
+        if topology.get("islands") is not None:
+            topology["islands"] = _build_islands(topology["islands"])
+        topology = TopologyConfig(**topology)
     return DpwaConfig(
         nodes=tuple(
             NodeSpec(name=f"node{i}", host="127.0.0.1", port=base_port + i)
@@ -1283,4 +1422,5 @@ def make_local_config(
         trust=trust if trust is not None else TrustConfig(),
         flowctl=flowctl if flowctl is not None else FlowctlConfig(),
         obs=obs if obs is not None else ObsConfig(),
+        topology=topology if topology is not None else TopologyConfig(),
     )

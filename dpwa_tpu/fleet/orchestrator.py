@@ -45,6 +45,8 @@ from dpwa_tpu.config import (
 )
 from dpwa_tpu.fleet.schedule import ChurnSchedule, ChurnSpec
 from dpwa_tpu.health.chaos import ChaosEngine
+from dpwa_tpu.hier.leader import LeaderBoard
+from dpwa_tpu.hier.topology import Topology
 from dpwa_tpu.health.detector import Outcome
 from dpwa_tpu.health.scoreboard import Scoreboard
 from dpwa_tpu.membership.manager import MembershipManager
@@ -61,10 +63,17 @@ class SimNode:
     bumped incarnation (which is what lets it refute stale DEAD claims,
     docs/membership.md)."""
 
-    def __init__(self, peer: int, n_peers: int, seed: int):
+    def __init__(
+        self,
+        peer: int,
+        n_peers: int,
+        seed: int,
+        topology: Optional[Topology] = None,
+    ):
         self.peer = int(peer)
         self.n_peers = int(n_peers)
         self.seed = int(seed)
+        self.topology = topology
         self.alive = False
         self.boots = 0
         self.next_incarnation = 0
@@ -81,12 +90,18 @@ class SimNode:
         self.board = Scoreboard(
             self.n_peers, self.peer, config=health, seed=self.seed
         )
+        # With a topology the node's manager owns a per-node LeaderBoard
+        # (built inside MembershipManager) and speaks the v2 digest:
+        # every node converges on leadership through gossip, the way the
+        # live transport does — the orchestrator's own board is just the
+        # ground-truth copy the schedule resolves restarts against.
         self.membership = MembershipManager(
             self.n_peers,
             self.peer,
             self.board,
             config=member,
             seed=self.seed,
+            topology=self.topology,
         )
         self.membership.incarnation = self.next_incarnation
         self.next_incarnation += 1
@@ -134,9 +149,16 @@ class FleetOrchestrator:
         incidents: Optional[ObsConfig] = None,
         path: Optional[str] = None,
         initial_live: Optional[int] = None,
+        topology: Optional[Topology] = None,
     ):
         self.n_peers = int(n_peers)
         self.spec = spec
+        if topology is not None and topology.n_peers != self.n_peers:
+            raise ValueError(
+                f"topology covers {topology.n_peers} peers, fleet has"
+                f" {self.n_peers}"
+            )
+        self.topology = topology
         self.seed = int(spec.seed)
         self.dim = int(dim)
         self.health = health if health is not None else HealthConfig()
@@ -158,7 +180,21 @@ class FleetOrchestrator:
                 byzantine_zero_probability=0.1,
             )
         )
-        self.schedule = ChurnSchedule(spec, self.n_peers)
+        self.schedule = ChurnSchedule(spec, self.n_peers, topology=topology)
+        # Ground-truth leadership view the orchestrator itself maintains
+        # (resolves leader restarts, stamps island records); per-node
+        # boards live inside each SimNode's MembershipManager and
+        # converge on this through v2 digests.
+        self.leader_board = (
+            LeaderBoard(topology, seed=self.seed)
+            if topology is not None
+            else None
+        )
+        self._board_events: List[dict] = (
+            list(self.leader_board.initial_events())
+            if self.leader_board is not None
+            else []
+        )
         self.observer = spec.protected[0] if spec.protected else 0
         self._path = path
         self._file = (
@@ -184,7 +220,7 @@ class FleetOrchestrator:
             mode="pull",
         )
         self.nodes = [
-            SimNode(p, self.n_peers, self.seed)
+            SimNode(p, self.n_peers, self.seed, topology=topology)
             for p in range(self.n_peers)
         ]
         n_live = (
@@ -198,7 +234,8 @@ class FleetOrchestrator:
         if inc_cfg is None:
             inc_cfg = ObsConfig()
         self.incidents = IncidentPlane(
-            self.observer, self.n_peers, inc_cfg, path=None
+            self.observer, self.n_peers, inc_cfg, path=None,
+            topology=topology,
         )
         # Convergence bookkeeping: (event round, peer) -> resolved round.
         self._leave_pending: Dict[int, int] = {}  # peer -> left round
@@ -249,6 +286,18 @@ class FleetOrchestrator:
         # forever and poison the episode summary).
         self._leave_pending.pop(peer, None)
         self._join_pending.setdefault(peer, int(round_))
+        if self.leader_board is not None:
+            self._board_events.extend(self.leader_board.note_alive(peer))
+
+    def _stop_peer(self, peer: int, round_: int) -> None:
+        self.nodes[peer].stop()
+        self._leave_pending.setdefault(peer, int(round_))
+        self._join_pending.pop(peer, None)
+        if self.leader_board is not None:
+            # Leader deaths bump the island's term and draw a successor
+            # — the ground-truth copy of what each node's board does
+            # once its scoreboard notices (docs/hierarchy.md).
+            self._board_events.extend(self.leader_board.note_dead(peer))
 
     # ------------------------------------------------------------------
     # One gossip exchange (plane-level wire)
@@ -305,9 +354,7 @@ class FleetOrchestrator:
             group = self.schedule.partition_group(r)
             # -- churn application ------------------------------------
             for p in ev.leaves:
-                self.nodes[p].stop()
-                self._leave_pending.setdefault(p, r)
-                self._join_pending.pop(p, None)
+                self._stop_peer(p, r)
             for p in ev.joins:
                 self._boot_peer(p, r)
             for p in ev.cohort:
@@ -316,8 +363,28 @@ class FleetOrchestrator:
                 # Rolling restart: down and back within the round, state
                 # restored through the donor path (the supervisor's
                 # crash->bootstrap cycle compressed to one round).
-                self.nodes[p].stop()
+                self._stop_peer(p, r)
                 self._boot_peer(p, r)
+            # Island-granular families (hier fleets only; empty tuples
+            # on flat fleets keep this a no-op).
+            for p in ev.island_leaves:
+                self._stop_peer(p, r)
+            for p in ev.island_joins:
+                self._boot_peer(p, r)
+            leader_restarts: List[int] = []
+            for g in ev.leader_restart_islands:
+                # The schedule names the ISLAND; the orchestrator's
+                # ground-truth board resolves who its leader is NOW.
+                leader = self.leader_board.leader_of(g)
+                if (
+                    leader is None
+                    or leader in self.spec.protected
+                    or not self.nodes[leader].alive
+                ):
+                    continue
+                self._stop_peer(leader, r)
+                self._boot_peer(leader, r)
+                leader_restarts.append(leader)
             live = self._live()
             # -- gossip exchanges -------------------------------------
             digests: Dict[int, bytes] = {}
@@ -384,6 +451,13 @@ class FleetOrchestrator:
                 events = self.nodes[f].membership.pop_events()
                 if f == self.observer:
                     obs_events = events
+            if self._board_events:
+                # Leadership events from this round's churn (elections,
+                # failover successions) reach the observer alongside its
+                # own membership events — the incident plane classifies
+                # leader_failover as a root cause (docs/incidents.md).
+                obs_events = obs_events + self._board_events
+                self._board_events = []
             rel_rms = self._rel_rms(live)
             wall = time.perf_counter() - t0
             max_wall = max(max_wall, wall)
@@ -409,20 +483,44 @@ class FleetOrchestrator:
             # -- records ----------------------------------------------
             evicted = obs.board.evicted_peers()
             if not ev.quiet:
-                self._emit(
-                    {
-                        "record": "fleet",
-                        "kind": "churn",
+                churn_rec = {
+                    "record": "fleet",
+                    "kind": "churn",
+                    "round": r,
+                    "leaves": list(ev.leaves),
+                    "joins": list(ev.joins),
+                    "cohort": list(ev.cohort),
+                    "restart": list(ev.restart),
+                    "chaos": list(ev.chaos),
+                    "live": len(live),
+                    "evicted": evicted,
+                }
+                if self.topology is not None:
+                    # Hier-only optional fields — a flat fleet's churn
+                    # stream stays byte-identical to pre-hierarchy runs.
+                    churn_rec["island_leaves"] = list(ev.island_leaves)
+                    churn_rec["island_joins"] = list(ev.island_joins)
+                    churn_rec["churned_islands"] = list(
+                        ev.churned_islands
+                    )
+                    churn_rec["leader_restarts"] = leader_restarts
+                self._emit(churn_rec)
+            if self.topology is not None:
+                for g in range(self.topology.n_islands):
+                    members = self.topology.members_of(g)
+                    live_m = [p for p in members if self.nodes[p].alive]
+                    island_rec = {
+                        "record": "island",
                         "round": r,
-                        "leaves": list(ev.leaves),
-                        "joins": list(ev.joins),
-                        "cohort": list(ev.cohort),
-                        "restart": list(ev.restart),
-                        "chaos": list(ev.chaos),
-                        "live": len(live),
-                        "evicted": evicted,
+                        "island": self.topology.island_name(g),
+                        "term": self.leader_board.term_of(g),
+                        "live": len(live_m),
+                        "rel_rms": round(self._rel_rms(live_m), 9),
                     }
-                )
+                    leader = self.leader_board.leader_of(g)
+                    if leader is not None:
+                        island_rec["leader"] = int(leader)
+                    self._emit(island_rec)
             self._emit(
                 {
                     "record": "fleet",
@@ -506,6 +604,13 @@ class FleetOrchestrator:
             "alerts": dict(sorted(alerts_total.items())),
             "incidents_opened": incidents_opened,
         }
+        if self.topology is not None:
+            # Hier-only optional fields (flat episodes byte-identical).
+            episode["islands"] = self.topology.n_islands
+            episode["leader_terms"] = {
+                self.topology.island_name(g): self.leader_board.term_of(g)
+                for g in range(self.topology.n_islands)
+            }
         self._emit(episode)
         if self._file is not None:
             self._file.close()

@@ -19,6 +19,21 @@ every other control decision in this repo follows.  Four event families
   rolling-restarted (leave + rejoin in the same round, state restored
   from a donor — ``churn_restart_draw`` picks the victim).
 
+Hierarchical fleets (a :class:`~dpwa_tpu.hier.topology.Topology` handed
+to :class:`ChurnSchedule`) add two island-granular families
+(docs/hierarchy.md):
+
+- **island churn** — every ``island_churn_every`` rounds each island
+  draws ``island_churn_draw``; under ``island_churn_probability`` the
+  WHOLE island toggles (live → leaves as one cohort, fully-departed →
+  rejoins as one), modeling a rack/pod power event rather than
+  uncorrelated peer exits;
+- **leader restarts** — every ``leader_restart_every`` rounds the
+  rotation lands on the next island; the schedule names the ISLAND only
+  (``leader_restart_islands``) because who its leader is at that round
+  is the orchestrator's live :class:`LeaderBoard` state, not a pure
+  function of the seed.
+
 Plus **chaos windows**: round intervals ``[start, stop)`` during which
 named fault classes (``partition`` / ``byzantine`` / ``straggler``,
 concurrently — the *mixed* windows ROADMAP asks for) are active.  The
@@ -41,6 +56,7 @@ from dpwa_tpu.parallel.schedules import (
     churn_join_draw,
     churn_leave_draw,
     churn_restart_draw,
+    island_churn_draw,
 )
 
 
@@ -78,8 +94,17 @@ class ChurnSpec:
     min_live: int = 2
     protected: Tuple[int, ...] = (0,)  # never churned (the observer)
     chaos_windows: Tuple[ChaosWindow, ...] = ()
+    # Island-granular churn (needs a Topology on the ChurnSchedule).
+    island_churn_every: int = 0  # 0 = no whole-island churn
+    island_churn_probability: float = 0.5
+    leader_restart_every: int = 0  # 0 = no rolling leader restarts
 
     def __post_init__(self) -> None:
+        if not 0.0 <= self.island_churn_probability <= 1.0:
+            raise ValueError(
+                f"island_churn_probability must be in [0, 1], "
+                f"got {self.island_churn_probability}"
+            )
         if not 0.0 <= self.leave_probability <= 1.0:
             raise ValueError(
                 f"leave_probability must be in [0, 1], "
@@ -115,21 +140,39 @@ class ChurnEvents:
     cohort: Tuple[int, ...]
     restart: Tuple[int, ...]  # () or (peer,)
     chaos: Tuple[str, ...]  # active fault classes, sorted
+    # Hierarchical families (empty on flat fleets — the flat record
+    # stream stays byte-identical, docs/hierarchy.md).
+    island_leaves: Tuple[int, ...] = ()  # peers, whole islands at once
+    island_joins: Tuple[int, ...] = ()
+    churned_islands: Tuple[int, ...] = ()  # island indices this round
+    leader_restart_islands: Tuple[int, ...] = ()  # rotation targets
 
     @property
     def quiet(self) -> bool:
         return not (
             self.leaves or self.joins or self.cohort or self.restart
-            or self.chaos
+            or self.chaos or self.island_leaves or self.island_joins
+            or self.leader_restart_islands
         )
 
 
 class ChurnSchedule:
-    """Resolve :class:`ChurnSpec` draws against a live/departed split."""
+    """Resolve :class:`ChurnSpec` draws against a live/departed split.
 
-    def __init__(self, spec: ChurnSpec, n_peers: int):
+    ``topology`` (a :class:`~dpwa_tpu.hier.topology.Topology`) arms the
+    island-granular families; None keeps the flat families only."""
+
+    def __init__(self, spec: ChurnSpec, n_peers: int, topology=None):
         self.spec = spec
         self.n_peers = int(n_peers)
+        self.topology = topology
+        if topology is None and (
+            spec.island_churn_every > 0 or spec.leader_restart_every > 0
+        ):
+            raise ValueError(
+                "island_churn_every / leader_restart_every need a"
+                " topology on the ChurnSchedule"
+            )
 
     def partition_group(self, round_: int) -> Tuple[int, ...]:
         """The minority side of the partition active at ``round_``
@@ -204,6 +247,53 @@ class ChurnSchedule:
                 idx = churn_restart_draw(spec.seed, round_, len(candidates))
                 restart = [candidates[idx]]
 
+        island_leaves: list = []
+        island_joins: list = []
+        churned_islands: list = []
+        topo = self.topology
+        if (
+            topo is not None
+            and spec.island_churn_every > 0
+            and round_ > 0
+            and round_ % spec.island_churn_every == 0
+        ):
+            live_set = set(live_sorted) - set(leaves)
+            departed_set = set(departed_sorted) | set(leaves)
+            taken = set(leaves) | set(joins) | set(cohort) | set(restart)
+            for g in range(topo.n_islands):
+                members = topo.members_of(g)
+                if protected & set(members) or taken & set(members):
+                    continue
+                draw = float(island_churn_draw(spec.seed, round_, g))
+                if draw >= spec.island_churn_probability:
+                    continue
+                live_members = [p for p in members if p in live_set]
+                if live_members:
+                    # Whole-island power event, floored like leaves.
+                    remaining = len(live_set) - len(live_members)
+                    if remaining < spec.min_live:
+                        continue
+                    island_leaves.extend(live_members)
+                    live_set -= set(live_members)
+                    churned_islands.append(g)
+                elif all(p in departed_set for p in members):
+                    island_joins.extend(members)
+                    churned_islands.append(g)
+
+        leader_restart_islands: list = []
+        if (
+            topo is not None
+            and spec.leader_restart_every > 0
+            and round_ > 0
+            and round_ % spec.leader_restart_every == 0
+        ):
+            # Rolling rotation over islands; the orchestrator resolves
+            # the island's CURRENT leader (LeaderBoard state) and skips
+            # islands whose leader is protected or already churned.
+            g = (round_ // spec.leader_restart_every - 1) % topo.n_islands
+            if g not in churned_islands:
+                leader_restart_islands.append(g)
+
         chaos = sorted(
             {
                 k
@@ -219,4 +309,8 @@ class ChurnSchedule:
             cohort=tuple(cohort),
             restart=tuple(restart),
             chaos=tuple(chaos),
+            island_leaves=tuple(sorted(island_leaves)),
+            island_joins=tuple(sorted(island_joins)),
+            churned_islands=tuple(sorted(churned_islands)),
+            leader_restart_islands=tuple(leader_restart_islands),
         )

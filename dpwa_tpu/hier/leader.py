@@ -1,0 +1,162 @@
+"""Per-island leader election and failover succession.
+
+Each island elects ONE leader — the only member that speaks on the
+wide-area ring (docs/hierarchy.md).  Election is a coordination-free
+threefry draw: :func:`schedules.leader_draw` keyed on
+``(seed, term, island)`` indexes the island's SORTED live-member list,
+so every replica that agrees on who is alive computes the same leader
+with zero message rounds.  Succession is the same draw at the next term:
+when the scoreboard/membership plane marks the leader dead, the board
+bumps the island's term and re-draws over the survivors — deterministic
+failover, replayable in tests bit-for-bit.
+
+Terms only ever increase and ride the v2 membership digest
+(``leader_term`` per entry), so a stale leader claim loses to the
+successor's higher term under the standard SWIM merge rules.
+
+The board emits bare event dicts (``leader_elected`` /
+``leader_failover``) in the same shape the membership manager uses; the
+hosting plane wraps them into full JSONL records (tools/schema_check.py
+freezes the kinds).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from dpwa_tpu.hier.topology import Topology
+from dpwa_tpu.parallel.schedules import leader_draw
+
+
+class LeaderBoard:
+    """Who speaks for each island, at which term.
+
+    Not thread-safe by itself — callers serialize through the plane that
+    owns it (the orchestrator loop, or the transport's membership lock).
+    """
+
+    def __init__(self, topology: Topology, seed: int = 0):
+        self.topology = topology
+        self.seed = int(seed)
+        self._terms: List[int] = [0] * topology.n_islands
+        self._alive: List[set] = [
+            set(topology.members_of(g)) for g in range(topology.n_islands)
+        ]
+        self._leaders: List[Optional[int]] = [
+            self._elect(g) for g in range(topology.n_islands)
+        ]
+
+    def _elect(self, island: int) -> Optional[int]:
+        """Draw the leader for ``island`` at its current term over the
+        sorted survivors; None when the island has no one left."""
+        candidates = sorted(self._alive[island])
+        if not candidates:
+            return None
+        idx = leader_draw(
+            self.seed, self._terms[island], island, len(candidates)
+        )
+        return candidates[idx]
+
+    # --- queries ---
+
+    def leader_of(self, island: int) -> Optional[int]:
+        return self._leaders[island]
+
+    def term_of(self, island: int) -> int:
+        return self._terms[island]
+
+    def is_leader(self, peer: int) -> bool:
+        return self._leaders[self.topology.island_of(peer)] == peer
+
+    def leaders(self) -> Dict[int, Optional[int]]:
+        """island index -> current leader peer id (None = empty island)."""
+        return dict(enumerate(self._leaders))
+
+    # --- lifecycle ---
+
+    def initial_events(self) -> List[dict]:
+        """The term-0 ``leader_elected`` events (one per non-empty island)."""
+        return [
+            {
+                "event": "leader_elected",
+                "island": self.topology.island_name(g),
+                "peer": leader,
+                "term": self._terms[g],
+            }
+            for g, leader in enumerate(self._leaders)
+            if leader is not None
+        ]
+
+    def note_dead(self, peer: int) -> List[dict]:
+        """Fold a death in; returns the succession events it caused.
+
+        A dead non-leader changes nothing (the candidate set just
+        shrinks for FUTURE elections).  A dead leader bumps the island's
+        term and re-draws over the survivors — exactly one
+        ``leader_failover`` event per succession."""
+        g = self.topology.island_of(peer)
+        self._alive[g].discard(peer)
+        if self._leaders[g] != peer:
+            return []
+        old = self._leaders[g]
+        self._terms[g] += 1
+        self._leaders[g] = self._elect(g)
+        return [
+            {
+                "event": "leader_failover",
+                "island": self.topology.island_name(g),
+                "old_leader": old,
+                "peer": self._leaders[g],
+                "term": self._terms[g],
+            }
+        ]
+
+    def adopt(self, island: int, term: int, leader: Optional[int]) -> List[dict]:
+        """Fold a remote leadership claim (digest v2 evidence).
+
+        Terms only ever increase and the island's board is the sole
+        writer, so a claim at a HIGHER term is strictly fresher — adopt
+        its leader outright.  Same-term claims agree by construction
+        (same threefry draw over the same survivor set) and lower terms
+        are stale noise; both are no-ops.  Returns the
+        ``leader_elected`` event the adoption caused (at most one)."""
+        term = int(term)
+        if term <= self._terms[island]:
+            return []
+        self._terms[island] = term
+        self._leaders[island] = (
+            leader if leader is not None else self._elect(island)
+        )
+        if self._leaders[island] is None:
+            return []
+        return [
+            {
+                "event": "leader_elected",
+                "island": self.topology.island_name(island),
+                "peer": self._leaders[island],
+                "term": term,
+            }
+        ]
+
+    def note_alive(self, peer: int) -> List[dict]:
+        """A peer (re)joined its island's candidate set.
+
+        Leadership is deliberately sticky: a return does NOT trigger a
+        re-election (churny peers flapping the leadership would thrash
+        the wide-area ring) — UNLESS the island was left leaderless, in
+        which case the returnee's arrival elects a leader at a fresh
+        term."""
+        g = self.topology.island_of(peer)
+        self._alive[g].add(peer)
+        if self._leaders[g] is not None:
+            return []
+        self._terms[g] += 1
+        self._leaders[g] = self._elect(g)
+        return [
+            {
+                "event": "leader_elected",
+                "island": self.topology.island_name(g),
+                "peer": self._leaders[g],
+                "term": self._terms[g],
+            }
+        ]

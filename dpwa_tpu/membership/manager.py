@@ -36,6 +36,7 @@ opposite orders would deadlock).
 
 from __future__ import annotations
 
+import dataclasses
 import threading
 from typing import Any, Callable, Dict, List, Optional, Set
 
@@ -44,6 +45,9 @@ from dpwa_tpu.health.scoreboard import PeerState, Scoreboard
 from dpwa_tpu.membership.digest import (
     ALIVE,
     DEAD,
+    DIGEST_VERSION,
+    DIGEST_VERSION_HIER,
+    NO_ISLAND,
     QUARANTINED,
     STATE_NAMES,
     SUSPECT,
@@ -71,12 +75,26 @@ class MembershipManager:
         scoreboard: Scoreboard,
         config: Optional[MembershipConfig] = None,
         seed: int = 0,
+        topology: Optional[Any] = None,
+        leader_board: Optional[Any] = None,
     ):
         self.config = config if config is not None else MembershipConfig()
         self.n_peers = n_peers
         self.me = me
         self.seed = seed
         self.scoreboard = scoreboard
+        # Hierarchical gossip (docs/hierarchy.md): with a Topology the
+        # digest is encoded at DIGEST_VERSION_HIER — each entry carries
+        # the peer's island, the island's leadership term, and a leader
+        # flag — and merge() folds remote leadership claims into the
+        # LeaderBoard.  Flat rings (topology=None) stay on v1
+        # byte-identically.
+        self.topology = topology
+        if topology is not None and leader_board is None:
+            from dpwa_tpu.hier.leader import LeaderBoard
+
+            leader_board = LeaderBoard(topology, seed=seed)
+        self.leader_board = leader_board
         self._lock = threading.Lock()
         self.incarnation = 0
         self._view: Dict[int, MemberEntry] = {}
@@ -161,8 +179,30 @@ class MembershipManager:
             entries[self.me] = MemberEntry(
                 state=ALIVE, incarnation=self.incarnation, suspicion=0.0
             )
+            version = DIGEST_VERSION
+            if self.topology is not None:
+                # Stamp each entry with its island and the island's
+                # CURRENT leadership claim — term + leader flag — so
+                # succession disseminates epidemic-style alongside the
+                # liveness states (the board reads happen under our
+                # lock, which is where merge() mutates it).
+                version = DIGEST_VERSION_HIER
+                topo, board = self.topology, self.leader_board
+                for peer, e in sorted(entries.items()):
+                    g = topo.island_of(peer)
+                    entries[peer] = dataclasses.replace(
+                        e,
+                        island=g,
+                        leader_term=board.term_of(g),
+                        is_leader=board.leader_of(g) == peer,
+                    )
             return encode_digest(
-                Digest(origin=self.me, round=int(round), entries=entries)
+                Digest(
+                    origin=self.me,
+                    round=int(round),
+                    entries=entries,
+                    version=version,
+                )
             )
 
     def merge(self, blob: Optional[bytes], round: Optional[int] = None) -> None:
@@ -214,6 +254,30 @@ class MembershipManager:
                 elif fresher and merged.state == ALIVE:
                     # The peer refuted a suspicion we were carrying.
                     readmits.append(peer)
+            if (
+                self.leader_board is not None
+                and digest.version == DIGEST_VERSION_HIER
+            ):
+                # Fold remote leadership claims: a leader-flagged entry
+                # at a higher term moves our board to the successor
+                # (terms only increase; LeaderBoard.adopt drops stale
+                # and same-term claims).  Board mutations stay under our
+                # lock — encode() reads it there too.
+                topo = self.topology
+                for peer, claim in sorted(digest.entries.items()):
+                    if (
+                        not claim.is_leader
+                        or claim.island == NO_ISLAND
+                        or claim.island >= topo.n_islands
+                        or peer >= self.n_peers
+                        or topo.island_of(peer) != claim.island
+                    ):
+                        continue
+                    events.extend(
+                        self.leader_board.adopt(
+                            claim.island, claim.leader_term, peer
+                        )
+                    )
             self._events.extend(events)
         for peer in adopts:
             self.scoreboard.adopt_quarantine(peer, round=r)

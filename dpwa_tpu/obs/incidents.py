@@ -83,6 +83,11 @@ _SOFT = (Outcome.BUSY, Outcome.SLOW)
 ALERT_KINDS: Dict[str, tuple] = {
     "partition": ("membership", "partition", "critical"),
     "partition_flap": ("membership", "partition", "critical"),
+    # Hierarchical (island-scoped) root causes, docs/hierarchy.md: a
+    # partition whose cut is exactly a union of whole islands, and a
+    # leader-board succession after the elected leader died.
+    "island_partition": ("membership", "island_partition", "critical"),
+    "leader_failover": ("hier", "leader_failover", "critical"),
     "trust_burst": ("trust", "byzantine", "critical"),
     "peer_failure": ("health", "peer_down", "critical"),
     "straggler": ("flowctl", "straggler", "warning"),
@@ -93,11 +98,16 @@ ALERT_KINDS: Dict[str, tuple] = {
 
 # Root-cause priority between incident classifications (first wins):
 # concurrent alert kinds fold into one incident classified by the
-# highest-priority evidence.  Wall-clock detectors rank last so timing
-# jitter can never misclassify an evidence-keyed chaos incident.
+# highest-priority evidence.  island_partition outranks the generic
+# partition because it is the same evidence made MORE specific (the cut
+# aligned with island boundaries); leader_failover outranks peer_down
+# because a dead leader usually also fires the fetch-streak detector,
+# and the succession event is the root cause, not the symptom.
+# Wall-clock detectors rank last so timing jitter can never misclassify
+# an evidence-keyed chaos incident.
 KIND_PRIORITY = (
-    "partition", "byzantine", "peer_down", "straggler",
-    "state_storm", "slo_burn", "conv_stall",
+    "island_partition", "partition", "byzantine", "leader_failover",
+    "peer_down", "straggler", "state_storm", "slo_burn", "conv_stall",
 )
 
 _SEV_RANK = {"warning": 1, "critical": 2}
@@ -131,10 +141,15 @@ class IncidentPlane:
         n_peers: int,
         cfg,
         path: Optional[str] = None,
+        topology=None,
     ):
         self.me = int(me)
         self.n_peers = int(n_peers)
         self.cfg = cfg
+        # Optional hier Topology: arms the island-scoped classifiers
+        # (island_partition alignment check).  None on flat rings —
+        # detector behavior is then byte-identical to pre-hierarchy.
+        self.topology = topology
         if path is None:
             path = cfg.incident_path
         self._logger = (
@@ -304,11 +319,30 @@ class IncidentPlane:
                     p for p in range(self.n_peers)
                     if p != self.me and comp is not None and p not in comp
                 }
-                _fire("partition", cut or others,
-                      len(comp) if comp is not None else 0,
-                      float(ev.get("quorum_fraction", 0.0)))
+                cut_islands = self._island_aligned_cut(cut)
+                if cut_islands is not None:
+                    # The cut is exactly a union of whole islands — the
+                    # island-scoped root cause, fired INSTEAD of the
+                    # generic partition alert (same evidence, more
+                    # specific classification).
+                    _fire("island_partition", cut, len(cut_islands),
+                          float(ev.get("quorum_fraction", 0.0)))
+                else:
+                    _fire("partition", cut or others,
+                          len(comp) if comp is not None else 0,
+                          float(ev.get("quorum_fraction", 0.0)))
             elif kind == "partition_healed":
                 self._partition_live = False
+            elif kind == "leader_failover":
+                # Leader-board succession (dpwa_tpu/hier/leader.py): the
+                # old leader is the implicated peer; value carries the
+                # new term so operators can line incidents up with the
+                # digest's leader_term column.
+                peers = set()
+                if ev.get("old_leader") is not None:
+                    peers.add(int(ev["old_leader"]))
+                _fire("leader_failover", peers,
+                      float(ev.get("term", 0)), 1.0)
             elif kind == "trust_collapsed":
                 p = ev.get("peer")
                 if p is not None:
@@ -392,6 +426,32 @@ class IncidentPlane:
     # ------------------------------------------------------------------
     # Correlator (called under self._lock)
     # ------------------------------------------------------------------
+
+    def _island_aligned_cut(self, cut) -> Optional[list]:
+        """The islands a cut consists of, when it is EXACTLY a union of
+        whole islands of the configured topology; None otherwise (no
+        topology, empty cut, or a cut that splits an island).  ``me``'s
+        own island never counts as cut — the local node is by definition
+        on this side of it."""
+        topo = self.topology
+        if topo is None or not cut:
+            return None
+        cut_islands = []
+        covered: Set[int] = set()
+        for g in range(topo.n_islands):
+            members = set(topo.members_of(g))
+            if self.me in members:
+                continue
+            inside = members & cut
+            if not inside:
+                continue
+            if inside != members:
+                return None  # island straddles the cut — not aligned
+            cut_islands.append(g)
+            covered |= members
+        if covered != set(cut):
+            return None
+        return cut_islands
 
     @staticmethod
     def _rank(kind: str) -> int:

@@ -78,6 +78,11 @@ BUSY_HDR_FMT = "<4sBH"
 DIGEST_HDR_FMT = "<4sBHIH"
 # One digest entry: peer(H) state(B) incarnation(I) suspicion(f).
 DIGEST_ENTRY_FMT = "<HBIf"
+# Version-2 (hierarchical) digest entry: the v1 fields, then
+# island(H) leader_term(H) flags(B) — flags bit0 = "is the island's
+# elected leader".  The header's u8 version field selects the entry
+# width; see BACK_COMPAT["digest_v2_hier_entries"].
+DIGEST_ENTRY_V2_FMT = "<HBIfHHB"
 # Observability trailer header: magic(4s) version(B) sketch_count(H)
 # trace_id(I) loss_ema(f) reserved(H), then sketch_count f32 values.
 OBS_HDR_FMT = "<4sBHIfH"
@@ -100,6 +105,7 @@ RELAY_HDR = struct.Struct(RELAY_HDR_FMT)
 BUSY_HDR = struct.Struct(BUSY_HDR_FMT)
 DIGEST_HDR = struct.Struct(DIGEST_HDR_FMT)
 DIGEST_ENTRY = struct.Struct(DIGEST_ENTRY_FMT)
+DIGEST_ENTRY_V2 = struct.Struct(DIGEST_ENTRY_V2_FMT)
 OBS_HDR = struct.Struct(OBS_HDR_FMT)
 STATE_PACK_LEN = struct.Struct(STATE_PACK_LEN_FMT)
 
@@ -195,6 +201,17 @@ BACK_COMPAT: Dict[str, str] = {
         "its next read fails the DPWM magic check on the DPWT header "
         "and stops harmlessly; obs-aware fetchers dispatch trailers by "
         "magic and handle every presence combination."
+    ),
+    "digest_v2_hier_entries": (
+        "Digest version 2 (hierarchical gossip) widens each entry from "
+        "11 to 16 bytes by APPENDING island id, leader term, and a "
+        "leader flag after the v1 fields.  The header layout is "
+        "unchanged and still carries the entry count, so a v2-aware "
+        "reader sizes the body per version, while a v1-only reader "
+        "rejects the unknown version and skips the whole trailer — the "
+        "digest is optional, so that degrades to 'no membership "
+        "piggyback', never a mis-framed stream.  Flat (no topology) "
+        "rings keep emitting version 1 byte-identically."
     ),
     "state_one_chunk_per_connection": (
         "The state transfer serves ONE chunk per connection, which "

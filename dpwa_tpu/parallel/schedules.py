@@ -209,6 +209,35 @@ def churn_restart_draw(seed, round_, n_candidates: int):
     )
 
 
+def leader_draw(seed, term, island, n_candidates: int):
+    """Index of the island member elected leader for ``term`` (tag 14).
+
+    Keyed on (seed, term, island) — every replica that knows the same
+    candidate list elects the same leader with no coordination round,
+    which is the whole point: succession after a leader death is just a
+    term bump plus a re-draw over the surviving members, computed
+    independently and identically everywhere (dpwa_tpu/hier/leader.py).
+    The draw indexes the SORTED surviving-member list, so determinism
+    only needs agreement on who is alive, which membership already
+    disseminates."""
+    return int(
+        jax.random.randint(
+            _pair_key(seed, term, island, _tags.TAG_LEADER),
+            (), 0, n_candidates,
+        )
+    )
+
+
+def island_churn_draw(seed, round_, island):
+    """Uniform [0,1) deciding whether ``island`` churns as a unit at
+    ``round_`` (tag 15) — the fleet orchestrator's whole-island
+    join/leave stream, independent of the per-peer churn draws so
+    island-granular chaos does not skew individual-peer churn."""
+    return float(
+        jax.random.uniform(_pair_key(seed, round_, island, _tags.TAG_ISLAND_CHURN))
+    )
+
+
 _CONTROL_DRAWS_WARM = False
 
 
@@ -241,6 +270,8 @@ def warm_control_draws(seed: int = 0, me: int = 0) -> None:
     float(churn_join_draw(seed, 0, me))
     churn_cohort_draw(seed, 0, 1)
     churn_restart_draw(seed, 0, 2)
+    leader_draw(seed, 0, 0, 2)
+    island_churn_draw(seed, 0, 0)
     _CONTROL_DRAWS_WARM = True
 
 

@@ -604,18 +604,17 @@ def _read_digest_trailer(
     responsive by streaming the whole payload."""
     from dpwa_tpu.membership.digest import (
         HEADER_SIZE,
-        entries_size,
-        header_entry_count,
+        header_entries_nbytes,
     )
 
     deadline = time.monotonic() + budget_s
     head = _recv_trailing(sock, HEADER_SIZE, deadline)
     if head is None:
         return None
-    n = header_entry_count(head)
-    if n is None:
+    nbytes = header_entries_nbytes(head)
+    if nbytes is None:
         return None
-    body = _recv_trailing(sock, entries_size(n), deadline)
+    body = _recv_trailing(sock, nbytes, deadline)
     if body is None:
         return None
     return head + body
@@ -644,8 +643,7 @@ def _read_trailers(
     from dpwa_tpu.membership.digest import (
         DIGEST_MAGIC,
         HEADER_SIZE,
-        entries_size,
-        header_entry_count,
+        header_entries_nbytes,
     )
     from dpwa_tpu.obs.wire import (
         OBS_HEADER_SIZE,
@@ -666,10 +664,10 @@ def _read_trailers(
             rest = _recv_trailing(sock, HEADER_SIZE - 4, deadline)
             if rest is None:
                 break
-            n = header_entry_count(magic + rest)
-            if n is None:
+            nbytes = header_entries_nbytes(magic + rest)
+            if nbytes is None:
                 break
-            body = _recv_trailing(sock, entries_size(n), deadline)
+            body = _recv_trailing(sock, nbytes, deadline)
             if body is None:
                 break
             digest = magic + rest + body
@@ -1331,7 +1329,22 @@ class TcpTransport:
     def __init__(self, config: DpwaConfig, name: str):
         self.config = config
         self.me = config.node_index(name)
-        self.schedule: Schedule = build_schedule(config)
+        # Hierarchical gossip (docs/hierarchy.md): a ``topology:`` block
+        # swaps in the two-level island×wide-area pool — intra-island
+        # slots everyone works, wide-area slots only the elected island
+        # leaders work (non-leaders self-pair, and a self-pair never
+        # fetches).  No block -> the flat pool, bit-identical to before
+        # the topology grammar existed.  Deferred import: hier pulls in
+        # the election machinery only topology users need.
+        self.topology = None
+        if config.topology.enabled:
+            from dpwa_tpu.hier.schedule import build_hier_schedule
+            from dpwa_tpu.hier.topology import Topology
+
+            self.topology = Topology.from_config(config)
+            self.schedule: Schedule = build_hier_schedule(config)
+        else:
+            self.schedule = build_schedule(config)
         # Content-trust plane (dpwa_tpu/trust/): screens every decoded
         # REMOTE payload and damps/rejects the merge.  Deferred import —
         # trust pulls in the screening jit machinery this module must
@@ -1449,7 +1462,8 @@ class TcpTransport:
             from dpwa_tpu.obs.incidents import IncidentPlane
 
             self.incidents = IncidentPlane(
-                self.me, len(config.nodes), obs_cfg
+                self.me, len(config.nodes), obs_cfg,
+                topology=self.topology,
             )
         self.flight = None
         if obs_cfg.recorder:
@@ -1568,9 +1582,21 @@ class TcpTransport:
         if self.scoreboard is not None and config.membership.enabled:
             from dpwa_tpu.membership.manager import MembershipManager
 
+            leader_board = None
+            if self.topology is not None:
+                # The board's seed must be the topology's leader_seed —
+                # the SAME draw build_hier_schedule compiled the term-0
+                # wide-area slots from — so digest-adopted successions
+                # and the static pool agree on who term 0's leaders are.
+                from dpwa_tpu.hier.leader import LeaderBoard
+
+                leader_board = LeaderBoard(
+                    self.topology, seed=config.topology.leader_seed
+                )
             self.membership = MembershipManager(
                 len(config.nodes), self.me, self.scoreboard,
                 config.membership, seed=self.schedule.seed,
+                topology=self.topology, leader_board=leader_board,
             )
             # Churn hardening: when the manager evicts a dead peer it
             # prunes the scoreboard itself; the trust EWMAs/windows and

@@ -12,8 +12,9 @@ raw tag literal that does not come from here.
 
 Layout of the tag space:
 
-- ``0 .. 13`` allocated control-plane draws (below).
-- ``14 .. 15`` free — claim the next one HERE, never inline.
+- ``0 .. 15`` allocated control-plane draws (below) — the block is now
+  full; the next claimant must move ``CHAOS_TAG_BASE`` draws or pick a
+  new base past the chaos kinds (and update this comment).
 - ``16 ..``    chaos fault-kind streams: ``CHAOS_TAG_BASE + kind`` where
   ``kind`` is one of the ``CHAOS_KIND_*`` indices below.  Keeping the
   chaos kinds far clear of the control tags means new control draws can
@@ -62,6 +63,12 @@ TAG_CHURN_LEAVE = _register("churn_leave_draw", 10)
 TAG_CHURN_JOIN = _register("churn_join_draw", 11)
 TAG_CHURN_COHORT = _register("churn_cohort_draw", 12)
 TAG_CHURN_RESTART = _register("churn_restart_draw", 13)
+# Hierarchical gossip (dpwa_tpu/hier): the per-(island, term) leader
+# election draw and the fleet's whole-island churn decisions.  Separate
+# streams so island membership churn cannot skew which member wins the
+# leadership draw.
+TAG_LEADER = _register("leader_draw", 14)
+TAG_ISLAND_CHURN = _register("island_churn_draw", 15)
 
 # Chaos fault-kind streams occupy CHAOS_TAG_BASE + kind.
 CHAOS_TAG_BASE = 16

@@ -1,0 +1,549 @@
+"""Hierarchical gossip tests (docs/hierarchy.md): topology grammar +
+validation, digest v2 wire format, deterministic leader election and
+failover succession, the two-level schedule, the island churn schedule,
+the CPU engine soak (hier vs flat convergence + wide-frame reduction,
+bit-identical reruns), the leader-kill incident, and the flat-config
+back-compat anchors (v1 digest bytes, flat schedule untouched).
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from dpwa_tpu.config import (
+    DpwaConfig,
+    IslandSpec,
+    TopologyConfig,
+    config_from_dict,
+    make_local_config,
+)
+from dpwa_tpu.fleet.orchestrator import FleetOrchestrator
+from dpwa_tpu.fleet.schedule import ChurnSchedule, ChurnSpec
+from dpwa_tpu.health.scoreboard import Scoreboard
+from dpwa_tpu.hier import (
+    HierGossipEngine,
+    LeaderBoard,
+    Topology,
+    build_hier_schedule,
+    wide_slot_indices,
+)
+from dpwa_tpu.membership.digest import (
+    DIGEST_VERSION,
+    DIGEST_VERSION_HIER,
+    NO_ISLAND,
+    Digest,
+    MemberEntry,
+    decode_digest,
+    encode_digest,
+    header_entries_nbytes,
+    merge_entry,
+)
+from dpwa_tpu.membership.manager import MembershipManager
+from dpwa_tpu.parallel.schedules import build_schedule
+from dpwa_tpu.parallel.tcp import TcpTransport
+
+
+def _hier_config(n_islands=2, island_size=4, **kw):
+    return make_local_config(
+        n_islands * island_size,
+        base_port=0,
+        topology={
+            "islands": [
+                {
+                    "name": f"isl{g}",
+                    "nodes": [
+                        f"node{g * island_size + i}"
+                        for i in range(island_size)
+                    ],
+                }
+                for g in range(n_islands)
+            ]
+        },
+        **kw,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Config grammar + validation
+# ---------------------------------------------------------------------------
+
+
+def test_topology_config_from_dict():
+    cfg = config_from_dict(
+        {
+            "nodes": [
+                {"name": f"n{i}", "host": "127.0.0.1", "port": 9000 + i}
+                for i in range(4)
+            ],
+            "protocol": {"schedule": "ring"},
+            "topology": {
+                "islands": [
+                    {"name": "a", "nodes": ["n0", "n1"]},
+                    {"name": "b", "nodes": ["n2", "n3"]},
+                ],
+                "intra_rounds": 2,
+            },
+        }
+    )
+    assert cfg.topology.enabled
+    assert cfg.topology.intra_rounds == 2
+    assert [i.name for i in cfg.topology.islands] == ["a", "b"]
+
+
+def test_topology_absent_block_means_flat():
+    cfg = make_local_config(4, base_port=0)
+    assert not cfg.topology.enabled
+    assert cfg.topology == TopologyConfig()
+
+
+def test_topology_validation_names_offenders():
+    # Unknown node: error names BOTH the island and the node.
+    with pytest.raises(ValueError, match=r"island 'a'.*'ghost'"):
+        make_local_config(
+            4, base_port=0,
+            topology={"islands": [
+                {"name": "a", "nodes": ["node0", "ghost"]},
+                {"name": "b", "nodes": ["node1", "node2", "node3"]},
+            ]},
+        )
+    # Duplicate membership across islands names both islands.
+    with pytest.raises(ValueError, match=r"'node1'.*'a'.*'b'"):
+        make_local_config(
+            4, base_port=0,
+            topology={"islands": [
+                {"name": "a", "nodes": ["node0", "node1"]},
+                {"name": "b", "nodes": ["node1", "node2", "node3"]},
+            ]},
+        )
+    # A node in no island at all.
+    with pytest.raises(ValueError, match="node3"):
+        make_local_config(
+            4, base_port=0,
+            topology={"islands": [
+                {"name": "a", "nodes": ["node0", "node1", "node2"]},
+            ]},
+        )
+    # Duplicate node WITHIN one island.
+    with pytest.raises(ValueError, match=r"island 'a'"):
+        TopologyConfig(
+            islands=(IslandSpec(name="a", nodes=("n0", "n0")),)
+        )
+    with pytest.raises(ValueError, match="intra_rounds"):
+        TopologyConfig(intra_rounds=0)
+
+
+def test_topology_resolution():
+    cfg = _hier_config(2, 4)
+    topo = Topology.from_config(cfg)
+    assert topo.n_islands == 2 and topo.n_peers == 8
+    assert topo.members_of(0) == (0, 1, 2, 3)
+    assert topo.members_of(1) == (4, 5, 6, 7)
+    assert topo.island_of(6) == 1
+    assert topo.island_name(0) == "isl0"
+    uni = Topology.uniform(2, 4)
+    assert uni.members_of(1) == (4, 5, 6, 7)
+
+
+# ---------------------------------------------------------------------------
+# Digest v2 wire format
+# ---------------------------------------------------------------------------
+
+
+def test_digest_v2_roundtrip():
+    d = Digest(
+        origin=1,
+        round=9,
+        entries={
+            0: MemberEntry(island=0, leader_term=3, is_leader=True),
+            5: MemberEntry(state=1, incarnation=2, suspicion=0.5,
+                           island=1, leader_term=7),
+        },
+        version=DIGEST_VERSION_HIER,
+    )
+    blob = encode_digest(d)
+    assert header_entries_nbytes(blob[: len(blob) - 32]) == 32  # 2 x 16B
+    back = decode_digest(blob)
+    assert back.version == DIGEST_VERSION_HIER
+    assert back.entries[0].island == 0
+    assert back.entries[0].leader_term == 3
+    assert back.entries[0].is_leader
+    assert back.entries[5].island == 1
+    assert back.entries[5].leader_term == 7
+    assert not back.entries[5].is_leader
+
+
+def test_digest_v1_decodes_with_hier_defaults():
+    blob = encode_digest(
+        Digest(origin=0, round=1, entries={2: MemberEntry(state=1)})
+    )
+    back = decode_digest(blob)
+    assert back.version == DIGEST_VERSION
+    assert back.entries[2].island == NO_ISLAND
+    assert back.entries[2].leader_term == 0
+    assert not back.entries[2].is_leader
+
+
+def test_merge_entry_prefers_higher_leader_term():
+    local = MemberEntry(island=0, leader_term=2, is_leader=True)
+    claim = MemberEntry(island=0, leader_term=3, is_leader=False)
+    merged, changed = merge_entry(local, claim)
+    assert changed and merged.leader_term == 3 and not merged.is_leader
+    # Known island beats the flat sentinel at equal incarnation.
+    merged, changed = merge_entry(
+        MemberEntry(), MemberEntry(island=1)
+    )
+    assert changed and merged.island == 1
+
+
+def test_flat_digest_bytes_unchanged():
+    # A flat manager (no topology) must emit v1 bytes identical to the
+    # plain encoder — the PR 11 wire, bit for bit.
+    sb = Scoreboard(4, 0)
+    m = MembershipManager(4, 0, sb)
+    blob = m.encode(5)
+    expect = encode_digest(
+        Digest(
+            origin=0,
+            round=5,
+            entries={p: MemberEntry() for p in range(4)},
+        )
+    )
+    assert blob == expect
+    assert decode_digest(blob).version == DIGEST_VERSION
+
+
+# ---------------------------------------------------------------------------
+# Leader election + succession
+# ---------------------------------------------------------------------------
+
+
+def test_leader_election_is_deterministic():
+    topo = Topology.uniform(4, 4)
+    a = LeaderBoard(topo, seed=3)
+    b = LeaderBoard(topo, seed=3)
+    assert a.leaders() == b.leaders()
+    for g in range(4):
+        leader = a.leader_of(g)
+        assert leader in topo.members_of(g)
+        assert a.is_leader(leader)
+
+
+def test_leader_kill_bounded_succession():
+    topo = Topology.uniform(2, 4)
+    board = LeaderBoard(topo, seed=0)
+    g = 0
+    survivors = set(topo.members_of(g))
+    terms = [board.term_of(g)]
+    # Kill leaders one by one: every death yields EXACTLY ONE failover
+    # event, the term bumps by exactly one, and the successor is always
+    # drawn from the survivors.
+    while len(survivors) > 1:
+        leader = board.leader_of(g)
+        survivors.discard(leader)
+        events = board.note_dead(leader)
+        assert len(events) == 1
+        ev = events[0]
+        assert ev["event"] == "leader_failover"
+        assert ev["old_leader"] == leader
+        assert ev["peer"] in survivors
+        terms.append(board.term_of(g))
+    assert terms == list(range(len(terms)))  # monotonic, +1 per death
+    # Last one standing dies: island goes leaderless.
+    events = board.note_dead(board.leader_of(g))
+    assert len(events) == 1 and events[0]["peer"] is None
+    assert board.leader_of(g) is None
+    # A returnee re-elects at a fresh term.
+    events = board.note_alive(0)
+    assert len(events) == 1 and events[0]["event"] == "leader_elected"
+    assert board.leader_of(g) == 0
+
+
+def test_non_leader_death_and_sticky_rejoin():
+    topo = Topology.uniform(2, 4)
+    board = LeaderBoard(topo, seed=0)
+    leader = board.leader_of(0)
+    other = next(p for p in topo.members_of(0) if p != leader)
+    assert board.note_dead(other) == []
+    assert board.term_of(0) == 0
+    # Rejoin while a leader stands: sticky, no re-election.
+    assert board.note_alive(other) == []
+    assert board.leader_of(0) == leader
+
+
+def test_adopt_folds_remote_claims():
+    topo = Topology.uniform(2, 4)
+    board = LeaderBoard(topo, seed=0)
+    # Stale and same-term claims are no-ops.
+    assert board.adopt(0, 0, 1) == []
+    # A higher-term claim moves the board.
+    events = board.adopt(0, 4, 2)
+    assert len(events) == 1 and events[0]["term"] == 4
+    assert board.leader_of(0) == 2 and board.term_of(0) == 4
+    assert board.adopt(0, 3, 1) == []  # lower term: stale noise
+
+
+def test_manager_v2_digest_adoption():
+    topo = Topology.uniform(2, 4)
+    m0 = MembershipManager(8, 0, Scoreboard(8, 0), topology=topo)
+    m1 = MembershipManager(8, 1, Scoreboard(8, 1), topology=topo)
+    assert decode_digest(m0.encode(1)).version == DIGEST_VERSION_HIER
+    # m1 witnesses its island-0 leader die and elects a successor; m0
+    # adopts the higher-term claim off the digest.
+    dead = m1.leader_board.leader_of(0)
+    m1.leader_board.note_dead(dead)
+    m0.merge(m1.encode(2), round=2)
+    assert m0.leader_board.term_of(0) == 1
+    assert m0.leader_board.leader_of(0) == m1.leader_board.leader_of(0)
+    events = [
+        e for e in m0.pop_events() if e.get("event") == "leader_elected"
+    ]
+    assert len(events) == 1 and events[0]["term"] == 1
+
+
+# ---------------------------------------------------------------------------
+# The two-level schedule
+# ---------------------------------------------------------------------------
+
+
+def test_hier_schedule_pool_shape():
+    cfg = _hier_config(2, 4)
+    sched = build_hier_schedule(cfg)
+    assert sched.name == "hier"
+    # 2 intra phases + 1 tournament slot for 2 islands.
+    assert sched.pool.shape == (3, 8)
+    topo = Topology.from_config(cfg)
+    wide = wide_slot_indices(sched, topo)
+    assert wide == (2,)
+    # The wide slot pairs ONLY the two elected leaders; everyone else
+    # self-pairs (a self-pair never fetches — the frame reduction).
+    board = LeaderBoard(topo, seed=cfg.topology.leader_seed)
+    row = sched.pool[2]
+    a, b = board.leader_of(0), board.leader_of(1)
+    for p in range(8):
+        if p in (a, b):
+            assert int(row[p]) in (a, b) and int(row[p]) != p
+        else:
+            assert int(row[p]) == p
+
+
+def test_hier_schedule_intra_rounds_knob():
+    cfg = make_local_config(
+        8, base_port=0,
+        topology={
+            "islands": [
+                {"name": "a", "nodes": [f"node{i}" for i in range(4)]},
+                {"name": "b", "nodes": [f"node{i}" for i in range(4, 8)]},
+            ],
+            "intra_rounds": 3,
+        },
+    )
+    sched = build_hier_schedule(cfg)
+    # Per tournament block: 3 x [even, odd] intra sweeps + 1 wide slot.
+    assert list(sched.branch_map) == [0, 1, 0, 1, 0, 1, 2]
+
+
+def test_flat_config_schedule_untouched():
+    # No topology block -> TcpTransport compiles the SAME flat pool the
+    # PR 11 transport did (the bit-identity anchor for flat configs).
+    cfg = make_local_config(4, base_port=0, seed=11)
+    t = TcpTransport(cfg, "node0")
+    try:
+        expect = build_schedule(cfg)
+        assert t.topology is None
+        assert t.schedule.name == expect.name
+        np.testing.assert_array_equal(t.schedule.pool, expect.pool)
+    finally:
+        t.close()
+
+
+# ---------------------------------------------------------------------------
+# Engine: hier vs flat convergence + frame accounting (the tier-1 soak)
+# ---------------------------------------------------------------------------
+
+
+def test_hier_soak_two_islands_vs_flat():
+    rounds, target = 40, 0.05
+    flat = HierGossipEngine(8, seed=0).run(rounds, target_rel=target)
+    topo = Topology.uniform(2, 4)
+    hier = HierGossipEngine(8, seed=0, topology=topo).run(
+        rounds, target_rel=target
+    )
+    # Convergence within tolerance of flat (the engine's intra
+    # all-reduce makes it strictly faster here; the bound is the
+    # acceptance criterion, not the expectation).
+    assert flat["rounds_to_target"] is not None
+    assert hier["rounds_to_target"] is not None
+    assert hier["rounds_to_target"] <= 2 * flat["rounds_to_target"]
+    assert hier["final_rel_rms"] <= target
+    # Wide-area frames drop by >= (island_size - eps)x.
+    mult = flat["wide_frames"] / hier["wide_frames"]
+    assert mult >= 4 - 0.1  # island_size = 4
+    # Bit-identical rerun: same seed -> same history AND same records.
+    rerun = HierGossipEngine(8, seed=0, topology=Topology.uniform(2, 4))
+    out2 = rerun.run(rounds, target_rel=target)
+    assert out2["history"] == hier["history"]
+    assert out2["wide_frames"] == hier["wide_frames"]
+
+
+def test_hier_engine_island_records_validate():
+    from tools import schema_check
+
+    topo = Topology.uniform(2, 4)
+    eng = HierGossipEngine(8, seed=0, topology=topo)
+    eng.run(4)
+    assert len(eng.records) == 8  # 2 islands x 4 rounds
+    for rec in eng.records:
+        assert schema_check.check_record(rec) == []
+
+
+def test_leader_kill_exactly_one_failover_incident():
+    from dpwa_tpu.config import ObsConfig
+
+    topo = Topology.uniform(2, 4)
+
+    def episode():
+        eng = HierGossipEngine(
+            8, seed=0, topology=topo, incidents=ObsConfig(incidents=True)
+        )
+        for r in range(3):
+            eng.step(r)
+        victim = eng.board.leader_of(1)
+        eng.kill(victim)
+        for r in range(3, 10):
+            eng.step(r)
+        return eng, victim
+
+    eng, victim = episode()
+    # Deterministic bounded succession: term bumped once, successor
+    # drawn from the survivors of island 1.
+    assert eng.board.term_of(1) == 1
+    successor = eng.board.leader_of(1)
+    assert successor in topo.members_of(1) and successor != victim
+    # Exactly one incident, classified leader_failover.
+    assert eng.incidents_opened == 1
+    assert eng.alerts_total == {"leader_failover": 1}
+    # Replay: identical successor, identical incident stream.
+    eng2, victim2 = episode()
+    assert victim2 == victim
+    assert eng2.board.leader_of(1) == successor
+    assert eng2.alerts_total == eng.alerts_total
+
+
+# ---------------------------------------------------------------------------
+# Island churn schedule + orchestrator
+# ---------------------------------------------------------------------------
+
+
+def test_island_churn_schedule_deterministic():
+    topo = Topology.uniform(4, 4)
+    spec = ChurnSpec(
+        seed=7, island_churn_every=3, island_churn_probability=0.5,
+        leader_restart_every=4, min_live=4,
+    )
+    a = ChurnSchedule(spec, 16, topology=topo)
+    b = ChurnSchedule(spec, 16, topology=topo)
+    live, departed = list(range(16)), []
+    for r in range(12):
+        ea, eb = a.events(r, live, departed), b.events(r, live, departed)
+        assert ea == eb
+        if r == 0 or r % 3:
+            assert not ea.island_leaves and not ea.island_joins
+        for g in ea.churned_islands:
+            members = set(topo.members_of(g))
+            # Whole island moves together.
+            assert members <= set(ea.island_leaves) or members <= set(
+                ea.island_joins
+            )
+
+
+def test_island_churn_needs_topology():
+    with pytest.raises(ValueError, match="topology"):
+        ChurnSchedule(ChurnSpec(island_churn_every=2), 8)
+
+
+def test_orchestrator_hier_episode_deterministic_and_valid(tmp_path):
+    from tools import schema_check
+
+    topo = Topology.uniform(4, 4)
+    spec = ChurnSpec(
+        seed=5, island_churn_every=5, island_churn_probability=0.6,
+        leader_restart_every=7, min_live=4,
+    )
+
+    def run(path=None):
+        return FleetOrchestrator(
+            16, spec, topology=topo, path=path
+        ).run(24)
+
+    path = str(tmp_path / "fleet.jsonl")
+    r1, r2 = run(path), run()
+    det = lambda recs: [  # noqa: E731 - local shorthand
+        json.dumps(x, sort_keys=True)
+        for x in recs
+        if x.get("kind") == "churn" or x.get("record") == "island"
+    ]
+    assert det(r1.records) == det(r2.records)
+    # Every emitted record validates against the frozen schemas.
+    n, errors = schema_check.check_file(path)
+    assert n == len(r1.records) and errors == []
+    assert r1.episode["islands"] == 4
+    assert set(r1.episode["leader_terms"]) == {
+        f"island{g}" for g in range(4)
+    }
+
+
+def test_flat_orchestrator_stream_has_no_hier_fields():
+    spec = ChurnSpec(seed=3, leave_probability=0.2, join_probability=0.5)
+    res = FleetOrchestrator(8, spec).run(12)
+    for rec in res.records:
+        assert rec.get("record") != "island"
+        for key in (
+            "island_leaves", "island_joins", "churned_islands",
+            "leader_restarts", "islands", "leader_terms",
+        ):
+            assert key not in rec
+
+
+# ---------------------------------------------------------------------------
+# TCP integration: a real 2-island ring over sockets
+# ---------------------------------------------------------------------------
+
+
+def test_tcp_hier_ring_converges():
+    cfg = _hier_config(2, 2, seed=7)
+    ts = [TcpTransport(cfg, f"node{i}") for i in range(4)]
+    for t in ts:
+        for i, other in enumerate(ts):
+            t.set_peer_port(i, other.port)
+    try:
+        assert ts[0].schedule.name == "hier"
+        assert ts[0].topology is not None
+        rng = np.random.default_rng(0)
+        cur = [
+            rng.standard_normal(32).astype(np.float32) for _ in range(4)
+        ]
+        for step in range(9):
+            for i, t in enumerate(ts):
+                t.publish(cur[i], float(step), 0.1)
+            cur = [
+                np.asarray(
+                    ts[i].exchange(cur[i], float(step), 0.1, step)[0]
+                )
+                for i in range(4)
+            ]
+        vecs = np.stack(cur)
+        mean = vecs.mean(axis=0)
+        rel = float(
+            np.sqrt(np.mean((vecs - mean) ** 2))
+            / (np.sqrt(np.mean(mean**2)) + 1e-12)
+        )
+        assert rel < 0.25
+        # The ring gossips v2 digests and agrees on the leaders.
+        blob = ts[0].membership.encode(9)
+        assert decode_digest(blob).version == DIGEST_VERSION_HIER
+        leaders = ts[0].membership.leader_board.leaders()
+        assert leaders == ts[3].membership.leader_board.leaders()
+    finally:
+        for t in ts:
+            t.close()

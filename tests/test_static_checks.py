@@ -103,6 +103,16 @@ def _valid_records():
             "alerts": ["peer_failure"],
         },
         {"record": "bench", "t": 1.0, "merge_ms": 3.2},
+        {
+            "record": "island", "round": 4, "island": "island0",
+            "term": 1, "live": 4, "rel_rms": 0.02, "leader": 3,
+            "wide_frames": 16,
+        },
+        {
+            "step": 1, "t": 0.1, "record": "event",
+            "event": "leader_failover", "island": "island0",
+            "old_leader": 3, "peer": 1, "term": 1,
+        },
     ]
 
 
@@ -581,6 +591,8 @@ def test_threefry_tags_are_pinned():
         11: "churn_join_draw",
         12: "churn_cohort_draw",
         13: "churn_restart_draw",
+        14: "leader_draw",
+        15: "island_churn_draw",
         16: "chaos:drop",
         17: "chaos:delay",
         18: "chaos:throttle",

@@ -38,7 +38,9 @@ from typing import Any, Dict, Iterable, List, Optional
 # (kept in sync by tests/test_fleet.py); duplicated so the report stays
 # stdlib-only and usable on a box without the package installed.
 FAULT_EXPECTATIONS: Dict[str, tuple] = {
-    "partition": ("partition",),
+    # An island-aligned cut fires the more-specific island_partition
+    # INSTEAD of partition (docs/hierarchy.md) — both count as detected.
+    "partition": ("partition", "island_partition"),
     "byzantine": ("byzantine",),
     "straggler": ("straggler", "slo_burn"),
 }
@@ -47,8 +49,10 @@ FAULT_EXPECTATIONS: Dict[str, tuple] = {
 ALERT_CLASS: Dict[str, str] = {
     "partition": "partition",
     "partition_flap": "partition",
+    "island_partition": "island_partition",
     "trust_burst": "byzantine",
     "peer_failure": "peer_down",
+    "leader_failover": "leader_failover",
     "straggler": "straggler",
     "state_storm": "state_storm",
     "slo_burn": "slo_burn",
@@ -65,7 +69,7 @@ def load_records(paths: Iterable[str]) -> Dict[str, List[dict]]:
     """Parse every file into kind-bucketed record lists."""
     out: Dict[str, List[dict]] = {
         "churn": [], "round": [], "episode": [],
-        "trace_round": [], "alert": [], "incident": [],
+        "trace_round": [], "alert": [], "incident": [], "island": [],
     }
     for path in paths:
         with open(path, "r", encoding="utf-8") as fh:
@@ -84,7 +88,7 @@ def load_records(paths: Iterable[str]) -> Dict[str, List[dict]]:
                     out[rec["kind"]].append(rec)
                 elif kind == "trace" and rec.get("kind") == "round":
                     out["trace_round"].append(rec)
-                elif kind in ("alert", "incident"):
+                elif kind in ("alert", "incident", "island"):
                     out[kind].append(rec)
     return out
 
@@ -213,6 +217,43 @@ def match_faults(
     return out
 
 
+def island_digest(island_recs: List[dict]) -> Dict[str, dict]:
+    """Per-island convergence/leadership summary from the ``island``
+    record stream (docs/hierarchy.md): leadership terms only increase,
+    so ``failovers`` is just the final term; ``leader_changes`` counts
+    the rounds where the leader id actually moved."""
+    by_island: Dict[str, List[dict]] = {}
+    for r in sorted(island_recs, key=lambda r: r.get("round", 0)):
+        name = r.get("island")
+        if isinstance(name, str):
+            by_island.setdefault(name, []).append(r)
+    out: Dict[str, dict] = {}
+    for name, recs in sorted(by_island.items()):
+        leaders = [r.get("leader") for r in recs]
+        changes = sum(
+            1
+            for prev, cur in zip(leaders, leaders[1:])
+            if cur != prev
+        )
+        rels = [
+            float(r["rel_rms"]) for r in recs
+            if isinstance(r.get("rel_rms"), (int, float))
+        ]
+        lives = [int(r["live"]) for r in recs if "live" in r]
+        out[name] = {
+            "rounds": len(recs),
+            "final_term": int(recs[-1].get("term", 0)),
+            "failovers": int(recs[-1].get("term", 0)),
+            "leader_changes": changes,
+            "final_leader": recs[-1].get("leader"),
+            "final_live": lives[-1] if lives else None,
+            "min_live": min(lives) if lives else None,
+            "final_rel_rms": rels[-1] if rels else None,
+            "p95_rel_rms": _pct(rels, 0.95),
+        }
+    return out
+
+
 def build_report(records: Dict[str, List[dict]]) -> Dict[str, Any]:
     rounds = sorted(records["round"], key=lambda r: r.get("round", 0))
     churn = records["churn"]
@@ -247,6 +288,15 @@ def build_report(records: Dict[str, List[dict]]) -> Dict[str, Any]:
                 for r in churn
             ),
             "restarts": sum(len(r.get("restart") or ()) for r in churn),
+            "island_leaves": sum(
+                len(r.get("island_leaves") or ()) for r in churn
+            ),
+            "island_joins": sum(
+                len(r.get("island_joins") or ()) for r in churn
+            ),
+            "leader_restarts": sum(
+                len(r.get("leader_restarts") or ()) for r in churn
+            ),
         },
         "membership_convergence": {
             "leave": _convergence(
@@ -265,7 +315,25 @@ def build_report(records: Dict[str, List[dict]]) -> Dict[str, Any]:
             1 for f in faults if f["verdict"] == "detected"
         ),
     }
+    if records["island"]:
+        rep["islands"] = island_digest(records["island"])
     return rep
+
+
+def print_islands(rep: Dict[str, Any]) -> None:
+    islands = rep.get("islands")
+    if not islands:
+        print("islands: no island records in the supplied streams")
+        return
+    print(f"islands: {len(islands)}")
+    for name, d in islands.items():
+        print(
+            f"  {name}: leader {d['final_leader']} (term "
+            f"{d['final_term']}, {d['leader_changes']} changes), live "
+            f"{d['final_live']} (min {d['min_live']}), rel_rms final "
+            f"{d['final_rel_rms']} p95 {d['p95_rel_rms']} over "
+            f"{d['rounds']} rounds"
+        )
 
 
 def print_report(rep: Dict[str, Any]) -> None:
@@ -280,6 +348,12 @@ def print_report(rep: Dict[str, Any]) -> None:
         f"churn: {ch['leaves']} leaves, {ch['joins']} joins, "
         f"{ch['restarts']} restarts across {ch['events']} eventful rounds"
     )
+    if ch["island_leaves"] or ch["island_joins"] or ch["leader_restarts"]:
+        print(
+            f"island churn: {ch['island_leaves']} island leaves, "
+            f"{ch['island_joins']} island joins, "
+            f"{ch['leader_restarts']} leader restarts"
+        )
     for name in ("leave", "join"):
         c = rep["membership_convergence"][name]
         print(
@@ -319,6 +393,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument(
         "--json", action="store_true", help="machine-readable output"
     )
+    ap.add_argument(
+        "--islands", action="store_true",
+        help="add the per-island convergence/leadership digest "
+        "(record: \"island\" streams, docs/hierarchy.md)",
+    )
     args = ap.parse_args(argv)
     rep = build_report(load_records(args.paths))
     if args.json:
@@ -326,6 +405,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         print()
     else:
         print_report(rep)
+        if args.islands:
+            print_islands(rep)
     return 0
 
 

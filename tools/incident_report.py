@@ -41,8 +41,8 @@ from typing import Any, Dict, Iterable, List, Optional
 # tests/test_incidents.py); duplicated here so the report stays
 # stdlib-only and usable on a box without the package installed.
 KIND_PRIORITY = (
-    "partition", "byzantine", "peer_down", "straggler",
-    "state_storm", "slo_burn", "conv_stall",
+    "island_partition", "partition", "byzantine", "leader_failover",
+    "peer_down", "straggler", "state_storm", "slo_burn", "conv_stall",
 )
 
 # Rounds of slack when overlapping per-node incident windows: nodes

@@ -32,6 +32,8 @@ schemas:
   deterministic bit-identity anchor (round counters and peer ids
   only), round records add measured fields, episode records the run
   summary ``tools/fleet_report.py`` digests — all closed-world;
+- ``record: "island"`` — per-island convergence/leadership rows from
+  the hierarchical planes (docs/hierarchy.md), closed-world;
 - records with no ``record`` key — per-step exchange/training records
   (``MetricsLogger.log`` / ``log_exchange``): ``step`` and ``t`` are
   pinned, the rest is adapter-defined.
@@ -262,6 +264,16 @@ _FLEET_CHURN_REQUIRED: Dict[str, tuple] = {
     "live": (int,),
     "evicted": (list,),
 }
+# Hierarchical fleets only (docs/hierarchy.md): the island-granular
+# churn families.  All-or-nothing in practice (the orchestrator adds
+# the whole group when a topology is configured), optional here so
+# flat churn records stay byte-identical.
+_FLEET_CHURN_OPTIONAL: Dict[str, tuple] = {
+    "island_leaves": (list,),
+    "island_joins": (list,),
+    "churned_islands": (list,),
+    "leader_restarts": (list,),
+}
 
 _FLEET_ROUND_REQUIRED: Dict[str, tuple] = {
     "record": (str,),
@@ -297,6 +309,27 @@ _FLEET_EPISODE_REQUIRED: Dict[str, tuple] = {
     "alerts": (dict,),
     "incidents_opened": (int,),
 }
+_FLEET_EPISODE_OPTIONAL: Dict[str, tuple] = {
+    "islands": (int,),
+    "leader_terms": (dict,),
+}
+
+# Per-island convergence records (docs/hierarchy.md): one per island
+# per round from the hier engine / orchestrator.  ``rel_rms`` is the
+# INTRA-island disagreement; ``term`` is the island's leadership term.
+_ISLAND_REQUIRED: Dict[str, tuple] = {
+    "record": (str,),
+    "round": (int,),
+    "island": (str,),
+    "term": (int,),
+    "live": (int,),
+    "rel_rms": _NUM,
+}
+_ISLAND_OPTIONAL: Dict[str, tuple] = {
+    "leader": (int,),
+    "wide_frames": (int,),
+    "t": _NUM,
+}
 
 _EXCHANGE_REQUIRED: Dict[str, tuple] = {
     "step": (int,),
@@ -309,7 +342,7 @@ _EXCHANGE_REQUIRED: Dict[str, tuple] = {
 RECORD_KINDS = frozenset(
     {
         "health", "trace", "event", "alert", "incident", "flight",
-        "bench", "fleet",
+        "bench", "fleet", "island",
     }
 )
 EVENT_KINDS = frozenset(
@@ -330,6 +363,8 @@ EVENT_KINDS = frozenset(
         "trust_recovered",
         # churn-hardened membership eviction (PR 11, docs/fleet.md)
         "peer_dead", "peer_rejoined",
+        # hierarchical gossip leadership (PR 12, docs/hierarchy.md)
+        "leader_elected", "leader_failover",
     }
 )
 
@@ -448,14 +483,22 @@ def check_record(rec: dict) -> List[str]:
     if kind == "fleet":
         fkind = rec.get("kind")
         if fkind == "churn":
-            return _check_fields(rec, _FLEET_CHURN_REQUIRED, closed=True)
+            return _check_fields(
+                rec, _FLEET_CHURN_REQUIRED, _FLEET_CHURN_OPTIONAL,
+                closed=True,
+            )
         if fkind == "round":
             return _check_fields(rec, _FLEET_ROUND_REQUIRED, closed=True)
         if fkind == "episode":
             return _check_fields(
-                rec, _FLEET_EPISODE_REQUIRED, closed=True
+                rec, _FLEET_EPISODE_REQUIRED, _FLEET_EPISODE_OPTIONAL,
+                closed=True,
             )
         return [f"unknown fleet kind {fkind!r}"]
+    if kind == "island":
+        return _check_fields(
+            rec, _ISLAND_REQUIRED, _ISLAND_OPTIONAL, closed=True
+        )
     if kind is None:
         return _check_fields(rec, _EXCHANGE_REQUIRED)
     return [f"unknown record kind {kind!r}"]
