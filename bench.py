@@ -413,27 +413,45 @@ def bench_tcp(
 TCP_GATE_WINDOW = 8
 TCP_GATE_REL_TOL = 0.5
 
+# Measurement-methodology version stamped on every history entry this
+# bench writes (``bench_methodology``).  The gates below only median
+# samples carrying the SAME stamp: the TCP leg's numbers moved ~18x when
+# the CPU-budget pinning landed, and a window that mixed pinned with
+# unpinned samples compared the current run against a median dominated
+# by the old methodology — the verdict read "improved" forever.  Bump
+# this whenever a harness change (pinning, socket options, timer source)
+# shifts what the same machine measures; entries WITHOUT the field are
+# the unpinned era and never comparable to anything current.
+#   v2: TCP leg runs under pin_cpu_budget (fixed CPU budget), hier leg
+#       counts frames from the engine accounting.
+BENCH_METHODOLOGY = 2
+
 
 def tcp_gate(
     history: list,
     current_gbps,
     window: int = TCP_GATE_WINDOW,
     rel_tol: float = TCP_GATE_REL_TOL,
+    methodology: int = BENCH_METHODOLOGY,
 ) -> dict:
     """Regression gate for the TCP baseline (pure; tests/test_fleet.py).
 
     ``history`` is the parsed ``artifacts/bench_history.jsonl`` entries;
     the gate takes the last ``window`` runs that recorded a live
-    ``tcp_baseline_gbps``, medians them, and classifies the current
-    measurement against a symmetric relative band.  The verdict is
-    recorded in the output (not a hard failure): a "regressed" TCP
-    baseline silently *inflates* ``vs_baseline``, so the 21x-127x
-    headline is only trusted when the gate says "ok"."""
+    ``tcp_baseline_gbps`` *under the same measurement methodology*
+    (``bench_methodology`` stamp — like compared with like only),
+    medians them, and classifies the current measurement against a
+    symmetric relative band.  The verdict is recorded in the output (not
+    a hard failure): a "regressed" TCP baseline silently *inflates*
+    ``vs_baseline``, so the 21x-127x headline is only trusted when the
+    gate says "ok".  Until two comparable samples exist the verdict is
+    ``no_data`` — never a judgement against an incomparable era."""
     samples = [
         float(e["tcp_baseline_gbps"])
         for e in history
         if isinstance(e, dict)
         and e.get("record") == "bench"
+        and e.get("bench_methodology") == methodology
         and isinstance(e.get("tcp_baseline_gbps"), (int, float))
         and not isinstance(e.get("tcp_baseline_gbps"), bool)
     ][-int(window):]
@@ -442,6 +460,7 @@ def tcp_gate(
         "samples": len(samples),
         "window": int(window),
         "rel_tol": float(rel_tol),
+        "methodology": int(methodology),
         "median_gbps": round(median, 3) if median is not None else None,
         "current_gbps": (
             round(float(current_gbps), 3)
@@ -529,9 +548,11 @@ def hier_gate(
     current_mult,
     window: int = HIER_GATE_WINDOW,
     rel_tol: float = HIER_GATE_REL_TOL,
+    methodology: int = BENCH_METHODOLOGY,
 ) -> dict:
     """Regression gate for the hier sweep's WORST wide-frame multiplier
-    (pure; mirrors :func:`tcp_gate`): a refactor that quietly starts
+    (pure; mirrors :func:`tcp_gate`, including the like-with-like
+    ``bench_methodology`` filter): a refactor that quietly starts
     fetching wide-area frames for non-leaders shows up here as a
     "regressed" verdict against the recent history medians."""
     samples = [
@@ -539,6 +560,7 @@ def hier_gate(
         for e in history
         if isinstance(e, dict)
         and e.get("record") == "bench"
+        and e.get("bench_methodology") == methodology
         and isinstance(e.get("hier"), dict)
         and isinstance(
             e["hier"].get("wide_multiplier_min"), (int, float)
@@ -550,6 +572,7 @@ def hier_gate(
         "samples": len(samples),
         "window": int(window),
         "rel_tol": float(rel_tol),
+        "methodology": int(methodology),
         "median_mult": round(median, 3) if median is not None else None,
         "current_mult": (
             round(float(current_mult), 3)
@@ -779,6 +802,106 @@ def bench_wire(d: int, iters: int, timeout_ms: int = 10000) -> dict:
         ),
     }
     return out
+
+
+# Shard counts for the sharded-wire sweep: k=1 is the unsharded
+# baseline every reduction is measured against.
+SHARD_SWEEP_KS = (1, 2, 4, 8)
+
+
+def bench_shard(
+    d: int, iters: int, ks=SHARD_SWEEP_KS, timeout_ms: int = 10000
+) -> dict:
+    """Sharded-wire sweep (docs/wire.md): bytes/frame at ``shard.k`` in
+    ``ks``, for the dense f32 wire and composed with the top-k codec.
+
+    Same discipline as :func:`bench_wire`: 2 peers on localhost driven
+    sequentially, bytes from each transport's ``wire_snapshot()`` frame
+    tally — measured, never layout arithmetic.  ``reduction_vs_k1`` is
+    within a codec family (f32 k=4 vs f32 k=1, topk k=4 vs topk k=1),
+    so it isolates the shard saving from the codec's own ratio;
+    ``reduction_floor_frac`` is the worst ``reduction_vs_k1 / k`` over
+    k>1 legs — the acceptance bar is >= 0.9 (the preamble is the only
+    overhead, so anything lower means a leg stopped shipping slices)."""
+    from dpwa_tpu.config import make_local_config
+    from dpwa_tpu.parallel.tcp import TcpTransport
+
+    def ring(**kw):
+        cfg = make_local_config(
+            2, base_port=0, schedule="ring", timeout_ms=timeout_ms, **kw
+        )
+        ts = [TcpTransport(cfg, f"node{i}") for i in range(2)]
+        for t in ts:
+            for i, other in enumerate(ts):
+                t.set_peer_port(i, other.port)
+        return ts
+
+    rng = np.random.default_rng(0)
+    base = [rng.standard_normal(d).astype(np.float32) for _ in range(2)]
+
+    def drive(ts):
+        vecs = [b.copy() for b in base]
+        durs = []
+        for it in range(iters):
+            for i, t in enumerate(ts):
+                t.publish(vecs[i], it, 0.0)
+            t0 = time.perf_counter()
+            for i, t in enumerate(ts):
+                merged, alpha, _ = t.exchange(vecs[i], it, 0.0, it)
+                if alpha != 0.0:
+                    vecs[i] = np.asarray(merged, np.float32)
+            durs.append(time.perf_counter() - t0)
+        return durs
+
+    families = (
+        ("f32", {}),
+        ("topk", {"wire_codec": "topk", "topk_fraction": 0.05}),
+    )
+    legs: dict = {}
+    for fam, kw in families:
+        for k in ks:
+            ts = ring(shard={"k": int(k)}, **kw)
+            try:
+                durs = drive(ts)
+                snap = ts[0].wire_snapshot()
+                leg = {
+                    "k": int(k),
+                    "codec": snap["codec"],
+                    "wire_bytes_per_frame": round(
+                        snap["wire_bytes"] / max(snap["frames"], 1), 1
+                    ),
+                    "compression_ratio": snap["compression_ratio"],
+                    "exchange_ms": round(
+                        float(np.median(durs)) * 1e3 / 2, 3
+                    ),
+                }
+                sh = snap.get("shard")
+                if sh is not None:
+                    leg["coverage"] = sh["coverage"]
+                legs[f"{fam}_k{k}"] = leg
+            finally:
+                for t in ts:
+                    t.close()
+    floor = None
+    for fam, _ in families:
+        b1 = legs[f"{fam}_k1"]["wire_bytes_per_frame"]
+        for k in ks:
+            leg = legs[f"{fam}_k{k}"]
+            leg["reduction_vs_k1"] = round(
+                b1 / leg["wire_bytes_per_frame"], 2
+            )
+            if k > 1:
+                frac = leg["reduction_vs_k1"] / k
+                floor = frac if floor is None else min(floor, frac)
+    return {
+        "d": int(d),
+        "iters": int(iters),
+        "ks": [int(k) for k in ks],
+        "legs": legs,
+        "reduction_floor_frac": (
+            round(floor, 3) if floor is not None else None
+        ),
+    }
 
 
 # Held-peer counts for the serve-leg capacity sweep (ISSUE 10): the
@@ -1105,6 +1228,27 @@ def main() -> None:
         "divide --hier-peers are skipped)",
     )
     ap.add_argument(
+        "--shard-leg", action="store_true",
+        help="run ONLY the sharded-wire sweep: bytes/frame at shard.k in "
+        "--shard-ks for the dense f32 wire and composed with the top-k "
+        "codec, reductions measured within each codec family vs its k=1 "
+        "leg; appends its own bench_history.jsonl record",
+    )
+    ap.add_argument(
+        "--shard-size", type=int, default=1024 * 1024,
+        help="vector length for the shard sweep (floats)",
+    )
+    ap.add_argument(
+        "--shard-iters", type=int, default=8,
+        help="exchange rounds per shard-sweep leg (>= max k, so every "
+        "leg reaches full round-robin coverage)",
+    )
+    ap.add_argument(
+        "--shard-ks", type=str, default="1,2,4,8",
+        help="comma-separated shard counts to sweep (1 = the unsharded "
+        "baseline the reductions are measured against)",
+    )
+    ap.add_argument(
         "--confirm-timeout", type=float, default=DEAD_CONFIRM_TIMEOUT_S,
         help="capped single-probe timeout once the backend dead-streak "
         "has tripped (the cheap re-confirmation instead of the full "
@@ -1162,8 +1306,59 @@ def main() -> None:
                 f"(current {hier['hier_gate']['current_mult']} vs median "
                 f"{hier['hier_gate']['median_mult']})"
             )
-        out = {"metric": "hier_wide_frame_multiplier", "hier": hier}
+        out = {
+            "metric": "hier_wide_frame_multiplier",
+            "bench_methodology": BENCH_METHODOLOGY,
+            "hier": hier,
+        }
         print(json.dumps(out), flush=True)
+        try:
+            os.makedirs(os.path.dirname(history_path), exist_ok=True)
+            with open(history_path, "a", encoding="utf-8") as f:
+                f.write(
+                    json.dumps({"record": "bench", "t": time.time(), **out})
+                    + "\n"
+                )
+        except OSError:
+            pass
+        return
+    if args.shard_leg:
+        # Standalone mode (the --hier-leg pattern): transports on the
+        # CPU backend, in-process.  Appends its own record="bench"
+        # history line stamped with the current methodology.
+        ks = [int(s) for s in args.shard_ks.split(",") if s.strip()]
+        if 1 not in ks:
+            ks = [1] + ks  # reductions are measured against the k=1 leg
+        log(
+            f"shard sweep: d={args.shard_size}, ks {ks}, "
+            f"x{args.shard_iters} rounds ..."
+        )
+        sweep = bench_shard(args.shard_size, args.shard_iters, ks=ks)
+        floor = sweep.get("reduction_floor_frac")
+        for fam in ("f32", "topk"):
+            worst = max(k for k in ks)
+            leg = sweep["legs"].get(f"{fam}_k{worst}")
+            if leg is not None:
+                log(
+                    f"shard sweep: {fam} k={worst} -> "
+                    f"{leg['wire_bytes_per_frame']} B/frame, "
+                    f"{leg['reduction_vs_k1']}x vs k=1"
+                )
+        log(
+            f"shard sweep: min(reduction_vs_k1 / k) over k>1 = {floor} "
+            "(acceptance >= 0.9)"
+        )
+        out = {
+            "metric": "shard_wire_byte_reduction",
+            "bench_methodology": BENCH_METHODOLOGY,
+            "shard_sweep": sweep,
+        }
+        print("SHARD_SWEEP " + json.dumps(sweep), flush=True)
+        print(json.dumps(out), flush=True)
+        history_path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "artifacts", "bench_history.jsonl",
+        )
         try:
             os.makedirs(os.path.dirname(history_path), exist_ok=True)
             with open(history_path, "a", encoding="utf-8") as f:
@@ -1403,6 +1598,7 @@ def main() -> None:
     value = dev_gbps if dev_gbps is not None else baseline
     out = {
         "metric": "pairwise_avg_bandwidth",
+        "bench_methodology": BENCH_METHODOLOGY,
         "value": round(value, 3),
         "unit": "GB/s/chip",
         "vs_baseline": round(value / baseline, 2),

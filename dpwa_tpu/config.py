@@ -47,6 +47,12 @@ Schema::
                                 #   event loop, docs/transport.md; wire
                                 #   behavior identical, chaos still
                                 #   forces the threaded server)
+    shard:                      # sharded gossip (TCP only, docs/wire.md)
+      k: 1                      # contiguous shards per replica; each round
+                                #   ships ONE shard (k× fewer wire bytes,
+                                #   full coverage every k rounds), merged
+                                #   slice-wise.  1 = off: frames stay
+                                #   byte-identical to a pre-shard build
     interpolation:
       type: constant            # constant | clock | loss
       factor: 0.5               # constant alpha (0.5 == (local+remote)/2)
@@ -386,6 +392,32 @@ class ProtocolConfig:
         if self.pool_size is not None:
             return self.pool_size
         return max(16, min(128, 2 * n_peers))
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardConfig:
+    """``shard:`` block — exchange 1/k of the replica per round.
+
+    ``k: 1`` (the default) or an absent block keeps sharding OFF and
+    every wire frame byte-identical to a pre-shard build.  ``k > 1``
+    partitions the flattened replica into k contiguous shards; each
+    publish ships the one shard the per-epoch ``shard_draw``
+    permutation assigns to that round (every shard once per k rounds),
+    and the merge touches only that slice.  Composes with
+    ``protocol.wire_dtype`` / ``protocol.wire_codec`` — the inner
+    encoding applies to the slice (top-k selects within the shard, int8
+    scale tables restart per shard).  TCP transport only; see
+    docs/wire.md."""
+
+    k: int = 1
+
+    def __post_init__(self) -> None:
+        if self.k < 1:
+            raise ValueError(f"shard.k must be >= 1, got {self.k}")
+
+    @property
+    def enabled(self) -> bool:
+        return self.k > 1
 
 
 @dataclasses.dataclass(frozen=True)
@@ -1230,6 +1262,7 @@ class TopologyConfig:
 class DpwaConfig:
     nodes: tuple[NodeSpec, ...]
     protocol: ProtocolConfig = ProtocolConfig()
+    shard: ShardConfig = ShardConfig()
     interpolation: InterpolationConfig = InterpolationConfig()
     health: HealthConfig = HealthConfig()
     chaos: ChaosConfig = ChaosConfig()
@@ -1319,6 +1352,7 @@ def config_from_dict(raw: Mapping[str, Any]) -> DpwaConfig:
     if "nodes" not in raw:
         raise ValueError("config is missing the required 'nodes:' list")
     proto = dict(raw.get("protocol") or {})
+    shard = dict(raw.get("shard") or {})
     interp = dict(raw.get("interpolation") or {})
     health = dict(raw.get("health") or {})
     chaos = dict(raw.get("chaos") or {})
@@ -1339,6 +1373,7 @@ def config_from_dict(raw: Mapping[str, Any]) -> DpwaConfig:
     return DpwaConfig(
         nodes=_build_nodes(raw["nodes"]),
         protocol=ProtocolConfig(**proto),
+        shard=ShardConfig(**shard),
         interpolation=InterpolationConfig(**interp),
         health=HealthConfig(**health),
         chaos=ChaosConfig(**chaos),
@@ -1377,6 +1412,7 @@ def make_local_config(
     flowctl: "FlowctlConfig | Mapping[str, Any] | None" = None,
     obs: "ObsConfig | Mapping[str, Any] | None" = None,
     topology: "TopologyConfig | Mapping[str, Any] | None" = None,
+    shard: "ShardConfig | Mapping[str, Any] | None" = None,
     **protocol_kwargs: Any,
 ) -> DpwaConfig:
     """Programmatic config for tests/benchmarks: n local peers on 127.0.0.1.
@@ -1398,6 +1434,8 @@ def make_local_config(
         flowctl = FlowctlConfig(**flowctl)
     if isinstance(obs, Mapping):
         obs = ObsConfig(**obs)
+    if isinstance(shard, Mapping):
+        shard = ShardConfig(**shard)
     if isinstance(topology, Mapping):
         topology = dict(topology)
         if topology.get("islands") is not None:
@@ -1423,4 +1461,5 @@ def make_local_config(
         flowctl=flowctl if flowctl is not None else FlowctlConfig(),
         obs=obs if obs is not None else ObsConfig(),
         topology=topology if topology is not None else TopologyConfig(),
+        shard=shard if shard is not None else ShardConfig(),
     )

@@ -291,6 +291,17 @@ class MetricsLogger:
                     overlap_prefetched=overlap.get("prefetched"),
                     overlap_straddled=overlap.get("straddled"),
                 )
+            shard = wire.get("shard")
+            if shard is not None:
+                # Sharded-wire columns (absent at shard.k == 1, keeping
+                # unsharded records byte-identical): the shard count and
+                # the round-robin coverage (distinct shards served / k,
+                # 1.0 once every shard has crossed the wire).
+                extra = dict(
+                    extra,
+                    shard_k=shard.get("k"),
+                    shard_coverage=shard.get("coverage"),
+                )
         reactor = snapshot.get("reactor")
         if reactor is not None:
             # Reactor scheduler columns (absent under the threaded Rx

@@ -1,0 +1,215 @@
+"""Sharded gossip payloads: ship 1/k of the replica per exchange.
+
+``shard: {k: >1}`` partitions the flattened replica into ``k``
+contiguous shards and makes each publish carry exactly ONE of them —
+the shard whose index :func:`dpwa_tpu.parallel.schedules.shard_draw`
+assigns to the publish clock.  The draw is a pure function of
+``(seed, step, k)``, so both sides of a pair land on the same shard
+each round with no negotiation, and its per-epoch permutation visits
+every shard exactly once per ``k`` rounds — after ``k`` rounds the
+whole vector has crossed the wire once, for ``k×`` fewer bytes per
+round.
+
+On the wire this is payload code 6: a
+:data:`~dpwa_tpu.parallel.protocol_constants.SHARD_HDR_FMT` preamble
+(``shard_idx | k | d | inner_code``) followed by the slice in any
+existing flat dtype or codec — top-k selects *within* the shard, the
+int8 scale tables restart per shard (chunking is per-payload), so the
+codecs compose multiplicatively with the ``k×`` shard saving.
+
+Decode returns a :class:`ShardPayload`, not a vector: like the top-k
+codec, only the receiver holds the replica the slice splices into, so
+densification happens in the transport against the receiver's own
+published view — and the merge touches ONLY the ``[lo, hi)`` slice,
+leaving the other ``k−1`` slices bit-identical (an f32 lerp of a
+coordinate with itself is NOT exact, so "merge the densified vector"
+would silently perturb the unshipped coordinates).
+
+Everything here is numpy + stdlib struct: the codec sits on the
+per-fetch hot path and must be importable without a JAX backend.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple, Union
+
+import numpy as np
+
+from dpwa_tpu.ops.quantize import (
+    TopkPayload,
+    decode_int8_payload,
+    decode_topk_payload,
+)
+from dpwa_tpu.parallel import protocol_constants as _pc
+
+try:  # bf16 inner slices — ml_dtypes ships with jax
+    import ml_dtypes
+except ImportError:  # pragma: no cover - ml_dtypes is a jax dependency
+    ml_dtypes = None
+
+_HDR = _pc.SHARD_HDR
+
+# Inner encodings a shard body may carry.  Deliberately closed: f64/u16
+# never ship from _publish, and a nested shard (code 6 inside code 6)
+# would make frame size unbounded by recursion — all are rejected as
+# malformed.
+_INNER_CODES = (
+    _pc.PAYLOAD_F32,
+    _pc.PAYLOAD_BF16,
+    _pc.PAYLOAD_INT8_CHUNKED,
+    _pc.PAYLOAD_TOPK_DELTA,
+)
+
+
+def shard_bounds(d: int, k: int, idx: int) -> Tuple[int, int]:
+    """``[lo, hi)`` of contiguous shard ``idx`` in a k-way partition.
+
+    The first ``d % k`` shards carry one extra element, so sizes differ
+    by at most one and every coordinate belongs to exactly one shard.
+    Pure arithmetic shared by encode, decode, trust, and the merge —
+    the partition must be impossible to fork between planes."""
+    d, k, idx = int(d), int(k), int(idx)
+    if k < 1:
+        raise ValueError(f"shard count k must be >= 1, got {k}")
+    if not 0 <= idx < k:
+        raise ValueError(f"shard_idx {idx} out of range for k={k}")
+    base, rem = divmod(d, k)
+    lo = idx * base + min(idx, rem)
+    return lo, lo + base + (1 if idx < rem else 0)
+
+
+class ShardPayload:
+    """A decoded shard frame: one contiguous slice of a d-element
+    replica.  ``inner`` is the already-decoded slice content — an f32
+    array for dense inner encodings, or a :class:`TopkPayload` over the
+    slice for top-k-within-shard.  ``nbytes`` is the on-wire payload
+    size (preamble included)."""
+
+    __slots__ = ("d", "k", "shard_idx", "inner_code", "inner", "nbytes")
+
+    def __init__(
+        self,
+        d: int,
+        k: int,
+        shard_idx: int,
+        inner_code: int,
+        inner: Union[np.ndarray, TopkPayload],
+        nbytes: int = 0,
+    ):
+        self.d = int(d)
+        self.k = int(k)
+        self.shard_idx = int(shard_idx)
+        self.inner_code = int(inner_code)
+        self.inner = inner
+        self.nbytes = int(nbytes)
+
+    @property
+    def bounds(self) -> Tuple[int, int]:
+        return shard_bounds(self.d, self.k, self.shard_idx)
+
+    def slice_estimate(self, local_slice: np.ndarray) -> np.ndarray:
+        """The sender-slice estimate as f32: dense inners decode to it
+        directly; a top-k inner splices into the receiver's OWN slice
+        (same absolute-value contract as the full-vector codec)."""
+        if isinstance(self.inner, TopkPayload):
+            return self.inner.densify(local_slice)
+        return self.inner
+
+    def densify(self, local: np.ndarray) -> np.ndarray:
+        """Full-vector sender estimate against the receiver's replica:
+        ``est = local.copy(); est[lo:hi] = slice_estimate``.  For trust
+        and guard plumbing only — the merge slices, never this."""
+        local = np.ascontiguousarray(local, dtype=np.float32).reshape(-1)
+        if local.shape[0] != self.d:
+            raise ValueError(
+                f"shard payload is for d={self.d} but local replica has "
+                f"{local.shape[0]} elements"
+            )
+        lo, hi = self.bounds
+        out = local.copy()
+        out[lo:hi] = self.slice_estimate(local[lo:hi])
+        return out
+
+
+def encode_shard_payload(
+    inner_payload: np.ndarray, d: int, k: int, shard_idx: int,
+    inner_code: int,
+) -> np.ndarray:
+    """uint8 inner payload (the encoded SLICE) -> code-6 wire body."""
+    if inner_code not in _INNER_CODES:
+        raise ValueError(f"shard inner_code {inner_code} not shippable")
+    lo, hi = shard_bounds(d, k, shard_idx)  # validates k / shard_idx
+    del lo, hi
+    head = np.frombuffer(
+        _HDR.pack(int(shard_idx), int(k), int(d), int(inner_code)),
+        np.uint8,
+    )
+    body = np.ascontiguousarray(inner_payload, dtype=np.uint8).reshape(-1)
+    return np.concatenate([head, body])
+
+
+def decode_shard_payload(buf: np.ndarray) -> ShardPayload:
+    """uint8 payload -> :class:`ShardPayload`; raises ValueError on ANY
+    malformed input — truncated preamble, k of zero, out-of-range
+    shard_idx, a slice length that contradicts ``(d, k, shard_idx)``,
+    an unknown/nested inner code, or an inner body that fails its own
+    codec's validation — so the transport classifies the frame CORRUPT
+    instead of crashing.  ``d`` against the local replica is checked by
+    the transport (only it knows the local length)."""
+    raw = np.ascontiguousarray(buf, dtype=np.uint8)
+    if raw.size < _HDR.size:
+        raise ValueError("shard wire payload shorter than its preamble")
+    shard_idx, k, d, inner_code = _HDR.unpack(
+        raw[: _HDR.size].tobytes()
+    )
+    if k < 1:
+        raise ValueError(f"shard wire payload with k={k}")
+    if shard_idx >= k:
+        raise ValueError(
+            f"shard wire payload shard_idx={shard_idx} out of range for "
+            f"k={k}"
+        )
+    if d < 1 or k > d:
+        raise ValueError(f"shard wire payload claims k={k} > d={d}")
+    lo, hi = shard_bounds(d, k, shard_idx)
+    m = hi - lo
+    body = raw[_HDR.size:]
+    if inner_code == _pc.PAYLOAD_F32:
+        if body.size != 4 * m:
+            raise ValueError(
+                f"shard f32 body is {body.size} bytes; {4 * m} expected "
+                f"for slice length {m}"
+            )
+        inner: Union[np.ndarray, TopkPayload] = np.frombuffer(
+            body.tobytes(), "<f4"
+        ).astype(np.float32)
+    elif inner_code == _pc.PAYLOAD_BF16:
+        if ml_dtypes is None:  # pragma: no cover - jax dependency
+            raise ValueError("bf16 shard payload requires ml_dtypes")
+        if body.size != 2 * m:
+            raise ValueError(
+                f"shard bf16 body is {body.size} bytes; {2 * m} expected "
+                f"for slice length {m}"
+            )
+        inner = (
+            np.frombuffer(body.tobytes(), dtype=np.dtype(ml_dtypes.bfloat16))
+            .astype(np.float32)
+        )
+    elif inner_code == _pc.PAYLOAD_INT8_CHUNKED:
+        inner = decode_int8_payload(body)
+        if inner.shape[0] != m:
+            raise ValueError(
+                f"shard int8 body decodes {inner.shape[0]} elements; "
+                f"{m} expected for slice length {m}"
+            )
+    elif inner_code == _pc.PAYLOAD_TOPK_DELTA:
+        inner = decode_topk_payload(body)
+        if inner.n != m:
+            raise ValueError(
+                f"shard top-k body is for n={inner.n}; slice length is {m}"
+            )
+    else:
+        raise ValueError(
+            f"shard wire payload with inner_code={inner_code}"
+        )
+    return ShardPayload(d, k, shard_idx, inner_code, inner, nbytes=raw.size)

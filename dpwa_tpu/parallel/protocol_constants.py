@@ -86,6 +86,13 @@ DIGEST_ENTRY_V2_FMT = "<HBIfHHB"
 # Observability trailer header: magic(4s) version(B) sketch_count(H)
 # trace_id(I) loss_ema(f) reserved(H), then sketch_count f32 values.
 OBS_HDR_FMT = "<4sBHIfH"
+# Sharded-payload preamble (payload code 6), prepended to the inner
+# payload bytes: shard_idx(I) k(I) d(Q) inner_code(B), where ``d`` is
+# the FULL flattened-replica length, ``shard_idx < k`` names which
+# contiguous slice the body carries, and ``inner_code`` is the payload
+# code of the body's encoding (f32 / bf16 / int8_chunked / topk_delta
+# — over the slice, never another shard).
+SHARD_HDR_FMT = "<IIQB"
 # Length prefix used by recovery/state_transfer.py when packing leaves
 # into the opaque state blob served under STATE_MAGIC.
 STATE_PACK_LEN_FMT = "<I"
@@ -107,6 +114,7 @@ DIGEST_HDR = struct.Struct(DIGEST_HDR_FMT)
 DIGEST_ENTRY = struct.Struct(DIGEST_ENTRY_FMT)
 DIGEST_ENTRY_V2 = struct.Struct(DIGEST_ENTRY_V2_FMT)
 OBS_HDR = struct.Struct(OBS_HDR_FMT)
+SHARD_HDR = struct.Struct(SHARD_HDR_FMT)
 STATE_PACK_LEN = struct.Struct(STATE_PACK_LEN_FMT)
 
 # --- payload (dtype) codes: the B ``dtype`` field of BLOB_HDR ---
@@ -139,10 +147,19 @@ PAYLOAD_INT8_CHUNKED = _payload("int8_chunked", 4)
 # happens in TcpTransport.fetch against the receiver's own published
 # view.  protocol.wire_codec: topk.
 PAYLOAD_TOPK_DELTA = _payload("topk_delta", 5)
+# Code 6: sharded payload (SHARD_HDR preamble | inner payload —
+# ops/shard.py).  The body carries ONE contiguous slice of the flattened
+# replica, itself encoded by any flat dtype or codec above (the
+# preamble's inner_code byte), so top-k and int8 compose per shard.
+# fetch_blob_full returns it as a ShardPayload object in the vector
+# slot — like top-k, only the receiver holds the replica the slice
+# merges into.  shard: {k: >1}.
+PAYLOAD_SHARD = _payload("shard", 6)
 # Codec payloads: codes whose body is NOT a flat dtype cast.
 CODEC_PAYLOAD_CODES: Tuple[int, ...] = (
     PAYLOAD_INT8_CHUNKED,
     PAYLOAD_TOPK_DELTA,
+    PAYLOAD_SHARD,
 )
 
 # --- relay outcome codes: the B ``outcome`` field of RELAY_HDR ---
@@ -225,6 +242,16 @@ BACK_COMPAT: Dict[str, str] = {
         "All request magics are 5 bytes so the Rx server reads exactly "
         "5 bytes and dispatches — adding a request type must keep that "
         "length or old servers mis-frame the connection."
+    ),
+    "shard_payload_code": (
+        "Payload code 6 (shard) was appended by the sharded-gossip "
+        "plane: the body is a SHARD_HDR preamble plus one slice of the "
+        "replica in any inner encoding.  Old readers reject the unknown "
+        "code as corrupt, which is the safe direction — they never "
+        "merge a slice as if it were the full vector.  ``shard:`` "
+        "absent or ``k: 1`` never takes this path, so mixed fleets "
+        "interoperate by leaving sharding off until everyone upgrades; "
+        "frames are then byte-identical to pre-shard builds."
     ),
 }
 

@@ -238,6 +238,40 @@ def island_churn_draw(seed, round_, island):
     )
 
 
+def shard_permutation(seed, epoch, k: int) -> np.ndarray:
+    """The shard-visit order for one k-round epoch (tag 32 — the first
+    draw in the second control block, ``tags.CONTROL_TAG_BASE_2``).
+
+    A fresh permutation of ``range(k)`` per epoch, keyed on
+    ``(seed, epoch)`` only: every peer holding the seed computes the
+    same order, so a pair exchanges the SAME slice in both directions
+    each round with no negotiation, and coverage is balanced by
+    construction — each shard is visited exactly once per ``k``
+    consecutive rounds.  A permutation rather than ``step % k`` so the
+    visit order varies across epochs (a fixed order would give shard 0
+    systematically fresher averages than shard k−1 at any stopping
+    point)."""
+    return np.asarray(
+        jax.random.permutation(_pair_key(seed, epoch, 0, _tags.TAG_SHARD), k)
+    )
+
+
+def shard_draw(seed, step, k: int) -> int:
+    """Shard index published at ``step`` under a k-way partition.
+
+    Pure function of ``(seed, step, k)``: ``step`` is bucketed into
+    epochs of ``k`` rounds and indexes that epoch's
+    :func:`shard_permutation`.  The TCP transport keys this on its
+    publish clock; hot-path callers should cache the per-epoch
+    permutation (one draw per k rounds) rather than re-drawing here
+    every round."""
+    k = int(k)
+    if k <= 1:
+        return 0
+    epoch, pos = divmod(int(step), k)
+    return int(shard_permutation(seed, epoch, k)[pos])
+
+
 _CONTROL_DRAWS_WARM = False
 
 
@@ -272,6 +306,7 @@ def warm_control_draws(seed: int = 0, me: int = 0) -> None:
     churn_restart_draw(seed, 0, 2)
     leader_draw(seed, 0, 0, 2)
     island_churn_draw(seed, 0, 0)
+    shard_draw(seed, 0, 2)
     _CONTROL_DRAWS_WARM = True
 
 

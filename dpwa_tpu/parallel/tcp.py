@@ -33,7 +33,7 @@ import socket
 import threading
 import time
 import zlib
-from typing import Optional, Tuple
+from typing import Dict, Optional, Tuple
 
 import numpy as np
 
@@ -81,6 +81,7 @@ _DTYPE_CODES = {v: k for k, v in _DTYPES.items()}
 # protocol_constants.py for their body layouts and decode ownership.
 _INT8_CHUNKED = _pc.PAYLOAD_INT8_CHUNKED
 _TOPK_DELTA = _pc.PAYLOAD_TOPK_DELTA
+_SHARD = _pc.PAYLOAD_SHARD
 _PAYLOAD_CODES = _pc.CODEC_PAYLOAD_CODES
 _MAX_BLOB = _pc.MAX_BLOB_BYTES
 
@@ -827,6 +828,25 @@ def fetch_blob_full(
                         None, Outcome.CORRUPT,
                         time.monotonic() - t0, nbytes_rx, None, None,
                     )
+            elif code == _SHARD:
+                # Sharded frame: one contiguous slice of the replica in
+                # any inner encoding.  Decoded and validated here (lying
+                # k, out-of-range shard_idx, truncated preamble, inner
+                # bodies that fail their own codec — all CORRUPT, never
+                # a crash) but NOT densified: like top-k, only the
+                # transport holds the replica the slice merges into, so
+                # the ShardPayload object rides the vector slot.
+                from dpwa_tpu.ops.shard import decode_shard_payload
+
+                try:
+                    vec = decode_shard_payload(
+                        np.frombuffer(data, dtype=np.uint8)
+                    )
+                except ValueError:
+                    return (
+                        None, Outcome.CORRUPT,
+                        time.monotonic() - t0, nbytes_rx, None, None,
+                    )
             elif code == _INT8_CHUNKED:
                 # Receiver-side dequantize: the wire moved 1 byte/elem
                 # (+ scales); the merge math runs on the f32 decode.
@@ -1292,7 +1312,7 @@ class _OverlappedExchange:
             remote_vec, alpha = self._t._weigh_remote(
                 got, self._clock, self._loss
             )
-            merged = _host_merge(pre_vec, remote_vec, alpha)
+            merged = self._t._merge_remote(pre_vec, remote_vec, alpha)
         if update is not None:
             merged = merged + update
         return merged, alpha, self.partner
@@ -1397,6 +1417,33 @@ class TcpTransport:
                 config.protocol.topk_fraction,
                 config.protocol.topk_values,
             )
+        # Sharded gossip (shard.k > 1, docs/wire.md): each publish ships
+        # ONE contiguous shard of the replica — the one the per-epoch
+        # shard_draw permutation assigns to the publish clock — wrapped
+        # in the code-6 preamble around the inner wire_dtype/wire_codec
+        # encoding, and the merge touches only that slice.  k == 1 (or
+        # an absent shard: block) keeps every branch below untaken and
+        # the frames byte-identical to a pre-shard build.
+        self._shard_k = config.shard.k
+        self._shard_on = config.shard.k > 1
+        # Top-k-within-shard keeps one error-feedback encoder PER shard:
+        # the base tracks "what the ring was told about this slice", and
+        # slices ship on independent cadences, so a shared base would
+        # smear one shard's residuals into another's selection.
+        self._shard_topk_encoders: Dict[int, object] = {}
+        # Per-epoch shard-visit permutation memo (one threefry draw per
+        # k rounds instead of per publish): (epoch, perm ndarray).
+        self._shard_perm: Optional[Tuple[int, np.ndarray]] = None
+        # The CURRENT fetch's shard slice bounds, consumed by
+        # _merge_remote so every merge substrate lerps ONLY [lo, hi)
+        # and copies the other k-1 slices bit-exactly ((1-a)x + ax is
+        # NOT x in f32).  None for dense/topk/full-vector fetches.
+        # dpwalint: double_buffered(_pending_shard) -- written by the fetch leg alongside _pending_trust_scale before finish() joins it; the merge reads strictly after the join
+        self._pending_shard: Optional[Tuple[int, int]] = None
+        # Per-shard wire accounting under _stats_lock: frames and bytes
+        # per shard index, behind wire_snapshot()["shard"] and the
+        # health_report --wire coverage columns.
+        self._shard_tally: Dict[int, Dict[str, int]] = {}
         # Per-publish wire accounting: actual on-wire payload bytes vs
         # the dense f32 size, behind the ``compression_ratio`` health
         # column and bench.py's codec sweep.  Guarded by _stats_lock:
@@ -1735,6 +1782,7 @@ class TcpTransport:
         if (
             self.trust is not None
             or self._wire_topk
+            or self._shard_on
             or (
                 self.config.recovery.enabled
                 and self.config.recovery.min_param_norm_ratio > 0.0
@@ -1743,7 +1791,7 @@ class TcpTransport:
             # Stash the f32 replica this round merges against: trust
             # screening and the zero-energy guard both compare the
             # incoming payload to what we just published — and a top-k
-            # frame can only densify against it.
+            # or shard frame can only densify against it.
             self._local_vec = np.ascontiguousarray(vec, dtype=np.float32)
             f32_vec = self._local_vec
             self._local_norm = float(
@@ -1769,6 +1817,14 @@ class TcpTransport:
             else None
         )
         tid = self._trace_id if obs is not None else None
+        if self._shard_on and vec.dtype == np.float32:
+            # Sharded wire (code 6): the obs trailer above was built
+            # from the FULL replica — the sketch plane's rel_rms stays
+            # full-vector so convergence accounting is honest even
+            # though the frame below carries one slice.
+            self._publish_shard(vec, f32_vec, clock, loss, digest, obs,
+                                tid)
+            return
         if self._wire_topk and vec.dtype == np.float32:
             payload = self._topk_encoder.encode(
                 np.ascontiguousarray(vec, dtype=np.float32).reshape(-1),
@@ -1798,12 +1854,94 @@ class TcpTransport:
         self.server.publish(vec, clock, loss, digest=digest, obs=obs,
                             trace_id=tid)
 
-    def _note_published(self, wire_bytes: int, dense_bytes: int) -> None:
+    def _shard_index(self, step: int, k: int) -> int:
+        """This publish clock's shard under the per-epoch permutation
+        (schedules.shard_draw semantics), with the epoch's permutation
+        memoized — one threefry draw per k rounds, not per publish."""
+        from dpwa_tpu.parallel.schedules import shard_permutation
+
+        epoch, pos = divmod(int(step), k)
+        memo = self._shard_perm
+        if memo is None or memo[0] != epoch:
+            memo = (epoch, shard_permutation(self.schedule.seed, epoch, k))
+            self._shard_perm = memo
+        return int(memo[1][pos])
+
+    def _publish_shard(
+        self, vec: np.ndarray, f32_vec: Optional[np.ndarray],
+        clock: float, loss: float, digest, obs, tid,
+    ) -> None:
+        """Serve this round's shard: slice -> inner wire_dtype /
+        wire_codec encoding -> SHARD_HDR preamble -> code-6 frame.  The
+        codecs compose per slice: top-k selects within the shard (one
+        error-feedback encoder per shard), the int8 scale tables restart
+        at the slice boundary because chunking is per-payload."""
+        from dpwa_tpu.ops import shard as _shard_ops
+
+        flat = (
+            f32_vec
+            if f32_vec is not None
+            else np.ascontiguousarray(vec, dtype=np.float32)
+        ).reshape(-1)
+        k = self._shard_k
+        idx = self._shard_index(int(clock), k)
+        lo, hi = _shard_ops.shard_bounds(flat.size, k, idx)
+        sl = np.ascontiguousarray(flat[lo:hi])
+        if self._wire_topk:
+            enc = self._shard_topk_encoders.get(idx)
+            if enc is None:
+                from dpwa_tpu.ops.quantize import TopkEncoder
+
+                enc = TopkEncoder(
+                    self.config.protocol.topk_fraction,
+                    self.config.protocol.topk_values,
+                )
+                self._shard_topk_encoders[idx] = enc
+            inner = enc.encode(sl, self.schedule.seed, clock, self.me)
+            inner_code = _TOPK_DELTA
+        elif self._wire_int8:
+            from dpwa_tpu.ops.quantize import encode_int8_payload
+
+            inner = encode_int8_payload(
+                sl, self.schedule.seed, clock, self.me
+            )
+            inner_code = _INT8_CHUNKED
+        elif self._wire_bf16:
+            inner = np.frombuffer(
+                sl.astype(_DTYPES[3]).tobytes(), np.uint8
+            )
+            inner_code = _pc.PAYLOAD_BF16
+        else:
+            inner = np.frombuffer(sl.astype("<f4").tobytes(), np.uint8)
+            inner_code = _pc.PAYLOAD_F32
+        payload = _shard_ops.encode_shard_payload(
+            inner, flat.size, k, idx, inner_code
+        )
+        self._note_published(
+            int(payload.size), int(flat.size) * 4, shard=idx
+        )
+        self.server.publish(
+            payload, clock, loss, code=_SHARD, digest=digest, obs=obs,
+            trace_id=tid,
+        )
+
+    def _note_published(
+        self, wire_bytes: int, dense_bytes: int,
+        shard: Optional[int] = None,
+    ) -> None:
         with self._stats_lock:
             t = self._wire_tally
             t["frames"] += 1
             t["wire_bytes"] += wire_bytes
             t["dense_bytes"] += dense_bytes
+            if shard is not None:
+                st = self._shard_tally.get(shard)
+                if st is None:
+                    st = self._shard_tally[shard] = {
+                        "frames": 0, "wire_bytes": 0,
+                    }
+                st["frames"] += 1
+                st["wire_bytes"] += wire_bytes
 
     # dpwalint: thread_root(overlap-fetch)
     def fetch(
@@ -1904,16 +2042,67 @@ class TcpTransport:
         codec = None
         sparse_guard = None   # (values, local_selected) for the guard
         sparse_trust = None   # (indices, values) for trust screening
+        trust_codec = None    # baseline family key (inner codec for shard)
+        trust_shard = None    # shard index for per-(codec, shard) windows
+        trust_local = None    # slice-local vectors for shard screening
+        trust_remote = None
+        # Double-buffered shard bounds: None for every dense/top-k frame
+        # so the merge substrates fall through to the full-vector lerp;
+        # a successfully decoded shard frame below overwrites it with
+        # its [lo, hi) before finish() joins the round.
+        self._pending_shard = None
         if got is not None and not isinstance(got[0], np.ndarray):
             t_stage = time.monotonic() if timing else 0.0
-            # Top-k delta frame: fetch_blob_full returns the decoded
-            # TopkPayload in the vector slot; only this side holds the
-            # replica the indices splice into.  No stashed local replica
-            # (or a size mismatch after a reshard) means the frame
-            # cannot be interpreted — classified corrupt, never merged.
+            # Sparse frame: fetch_blob_full returns the decoded payload
+            # object (TopkPayload or ShardPayload) in the vector slot;
+            # only this side holds the replica it splices into.  No
+            # stashed local replica (or a size mismatch after a reshard)
+            # means the frame cannot be interpreted — classified
+            # corrupt, never merged.
+            from dpwa_tpu.ops.shard import ShardPayload
+
             sp = got[0]
             lv = self._local_vec
-            if lv is None or int(lv.size) != int(sp.n):
+            if isinstance(sp, ShardPayload):
+                if lv is None or int(lv.size) != int(sp.d):
+                    got = None
+                    outcome = Outcome.CORRUPT
+                else:
+                    lo, hi = sp.bounds
+                    local_slice = np.ascontiguousarray(lv[lo:hi])
+                    est_slice = sp.slice_estimate(local_slice)
+                    inner = sp.inner
+                    if not isinstance(inner, np.ndarray):
+                        # top-k within the shard: guard/trust judge the
+                        # SUPPORT, indices relative to the slice.
+                        trust_codec = "topk"
+                        local_sel = local_slice[
+                            inner.indices.astype(np.intp)
+                        ]
+                        sparse_guard = (inner.values, local_sel)
+                        sparse_trust = (inner.indices, inner.values)
+                    else:
+                        trust_codec = {
+                            0: "f32", 3: "bf16", 4: "int8",
+                        }.get(sp.inner_code, "dense")
+                        # Zero-energy screening on the slice actually
+                        # shipped — the densified remote shares k−1
+                        # slices with the local replica, which would
+                        # mask a silenced shard.
+                        sparse_guard = (est_slice, local_slice)
+                    codec = f"shard+{trust_codec}"
+                    trust_shard = sp.shard_idx
+                    # Trust compares slice against slice: cosine/norm on
+                    # the densified FULL vector would sit near +1 by
+                    # construction (k−1 shared slices) and dilute the
+                    # byzantine signal k-fold.
+                    trust_local = local_slice
+                    trust_remote = est_slice
+                    remote = lv.astype(np.float32, copy=True)
+                    remote[lo:hi] = est_slice
+                    got = (remote, got[1], got[2])
+                    self._pending_shard = (lo, hi)
+            elif lv is None or int(lv.size) != int(sp.n):
                 got = None
                 outcome = Outcome.CORRUPT
             else:
@@ -1962,8 +2151,14 @@ class TcpTransport:
             # byzantine peer answers header probes perfectly.
             t_stage = time.monotonic() if timing else 0.0
             verdict, scale, tstats = self.trust.screen(
-                peer_index, got[0], got[1], self._local_vec, round=step,
-                codec=codec or "dense", sparse=sparse_trust,
+                peer_index,
+                trust_remote if trust_remote is not None else got[0],
+                got[1],
+                trust_local if trust_local is not None else self._local_vec,
+                round=step,
+                codec=trust_codec or codec or "dense",
+                sparse=sparse_trust,
+                shard=trust_shard,
             )
             if timing:
                 tr.mark("trust", time.monotonic() - t_stage)
@@ -2436,7 +2631,7 @@ class TcpTransport:
             # Present exactly when the reactor serves this node, so
             # threaded runs keep their health records byte-identical.
             snap["reactor"] = reactor_snap()
-        if self._wire_topk or self._prefetch_on:
+        if self._wire_topk or self._prefetch_on or self._shard_on:
             # Gated on the new planes being ON: a dense sequential run
             # keeps its health records byte-identical to PR 5.
             snap["wire"] = self.wire_snapshot()
@@ -2468,7 +2663,12 @@ class TcpTransport:
         of fetch wall-time the caller never waited on)."""
         with self._stats_lock:
             t = dict(self._wire_tally)
+            shard_tally = {
+                i: dict(st) for i, st in self._shard_tally.items()
+            }
         codec = "topk" if self._wire_topk else self.config.protocol.wire_dtype
+        if self._shard_on:
+            codec = f"shard+{codec}"
         out = {
             "codec": codec,
             "frames": t["frames"],
@@ -2483,6 +2683,23 @@ class TcpTransport:
         if self._wire_topk:
             out["topk_fraction"] = self.config.protocol.topk_fraction
             out["topk_values"] = self.config.protocol.topk_values
+        if self._shard_on:
+            k = self._shard_k
+            # coverage = distinct shards this node has actually served /
+            # k — the round-robin invariant says it reaches 1.0 within
+            # the first k publishes and stays there.
+            out["shard"] = {
+                "k": k,
+                "frames_per_shard": [
+                    shard_tally.get(i, {}).get("frames", 0)
+                    for i in range(k)
+                ],
+                "wire_bytes_per_shard": [
+                    shard_tally.get(i, {}).get("wire_bytes", 0)
+                    for i in range(k)
+                ],
+                "coverage": round(len(shard_tally) / k, 4),
+            }
         if self._prefetch_on:
             with self._stats_lock:
                 o = dict(self._overlap)
@@ -2654,6 +2871,28 @@ class TcpTransport:
         size the overlapped-join backstop.  Mirrors :meth:`publish`'s
         encoding choice exactly."""
         n = int(vec.size)
+        if self._shard_on and vec.dtype == np.float32:
+            # Sharded frame: SHARD_HDR preamble + the inner encoding
+            # over the LONGEST slice (ceil(n/k)) — a conservative upper
+            # bound is fine for a join backstop.
+            m = -(-n // self._shard_k)
+            if self._wire_topk:
+                from dpwa_tpu.ops.quantize import topk_k, topk_nbytes
+
+                inner = topk_nbytes(
+                    m,
+                    topk_k(m, self.config.protocol.topk_fraction),
+                    self.config.protocol.topk_values,
+                )
+            elif self._wire_int8:
+                from dpwa_tpu.ops.quantize import _n_chunks
+
+                inner = 8 + 4 * _n_chunks(m) + m
+            elif self._wire_bf16:
+                inner = 2 * m
+            else:
+                inner = 4 * m
+            return _pc.SHARD_HDR.size + inner
         if self._wire_topk and vec.dtype == np.float32:
             from dpwa_tpu.ops.quantize import topk_k, topk_nbytes
 
@@ -2691,6 +2930,27 @@ class TcpTransport:
             # the ICI transport's bf16-wire merge).
             remote_vec = remote_vec.astype(np.float32)
         return remote_vec, alpha
+
+    def _merge_remote(
+        self, vec: np.ndarray, remote_vec: np.ndarray, alpha: float
+    ) -> np.ndarray:
+        """The merge shared by every host-side substrate: full-vector
+        lerp normally; when the consume leg stashed shard bounds, lerp
+        ONLY the ``[lo, hi)`` slice and copy the rest bit-exactly.  An
+        f32 ``(1-α)·x + α·x`` is NOT exactly ``x``, so lerping the
+        densified full vector would silently perturb the k−1 slices the
+        frame never shipped."""
+        bounds = self._pending_shard
+        if bounds is None:
+            return _host_merge(vec, remote_vec, alpha)
+        lo, hi = bounds
+        merged = np.array(vec, dtype=np.float32, copy=True)
+        merged[lo:hi] = _host_merge(
+            np.ascontiguousarray(merged[lo:hi]),
+            np.ascontiguousarray(remote_vec[lo:hi]),
+            alpha,
+        )
+        return merged
 
     def _round(
         self, vec: np.ndarray, clock: float, loss: float, step: int
@@ -2901,7 +3161,7 @@ class TcpTransport:
             if remote_vec is None:
                 return vec, alpha, partner
             t0 = time.monotonic() if rt else 0.0
-            merged = _host_merge(vec, remote_vec, alpha)
+            merged = self._merge_remote(vec, remote_vec, alpha)
             if rt:
                 tr.mark("merge", time.monotonic() - t0)
                 tr.set(alpha=float(alpha))
@@ -2967,7 +3227,7 @@ class TcpTransport:
                 return vec, 0.0, partner
             remote_vec, alpha = self._weigh_remote(got, clock, loss)
             t_m = time.monotonic() if rt else 0.0
-            merged = _host_merge(vec, remote_vec, alpha)
+            merged = self._merge_remote(vec, remote_vec, alpha)
             if rt:
                 tr.mark("merge", time.monotonic() - t_m)
                 tr.set(alpha=float(alpha))
@@ -3159,11 +3419,19 @@ class TcpTransport:
         the rebuild's actual data plane — each OS process free-runs its
         own device-resident replica — where the lock-step SPMD paths
         emulate it with masked merges."""
-        remote_vec, alpha, partner = self._round(
-            np.asarray(vec_dev), clock, loss, step
-        )
+        host_vec = np.asarray(vec_dev)
+        remote_vec, alpha, partner = self._round(host_vec, clock, loss, step)
         if remote_vec is None:
             return vec_dev, alpha, partner
+        if self._pending_shard is not None:
+            # Sharded round: the slice-only merge must keep the k−1
+            # unshipped slices bit-identical, which a full-vector device
+            # lerp cannot (f32 (1-α)x + αx ≠ x).  Merge on the host copy
+            # the publish leg already downloaded, upload the result.
+            import jax.numpy as jnp
+
+            merged = self._merge_remote(host_vec, remote_vec, alpha)
+            return jnp.asarray(merged), alpha, partner
         return _device_lerp(vec_dev, remote_vec, alpha), alpha, partner
 
     def close(self) -> None:

@@ -162,6 +162,7 @@ class TrustManager:
         round: Optional[int] = None,
         codec: str = "dense",
         sparse: Optional[Tuple[np.ndarray, np.ndarray]] = None,
+        shard: Optional[int] = None,
     ) -> Tuple[str, float, Dict[str, Any]]:
         """Classify one decoded payload; returns ``(verdict,
         alpha_scale, stats)``.  ``alpha_scale`` is the trust-scaled merge
@@ -175,7 +176,17 @@ class TrustManager:
         is a real extension of the dense guarantees, not a bypass —
         support-space magnitudes never poison the dense windows and vice
         versa.  ``remote_vec`` stays the DENSIFIED vector (the shape
-        check guards what would actually merge)."""
+        check guards what would actually merge).
+
+        ``shard`` — for a sharded frame, the shard index.  The transport
+        then passes the local/remote SLICES as ``local_vec`` /
+        ``remote_vec`` (norm and cosine are slice-vs-slice — a full-
+        vector cosine would sit near +1 for ANY slice content, since the
+        densified remote shares k−1 of k slices with the local replica)
+        and the baseline windows are keyed per (codec, shard): different
+        slices of a real model have legitimately different magnitude
+        profiles, and a rejected shard must not poison the history the
+        other shards' frames are screened against."""
         cfg = self.config
         lenient = self._observe_contact(peer, round)
         if remote_vec.size != local_vec.size:
@@ -193,7 +204,11 @@ class TrustManager:
                 local_vec, remote_vec,
                 self._resolve_leaf_starts(local_vec.size),
             )
-        baselines = self._baselines_for(codec)
+        if shard is not None:
+            stats["shard"] = int(shard)
+        baselines = self._baselines_for(
+            codec if shard is None else f"{codec}:s{int(shard)}"
+        )
         with self._lock:
             armed = (
                 min(len(b) for b in baselines.values())

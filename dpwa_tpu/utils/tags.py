@@ -12,13 +12,16 @@ raw tag literal that does not come from here.
 
 Layout of the tag space:
 
-- ``0 .. 15`` allocated control-plane draws (below) — the block is now
-  full; the next claimant must move ``CHAOS_TAG_BASE`` draws or pick a
-  new base past the chaos kinds (and update this comment).
-- ``16 ..``    chaos fault-kind streams: ``CHAOS_TAG_BASE + kind`` where
-  ``kind`` is one of the ``CHAOS_KIND_*`` indices below.  Keeping the
-  chaos kinds far clear of the control tags means new control draws can
-  claim 10..15 without colliding with fault kinds.
+- ``0 .. 15``  first control-plane block (below) — FULL as of the
+  island-churn draw; new control draws go in the second block.
+- ``16 .. 31`` chaos fault-kind streams: ``CHAOS_TAG_BASE + kind`` where
+  ``kind`` is one of the ``CHAOS_KIND_*`` indices below (13 of 16 kinds
+  allocated; the remaining three stay reserved for future fault kinds so
+  chaos never has to renumber).
+- ``32 .. 47`` second control-plane block (``CONTROL_TAG_BASE_2``),
+  opened for the shard-schedule draw once 0..15 filled.  Allocate new
+  control draws here, bottom-up; when THIS block fills, open 48..63 and
+  extend this comment.
 
 The int8 stochastic-rounding stream in ``ops/quantize.py`` is keyed on a
 separate ``fold_in(fold_in(key, step), sender)`` chain (no control tag)
@@ -113,6 +116,15 @@ CHAOS_KIND_BYZ_ZERO = _register_chaos_kind("byz_zero", 10)
 # draws, so a trickled peer can ALSO stall, like a real overloaded box.
 CHAOS_KIND_STALL = _register_chaos_kind("stall", 11)
 CHAOS_KIND_STALL_LEN = _register_chaos_kind("stall_len", 12)
+
+# Second control-plane block (0..15 filled; 16..31 belongs to chaos).
+CONTROL_TAG_BASE_2 = 32
+
+# Sharded gossip (ops/shard.py + schedules.shard_draw): the per-epoch
+# shard-visit permutation.  Keyed on the publish clock, so a pair of
+# free-running peers lands on the same shard each round without any
+# negotiation, and every shard is visited exactly once per k rounds.
+TAG_SHARD = _register("shard_draw", CONTROL_TAG_BASE_2 + 0)
 
 
 def registered_tags() -> Dict[int, str]:

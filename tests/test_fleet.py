@@ -569,9 +569,14 @@ def test_reactor_chaos_partition_blocks_relay_guard():
 # ---------------------------------------------------------------------------
 
 
-def _hist(values):
+def _hist(values, methodology=bench.BENCH_METHODOLOGY):
     return [
-        {"record": "bench", "tcp_baseline_gbps": v} for v in values
+        {
+            "record": "bench",
+            "bench_methodology": methodology,
+            "tcp_baseline_gbps": v,
+        }
+        for v in values
     ]
 
 
@@ -607,6 +612,38 @@ def test_tcp_gate_windows_recent_history():
     gate = bench.tcp_gate(hist, 0.21, window=8)
     assert gate["median_gbps"] == 0.2
     assert gate["verdict"] == "ok"
+
+
+def test_tcp_gate_compares_like_with_like_only():
+    # The unpinned pre-methodology era (no bench_methodology stamp) and
+    # older stamps never enter the window: a tail of 0.024 GB/s unpinned
+    # samples next to pinned 0.45 ones must not drag the median (the
+    # "verdict is always improved" bug) — and alone they mean no_data,
+    # never a judgement against an incomparable era.
+    legacy = [{"record": "bench", "tcp_baseline_gbps": 0.024}] * 6
+    gate = bench.tcp_gate(legacy + _hist([0.45, 0.44]), 0.45)
+    assert gate["samples"] == 2
+    assert gate["verdict"] == "ok"
+    gate = bench.tcp_gate(legacy, 0.45)
+    assert gate["samples"] == 0
+    assert gate["verdict"] == "no_data"
+    old_stamp = _hist([0.024] * 4, methodology=bench.BENCH_METHODOLOGY - 1)
+    assert bench.tcp_gate(old_stamp, 0.45)["verdict"] == "no_data"
+
+
+def test_hier_gate_compares_like_with_like_only():
+    def mk(v, m):
+        e = {"record": "bench", "hier": {"wide_multiplier_min": v}}
+        if m is not None:
+            e["bench_methodology"] = m
+        return e
+
+    legacy = [mk(9.0, None)] * 5
+    cur = [mk(2.0, bench.BENCH_METHODOLOGY), mk(2.1, bench.BENCH_METHODOLOGY)]
+    gate = bench.hier_gate(legacy + cur, 2.0)
+    assert gate["samples"] == 2
+    assert gate["verdict"] == "ok"
+    assert bench.hier_gate(legacy, 2.0)["verdict"] == "no_data"
 
 
 def test_read_bench_history_survives_junk(tmp_path):

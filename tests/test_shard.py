@@ -1,0 +1,639 @@
+"""Sharded gossip (payload code 6, ``shard: {k: >1}`` — docs/wire.md).
+
+Each exchange ships ONE deterministic contiguous shard of the flattened
+replica: the per-round index comes from the threefry ``shard_draw``
+stream (every shard visited exactly once per k rounds), the frame is a
+``SHARD_HDR`` preamble plus the slice in any inner wire encoding, and
+the merge lerps ONLY the ``[lo, hi)`` slice.  These tests pin the
+partition arithmetic, the draw's balanced coverage, the codec roundtrip
+per inner encoding, the malformed-frame taxonomy (ValueError at decode,
+``corrupt`` over the real wire on BOTH Rx servers — never a crash), the
+algebraic identity that k slice-merges over a fixed pool equal one
+full-vector merge bit-exactly, byte-identity of the wire when the block
+is absent or ``k: 1``, a 4-node convergence soak vs the unsharded run,
+and per-(codec, shard) trust screening of sign-flipped shard frames."""
+
+import socket
+import struct
+
+import numpy as np
+import pytest
+
+from dpwa_tpu.config import make_local_config
+from dpwa_tpu.health.detector import Outcome
+from dpwa_tpu.ops import quantize as qz
+from dpwa_tpu.ops import shard as sh
+from dpwa_tpu.parallel import protocol_constants as pc
+from dpwa_tpu.parallel.schedules import shard_draw, shard_permutation
+from dpwa_tpu.parallel.tcp import _SHARD, TcpTransport, _host_merge
+
+
+def _ring(n, **cfg_kwargs):
+    cfg = make_local_config(n, base_port=0, **cfg_kwargs)
+    ts = [TcpTransport(cfg, f"node{i}") for i in range(n)]
+    for t in ts:
+        for i, other in enumerate(ts):
+            t.set_peer_port(i, other.port)
+    return ts
+
+
+def _close(ts):
+    for t in ts:
+        t.close()
+
+
+# ---------------------------------------------------------------------------
+# Partition arithmetic and the shard draw (ops/shard.py, schedules)
+# ---------------------------------------------------------------------------
+
+
+def test_shard_bounds_partition_every_coordinate_exactly_once():
+    for d, k in [(10, 3), (4096, 4), (7, 7), (5, 1), (100, 8)]:
+        seen = []
+        sizes = []
+        for idx in range(k):
+            lo, hi = sh.shard_bounds(d, k, idx)
+            assert 0 <= lo <= hi <= d
+            seen.extend(range(lo, hi))
+            sizes.append(hi - lo)
+        assert seen == list(range(d))  # contiguous, disjoint, complete
+        assert max(sizes) - min(sizes) <= 1  # balanced to within one
+
+
+def test_shard_bounds_validates_k_and_idx():
+    with pytest.raises(ValueError):
+        sh.shard_bounds(10, 0, 0)
+    with pytest.raises(ValueError):
+        sh.shard_bounds(10, 4, 4)
+    with pytest.raises(ValueError):
+        sh.shard_bounds(10, 4, -1)
+
+
+def test_shard_draw_visits_every_shard_once_per_epoch():
+    for k in (2, 4, 8):
+        for epoch in range(3):
+            visited = [shard_draw(0, epoch * k + pos, k)
+                       for pos in range(k)]
+            assert sorted(visited) == list(range(k)), (k, epoch)
+    # k == 1 short-circuits without a draw.
+    assert shard_draw(0, 5, 1) == 0
+
+
+def test_shard_draw_is_deterministic_and_epoch_keyed():
+    a = [shard_draw(7, s, 4) for s in range(16)]
+    b = [shard_draw(7, s, 4) for s in range(16)]
+    assert a == b  # pure function of (seed, step, k)
+    assert a != [shard_draw(8, s, 4) for s in range(16)]  # seed moves it
+    # A permutation per epoch, not a fixed step % k order: across many
+    # epochs at least one epoch must visit in a different order.
+    perms = {tuple(shard_permutation(7, e, 4).tolist()) for e in range(32)}
+    assert len(perms) > 1
+
+
+# ---------------------------------------------------------------------------
+# Codec roundtrip per inner encoding
+# ---------------------------------------------------------------------------
+
+
+def _inner_payload(sl, inner_code):
+    if inner_code == pc.PAYLOAD_F32:
+        return np.frombuffer(sl.astype("<f4").tobytes(), np.uint8)
+    if inner_code == pc.PAYLOAD_BF16:
+        import ml_dtypes
+
+        return np.frombuffer(
+            sl.astype(ml_dtypes.bfloat16).tobytes(), np.uint8
+        )
+    if inner_code == pc.PAYLOAD_INT8_CHUNKED:
+        return qz.encode_int8_payload(sl, 0, 0.0, 0)
+    if inner_code == pc.PAYLOAD_TOPK_DELTA:
+        return qz.TopkEncoder(0.25, "f32").encode(sl, 0, 0.0, 0)
+    raise AssertionError(inner_code)
+
+
+@pytest.mark.parametrize("inner_code,tol", [
+    (pc.PAYLOAD_F32, 0.0),
+    (pc.PAYLOAD_BF16, 0.01),
+    (pc.PAYLOAD_INT8_CHUNKED, 0.05),
+])
+def test_shard_roundtrip_dense_inners(inner_code, tol):
+    rng = np.random.default_rng(2)
+    d, k, idx = 103, 4, 2  # uneven split: first d%k shards one longer
+    full = rng.standard_normal(d).astype(np.float32)
+    lo, hi = sh.shard_bounds(d, k, idx)
+    payload = sh.encode_shard_payload(
+        _inner_payload(full[lo:hi], inner_code), d, k, idx, inner_code
+    )
+    sp = sh.decode_shard_payload(payload)
+    assert (sp.d, sp.k, sp.shard_idx) == (d, k, idx)
+    assert sp.bounds == (lo, hi)
+    assert sp.nbytes == payload.size
+    local = rng.standard_normal(d).astype(np.float32)
+    dense = sp.densify(local)
+    if tol == 0.0:
+        np.testing.assert_array_equal(dense[lo:hi], full[lo:hi])
+    else:
+        np.testing.assert_allclose(
+            dense[lo:hi], full[lo:hi], rtol=tol, atol=tol
+        )
+    # The other k-1 slices are the receiver's own, bit-identical.
+    mask = np.ones(d, bool)
+    mask[lo:hi] = False
+    np.testing.assert_array_equal(dense[mask], local[mask])
+    with pytest.raises(ValueError):
+        sp.densify(local[:-1])  # d mismatch never splices
+
+
+def test_shard_roundtrip_topk_inner_composes():
+    rng = np.random.default_rng(3)
+    d, k, idx = 512, 4, 1
+    full = rng.standard_normal(d).astype(np.float32)
+    lo, hi = sh.shard_bounds(d, k, idx)
+    payload = sh.encode_shard_payload(
+        _inner_payload(full[lo:hi], pc.PAYLOAD_TOPK_DELTA),
+        d, k, idx, pc.PAYLOAD_TOPK_DELTA,
+    )
+    sp = sh.decode_shard_payload(payload)
+    assert isinstance(sp.inner, qz.TopkPayload)
+    assert sp.inner.n == hi - lo  # indices are SLICE-relative
+    local = rng.standard_normal(d).astype(np.float32)
+    dense = sp.densify(local)
+    # Shipped support carries the sender's values; everything else —
+    # including unshipped coordinates INSIDE the shard — stays local.
+    sel = sp.inner.indices.astype(np.intp)
+    np.testing.assert_array_equal(dense[lo:hi][sel], sp.inner.values)
+    inner_mask = np.ones(hi - lo, bool)
+    inner_mask[sel] = False
+    np.testing.assert_array_equal(
+        dense[lo:hi][inner_mask], local[lo:hi][inner_mask]
+    )
+
+
+def test_encode_rejects_nested_and_unknown_inner_codes():
+    body = np.zeros(4, np.uint8)
+    with pytest.raises(ValueError):
+        sh.encode_shard_payload(body, 1, 1, 0, pc.PAYLOAD_SHARD)  # nested
+    with pytest.raises(ValueError):
+        sh.encode_shard_payload(body, 1, 1, 0, 99)
+    with pytest.raises(ValueError):
+        sh.encode_shard_payload(body, 4, 2, 2, pc.PAYLOAD_F32)  # idx >= k
+
+
+# ---------------------------------------------------------------------------
+# Malformed-frame taxonomy: decode ValueError, wire-level CORRUPT
+# ---------------------------------------------------------------------------
+
+_FUZZ_D = 64
+
+
+def _valid_shard_payload(d=_FUZZ_D, k=4, idx=1, inner_code=pc.PAYLOAD_F32):
+    rng = np.random.default_rng(0)
+    full = rng.standard_normal(d).astype(np.float32)
+    lo, hi = sh.shard_bounds(d, k, idx)
+    return sh.encode_shard_payload(
+        _inner_payload(full[lo:hi], inner_code), d, k, idx, inner_code
+    ).tobytes()
+
+
+def _mutations():
+    good = bytearray(_valid_shard_payload())
+
+    def with_head(**kw):
+        b = bytearray(good)
+        idx, k, d, code = pc.SHARD_HDR.unpack(bytes(b[: pc.SHARD_HDR.size]))
+        idx = kw.get("idx", idx)
+        k = kw.get("k", k)
+        d = kw.get("d", d)
+        code = kw.get("code", code)
+        b[: pc.SHARD_HDR.size] = pc.SHARD_HDR.pack(idx, k, d, code)
+        return bytes(b)
+
+    return [
+        ("truncated_preamble", bytes(good[: pc.SHARD_HDR.size - 3])),
+        ("truncated_body", bytes(good[:-5])),
+        ("trailing_garbage", bytes(good) + b"\x00\x00"),
+        ("zero_k", with_head(k=0)),
+        ("idx_out_of_range", with_head(idx=4)),
+        ("lying_k", with_head(k=8)),  # body length contradicts the slice
+        ("k_gt_d", with_head(k=_FUZZ_D + 1, idx=0, d=_FUZZ_D)),
+        ("zero_d", with_head(d=0)),
+        ("d_mismatch_vs_body", with_head(d=_FUZZ_D * 2)),
+        ("unknown_inner", with_head(code=9)),
+        ("nested_shard_inner", with_head(code=pc.PAYLOAD_SHARD)),
+        ("corrupt_topk_inner", _valid_shard_payload(
+            inner_code=pc.PAYLOAD_TOPK_DELTA
+        )[: pc.SHARD_HDR.size + 7]),
+    ]
+
+
+@pytest.mark.parametrize("name,raw", _mutations())
+def test_decode_rejects_malformed(name, raw):
+    with pytest.raises(ValueError):
+        sh.decode_shard_payload(np.frombuffer(raw, np.uint8))
+
+
+@pytest.mark.parametrize("rx_server", ["threaded", "reactor"])
+def test_served_malformed_shard_frames_corrupt_never_crash(rx_server):
+    """Fuzz over the REAL wire on both Rx servers: node 1 serves each
+    malformed code-6 body in turn; node 0 must classify ``corrupt``,
+    skip the merge, and keep serving the next round."""
+    ts = _ring(
+        2, shard={"k": 4}, timeout_ms=2000, rx_server=rx_server,
+        health=dict(enabled=False),
+    )
+    try:
+        vec = np.linspace(0.0, 1.0, _FUZZ_D).astype(np.float32)
+        step = 0
+
+        def next_paired(step):
+            while ts[0].schedule.partner(step, 0) != 1:
+                step += 1
+            return step
+
+        for name, raw in _mutations():
+            step = next_paired(step)
+            ts[1].server.publish(
+                np.frombuffer(raw, np.uint8), float(step), 0.0,
+                code=_SHARD,
+            )
+            merged, alpha, partner = ts[0].exchange(vec, step, 0.0, step)
+            assert partner == 1
+            assert alpha == 0.0, name  # never merged
+            assert ts[0].last_fetch["outcome"] == Outcome.CORRUPT, name
+            np.testing.assert_array_equal(merged, vec)
+            step += 1
+        # A well-formed frame whose d disagrees with the local replica
+        # is corrupt too (the transport owns that check).
+        step = next_paired(step)
+        ts[1].server.publish(
+            np.frombuffer(
+                _valid_shard_payload(d=_FUZZ_D * 2), np.uint8
+            ),
+            float(step), 0.0, code=_SHARD,
+        )
+        _, alpha, _ = ts[0].exchange(vec, step, 0.0, step)
+        assert alpha == 0.0
+        assert ts[0].last_fetch["outcome"] == Outcome.CORRUPT
+        step += 1
+        # Both ends survived the taxonomy: an honest round merges.
+        step = next_paired(step)
+        ts[1].publish(vec * 2.0, step, 0.0)
+        merged, alpha, _ = ts[0].exchange(vec, step, 0.0, step)
+        assert alpha != 0.0
+        assert ts[0].last_fetch["outcome"] == Outcome.SUCCESS
+        assert ts[0].last_fetch["codec"] == "shard+f32"
+    finally:
+        _close(ts)
+
+
+# ---------------------------------------------------------------------------
+# Algebraic identity: k slice-merges over a fixed pool == one full merge
+# ---------------------------------------------------------------------------
+
+
+def test_k_slice_merges_equal_one_full_vector_merge_bit_exactly():
+    rng = np.random.default_rng(5)
+    d, k, alpha, seed = 1000, 4, 0.37, 11
+    local = rng.standard_normal(d).astype(np.float32)
+    remote = rng.standard_normal(d).astype(np.float32)
+    full = _host_merge(local.copy(), remote, alpha)
+    acc = local.copy()
+    visited = []
+    for step in range(k):
+        idx = shard_draw(seed, step, k)
+        visited.append(idx)
+        lo, hi = sh.shard_bounds(d, k, idx)
+        acc[lo:hi] = _host_merge(
+            np.ascontiguousarray(acc[lo:hi]),
+            np.ascontiguousarray(remote[lo:hi]),
+            alpha,
+        )
+    assert sorted(visited) == list(range(k))  # one epoch covers all
+    np.testing.assert_array_equal(acc, full)  # bit-exact on CPU
+
+
+def test_merge_remote_touches_only_the_pending_slice():
+    ts = _ring(2, shard={"k": 4}, timeout_ms=2000)
+    try:
+        rng = np.random.default_rng(6)
+        local = rng.standard_normal(103).astype(np.float32)
+        remote = rng.standard_normal(103).astype(np.float32)
+        lo, hi = sh.shard_bounds(103, 4, 2)
+        ts[0]._pending_shard = (lo, hi)
+        merged = ts[0]._merge_remote(local, remote, 0.5)
+        mask = np.ones(103, bool)
+        mask[lo:hi] = False
+        np.testing.assert_array_equal(merged[mask], local[mask])
+        np.testing.assert_array_equal(
+            merged[lo:hi],
+            _host_merge(
+                np.ascontiguousarray(local[lo:hi]),
+                np.ascontiguousarray(remote[lo:hi]),
+                0.5,
+            ),
+        )
+        # No pending bounds -> the plain full-vector merge.
+        ts[0]._pending_shard = None
+        np.testing.assert_array_equal(
+            ts[0]._merge_remote(local, remote, 0.5),
+            _host_merge(local.copy(), remote, 0.5),
+        )
+    finally:
+        _close(ts)
+
+
+# ---------------------------------------------------------------------------
+# Byte-identity: shard absent / k == 1 -> frames identical to a
+# pre-shard build's
+# ---------------------------------------------------------------------------
+
+
+def _raw_served_frame(port):
+    with socket.create_connection(("127.0.0.1", port), timeout=2) as s:
+        s.sendall(pc.BLOB_REQ)
+        s.settimeout(2)
+        chunks = []
+        while True:
+            b = s.recv(1 << 16)
+            if not b:
+                break
+            chunks.append(b)
+    return b"".join(chunks)
+
+
+@pytest.mark.parametrize("codec_cfg", [
+    {},
+    dict(wire_dtype="int8"),
+    dict(wire_codec="topk", topk_fraction=0.25),
+])
+def test_k1_and_absent_shard_block_serve_byte_identical_frames(codec_cfg):
+    vec = np.linspace(0.0, 1.0, 256).astype(np.float32)
+    frames = []
+    for shard_cfg in ({}, dict(shard={"k": 1})):
+        ts = _ring(2, timeout_ms=2000, **codec_cfg, **shard_cfg)
+        try:
+            ts[0].publish(vec, 3.0, 0.25)
+            frames.append(_raw_served_frame(ts[0].port))
+        finally:
+            _close(ts)
+    assert frames[0] == frames[1]
+    # And neither is a code-6 frame: the payload code byte in the blob
+    # header (after magic + version) stays whatever the codec published
+    # before sharding existed.
+    code = frames[0][struct.calcsize("<4sBB") - 1]
+    assert code != pc.PAYLOAD_SHARD
+
+
+def test_k2_frames_do_use_the_shard_code():
+    vec = np.linspace(0.0, 1.0, 256).astype(np.float32)
+    ts = _ring(2, timeout_ms=2000, shard={"k": 2})
+    try:
+        ts[0].publish(vec, 3.0, 0.25)
+        frame = _raw_served_frame(ts[0].port)
+        assert frame[struct.calcsize("<4sBB") - 1] == pc.PAYLOAD_SHARD
+    finally:
+        _close(ts)
+
+
+# ---------------------------------------------------------------------------
+# Wire accounting: measured k-fold byte reduction, snapshot, metrics
+# ---------------------------------------------------------------------------
+
+
+def _drive_rounds(ts, vecs, rounds):
+    for step in range(rounds):
+        vecs = [
+            np.asarray(
+                ts[i].exchange(vecs[i], step, 0.0, step)[0], np.float32
+            )
+            for i in range(len(ts))
+        ]
+    return vecs
+
+
+def test_wire_bytes_drop_k_fold_and_coverage_reaches_one():
+    d, k, rounds = 8192, 4, 8
+    rng = np.random.default_rng(7)
+    base = [rng.standard_normal(d).astype(np.float32) for _ in range(2)]
+    per_frame = {}
+    for kk in (1, k):
+        ts = _ring(2, timeout_ms=2000, shard={"k": kk})
+        try:
+            _drive_rounds(ts, [b.copy() for b in base], rounds)
+            snap = ts[0].wire_snapshot()
+            per_frame[kk] = snap["wire_bytes"] / snap["frames"]
+            if kk > 1:
+                assert snap["codec"] == "shard+f32"
+                assert snap["shard"]["k"] == kk
+                assert snap["shard"]["coverage"] == 1.0
+                # Balanced round-robin: every shard served equally.
+                fps = snap["shard"]["frames_per_shard"]
+                assert max(fps) - min(fps) <= 1 and sum(fps) > 0
+            else:
+                assert "shard" not in snap
+        finally:
+            _close(ts)
+    reduction = per_frame[1] / per_frame[k]
+    assert reduction >= 0.9 * k, (per_frame, reduction)
+
+
+def test_health_snapshot_and_metrics_gain_shard_columns_only_when_on():
+    import io
+    import json
+
+    from dpwa_tpu.metrics import MetricsLogger
+
+    vec = np.linspace(0.0, 1.0, 256).astype(np.float32)
+    ts = _ring(2, timeout_ms=2000, shard={"k": 2})
+    try:
+        _drive_rounds(ts, [vec.copy(), vec * 2.0], 4)
+        snap = ts[0].health_snapshot()
+        assert snap["wire"]["shard"]["k"] == 2
+        sio = io.StringIO()
+        log = MetricsLogger(stream=sio)
+        log.log_health(0, snap)
+        rec = json.loads(sio.getvalue().splitlines()[-1])
+        assert rec["shard_k"] == 2
+        assert rec["shard_coverage"] == 1.0
+        log.close()
+    finally:
+        _close(ts)
+    ts = _ring(2, timeout_ms=2000)
+    try:
+        _drive_rounds(ts, [vec.copy(), vec * 2.0], 2)
+        snap = ts[0].health_snapshot()
+        assert "wire" not in snap  # dense sequential stays pre-shard
+    finally:
+        _close(ts)
+
+
+# ---------------------------------------------------------------------------
+# Acceptance: 4-node shard soak — converges within tolerance of
+# unsharded in <= k x the rounds, bit-identical across reruns
+# ---------------------------------------------------------------------------
+
+_SOAK_STEPS = 48
+_SOAK_K = 4
+
+
+def _run_soak(steps, seed=6, **wire_cfg):
+    ts = _ring(4, seed=seed, schedule="ring", timeout_ms=2000, **wire_cfg)
+    dim = 64
+    target = np.linspace(-1.0, 1.0, dim).astype(np.float32)
+    rng = np.random.RandomState(seed)
+    vecs = [
+        (target + rng.standard_normal(dim).astype(np.float32))
+        for _ in range(4)
+    ]
+    digests = []
+    try:
+        for step in range(steps):
+            losses = [float(np.mean((v - target) ** 2)) for v in vecs]
+            vecs = [v - 0.1 * 2.0 * (v - target) / dim for v in vecs]
+            vecs = [
+                np.asarray(
+                    ts[i].exchange(
+                        vecs[i].astype(np.float32), step, losses[i], step
+                    )[0],
+                    np.float32,
+                )
+                for i in range(4)
+            ]
+            digests.append([v.tobytes() for v in vecs])
+        final = [float(np.mean((v - target) ** 2)) for v in vecs]
+        spread = max(
+            float(np.abs(vecs[i] - vecs[j]).max())
+            for i in range(4)
+            for j in range(i + 1, 4)
+        )
+        return digests, final, spread
+    finally:
+        _close(ts)
+
+
+def test_shard_soak_converges_within_k_times_the_rounds():
+    _, dense_final, dense_spread = _run_soak(_SOAK_STEPS)
+    # The sharded run gets k x the rounds (each round moves 1/k of the
+    # coordinates) and must land within tolerance of the dense run.
+    _, shard_final, shard_spread = _run_soak(
+        _SOAK_STEPS * _SOAK_K, shard={"k": _SOAK_K}
+    )
+    for df, sf in zip(dense_final, shard_final):
+        assert sf < max(10.0 * df, 1e-2), (dense_final, shard_final)
+    assert shard_spread < max(10.0 * dense_spread, 0.5)
+
+
+def test_shard_soak_bit_identical_rerun():
+    dig_a, fin_a, _ = _run_soak(_SOAK_STEPS, shard={"k": _SOAK_K})
+    dig_b, fin_b, _ = _run_soak(_SOAK_STEPS, shard={"k": _SOAK_K})
+    assert dig_a == dig_b
+    assert fin_a == fin_b
+    assert dig_a[-1] != dig_a[0]  # the rounds actually exchanged
+
+
+# ---------------------------------------------------------------------------
+# Per-(codec, shard) trust: a sign-flipped single-shard frame is
+# rejected without quarantining the honest shards' history
+# ---------------------------------------------------------------------------
+
+_TIGHT_TRUST = dict(window=16, min_window=2, amnesty_gap=0, amnesty_rounds=0)
+
+
+def test_byzantine_single_shard_rejected_without_cross_shard_damage():
+    k = 2
+    ts = _ring(
+        2, seed=3, shard={"k": k}, trust=_TIGHT_TRUST, timeout_ms=2000,
+        health=dict(enabled=False),
+    )
+    try:
+        rng = np.random.default_rng(9)
+        d = 256
+        vecs = [
+            (np.linspace(0.5, 1.5, d)
+             + 0.01 * rng.standard_normal(d)).astype(np.float32)
+            for _ in range(2)
+        ]
+        # Honest warmup: every (codec, shard) baseline window arms.
+        step = 0
+        while step < 12:
+            vecs = [
+                np.asarray(
+                    ts[i].exchange(vecs[i], step, 0.1, step)[0], np.float32
+                )
+                for i in range(2)
+            ]
+            step += 1
+        baselines = ts[0].trust._codec_baselines
+        assert {f"f32:s{i}" for i in range(k)} <= set(baselines)
+        fills_before = {
+            key: {
+                stat: len(b._window)
+                for stat, b in baselines[key].items()
+            }
+            for key in (f"f32:s{i}" for i in range(k))
+        }
+        # Attack round: node 1 serves the DRAWN shard with its content
+        # sign-flipped (header honest, content lies — wire-valid).
+        while ts[0].schedule.partner(step, 0) != 1:
+            step += 1
+        idx = shard_draw(ts[0].schedule.seed, step, k)
+        lo, hi = sh.shard_bounds(d, k, idx)
+        flipped = -vecs[1][lo:hi]
+        ts[1].server.publish(
+            sh.encode_shard_payload(
+                np.frombuffer(flipped.astype("<f4").tobytes(), np.uint8),
+                d, k, idx, pc.PAYLOAD_F32,
+            ),
+            float(step), 0.1, code=_SHARD,
+        )
+        merged, alpha, _ = ts[0].exchange(vecs[0], step, 0.1, step)
+        assert alpha == 0.0  # rejected, never merged
+        assert ts[0].last_fetch["outcome"] == Outcome.UNTRUSTED
+        tinfo = ts[0].last_fetch["trust"]
+        assert tinfo["shard"] == idx
+        assert tinfo["cosine"] < -0.9  # slice-vs-slice signal, undiluted
+        np.testing.assert_array_equal(merged, vecs[0])
+        # The rejection charged NO shard's baseline history: rejected
+        # frames never push stats, and the other shards' windows are
+        # exactly as the warmup left them.
+        fills_after = {
+            key: {
+                stat: len(b._window)
+                for stat, b in baselines[key].items()
+            }
+            for key in (f"f32:s{i}" for i in range(k))
+        }
+        assert fills_after == fills_before
+        # Honest rounds afterwards stay trusted on every shard.
+        step += 1
+        trusted = 0
+        while trusted < 2 * k and step < 40:
+            ts[1].publish(vecs[1], float(step), 0.1)
+            merged, alpha, _ = ts[0].exchange(vecs[0], step, 0.1, step)
+            if ts[0].schedule.partner(step, 0) == 1 and alpha != 0.0:
+                assert ts[0].last_fetch["outcome"] == Outcome.SUCCESS
+                trusted += 1
+                vecs[0] = np.asarray(merged, np.float32)
+            step += 1
+        assert trusted >= 2 * k  # both shards kept merging after
+    finally:
+        _close(ts)
+
+
+def test_trust_screens_slice_against_slice():
+    """The densified full vector shares k-1 slices with the local
+    replica, so full-vector cosine would sit near +1 even for a flipped
+    shard — the transport must hand trust the SLICES."""
+    from dpwa_tpu.trust.screen import payload_stats
+
+    rng = np.random.default_rng(4)
+    d, k, idx = 256, 4, 1
+    local = rng.standard_normal(d).astype(np.float32)
+    lo, hi = sh.shard_bounds(d, k, idx)
+    flipped_slice = -local[lo:hi]
+    densified = local.copy()
+    densified[lo:hi] = flipped_slice
+    diluted = payload_stats(local, densified)
+    undiluted = payload_stats(local[lo:hi], flipped_slice)
+    assert diluted["cosine"] > 0.0  # the dilution trap
+    assert undiluted["cosine"] == pytest.approx(-1.0, abs=1e-5)

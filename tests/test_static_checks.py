@@ -567,8 +567,10 @@ def test_wire_magics_are_pinned():
     # layout contracts ride along: a format change is a wire break
     assert pc.BLOB_HDR_FMT == "<4sBBddQ"
     assert pc.STATE_HDR_FMT == "<4sBIQQII"
-    assert sorted(pc.registered_payload_codes()) == [0, 1, 2, 3, 4, 5]
+    assert sorted(pc.registered_payload_codes()) == [0, 1, 2, 3, 4, 5, 6]
     assert pc.registered_payload_codes()[5] == "topk_delta"
+    assert pc.registered_payload_codes()[6] == "shard"
+    assert pc.SHARD_HDR_FMT == "<IIQB"
     assert pc.RELAY_OUTCOME_NAMES == (
         "success", "timeout", "refused", "short_read", "corrupt", "busy",
     )
@@ -606,8 +608,13 @@ def test_threefry_tags_are_pinned():
         26: "chaos:byz_zero",
         27: "chaos:stall",
         28: "chaos:stall_len",
+        32: "shard_draw",
     }
     assert tags.CHAOS_TAG_BASE == 16
+    # Second control-plane block: 0..15 is full, 16..31 belongs to the
+    # chaos fault-kind streams, so new control draws allocate from 32 up.
+    assert tags.CONTROL_TAG_BASE_2 == 32
+    assert tags.TAG_SHARD == 32
 
 
 def test_tag_collision_raises():

@@ -36,9 +36,10 @@ outcomes, and the serving side's shed totals.
 
 ``--wire`` prints the wire-plane digest (docs/wire.md): the publishing
 codec, cumulative on-wire bytes and the final wire-vs-dense compression
-ratio, the number of sparse (top-k) fetches consumed, and — when the
-prefetch pipeline contributed — the overlap occupancy and
-hidden-fetch-fraction trajectory.
+ratio, the number of sparse (top-k) fetches consumed, the sharded-wire
+view when ``shard.k > 1`` (k, round-robin coverage, shard fetches
+consumed), and — when the prefetch pipeline contributed — the overlap
+occupancy and hidden-fetch-fraction trajectory.
 
 ``--reactor`` prints the reactor Rx scheduler digest
 (docs/transport.md): the event-loop lag trajectory (final/max EWMA ms),
@@ -163,6 +164,10 @@ def summarize(
         "hidden_frac_final": None,
         "prefetched": None,
         "straddled": None,
+        "shard_seen": False,  # any shard_* column / shard+* codec
+        "shard_k": None,
+        "shard_coverage_final": None,
+        "shard_fetches": 0,  # exchange records consumed as shard frames
     }
 
     reactor: Dict[str, Any] = {
@@ -365,6 +370,12 @@ def summarize(
                     )
                     wire["prefetched"] = rec.get("overlap_prefetched")
                     wire["straddled"] = rec.get("overlap_straddled")
+                if rec.get("shard_k") is not None:
+                    wire["shard_seen"] = True
+                    wire["shard_k"] = rec["shard_k"]
+                    wire["shard_coverage_final"] = rec.get(
+                        "shard_coverage"
+                    )
             lag = rec.get("reactor_loop_lag_ms")
             if lag is not None:
                 reactor["seen"] = True
@@ -412,6 +423,10 @@ def summarize(
         if rec.get("codec") == "topk":
             wire["seen"] = True
             wire["topk_fetches"] += 1
+        if str(rec.get("codec") or "").startswith("shard+"):
+            wire["seen"] = True
+            wire["shard_seen"] = True
+            wire["shard_fetches"] += 1
         if rec.get("outcome") == "untrusted":
             trust["seen"] = True
             trust["untrusted_fetches"] += 1
@@ -548,6 +563,12 @@ def _print_wire(summary: Dict[str, Any]) -> None:
     )
     if w.get("topk_fetches"):
         print(f"  sparse (top-k) fetches consumed: {w['topk_fetches']}")
+    if w.get("shard_seen"):
+        print(
+            f"  shard: k={w.get('shard_k')}, round-robin coverage "
+            f"{w.get('shard_coverage_final')} (distinct shards served "
+            f"/ k); shard fetches consumed: {w.get('shard_fetches')}"
+        )
     if w.get("overlap_seen"):
         print(
             f"  prefetch overlap: occupancy {w.get('occupancy_final')}, "

@@ -117,6 +117,13 @@ _HEALTH_GROUPS: Dict[str, Dict[str, tuple]] = {
         "overlap_prefetched": (int,),
         "overlap_straddled": (int,),
     },
+    # Sharded wire (shard.k > 1).  Bench records carry the shard sweep
+    # (``shard_sweep`` / ``bench_methodology``) inside their open
+    # leg-defined payload — the bench envelope stays unversioned here.
+    "shard": {
+        "shard_k": (int,),
+        "shard_coverage": _NUM,
+    },
     "obs": {
         "disagreement_rms": _NUM + (type(None),),
         "disagreement_rel": _NUM + (type(None),),
