@@ -37,6 +37,7 @@ import subprocess
 import sys
 import threading
 import time
+import tracemalloc
 
 import numpy as np
 
@@ -351,13 +352,21 @@ def pin_cpu_budget(n: int = TCP_LEG_CPU_BUDGET) -> bool:
 
 
 def bench_tcp(
-    d: int, iters: int, timeout_ms: int = 10000, repeats: int = 3
-) -> float:
+    d: int, iters: int, timeout_ms: int = 10000, repeats: int = 3,
+    warmups: int = 3,
+) -> dict:
     """Reference-equivalent baseline: 2 peers, localhost TCP, CPU merge.
 
-    Runs ``repeats`` independent measurement passes of ``iters``
-    exchanges each and reports the median of the per-pass medians —
-    one noisy pass (GC, a cron wakeup) cannot drag the headline."""
+    Runs ``warmups`` throwaway exchanges (socket buffers, allocator
+    pools, the adaptive-deadline estimator, and the receive ring all
+    start cold — the first exchanges of a fresh pair measure setup, not
+    steady state), then ``repeats`` independent measurement passes of
+    ``iters`` exchanges each.  The headline ``gbps`` is the median of
+    the per-pass medians — one noisy pass (GC, a cron wakeup) cannot
+    drag it — and ``spread_iqr_frac`` (IQR of the per-pass GB/s over
+    their median) quantifies how much the passes disagreed, so
+    :func:`tcp_gate` can refuse to trust a wobbling baseline instead of
+    letting it silently inflate ``vs_baseline``."""
     from dpwa_tpu.config import make_local_config
     from dpwa_tpu.parallel.tcp import TcpTransport
 
@@ -372,17 +381,18 @@ def bench_tcp(
         vecs = [
             np.full(d, float(i), np.float32) for i in range(2)
         ]
-        # Warmup round.
-        for i, t in enumerate(ts):
-            t.publish(vecs[i], 0, 0)
-        for i, t in enumerate(ts):
-            t.exchange(vecs[i], 0, 0, 0)
+        warmups = max(1, warmups)
+        for w in range(warmups):
+            for i, t in enumerate(ts):
+                t.publish(vecs[i], w, 0)
+            for i, t in enumerate(ts):
+                t.exchange(vecs[i], w, 0, w)
 
         medians = []
         for rep in range(max(1, repeats)):
             durations = []
             for it in range(iters):
-                step = 1 + rep * iters + it
+                step = warmups + rep * iters + it
                 for i, t in enumerate(ts):
                     t.publish(vecs[i], step, 0)
                 results = [None, None]
@@ -402,9 +412,20 @@ def bench_tcp(
                 durations.append(time.perf_counter() - t0)
                 assert results[0][1] != 0.0, "TCP exchange failed"
             medians.append(float(np.median(durations)))
-        dt = float(np.median(medians))
         # Per peer per exchange: receive d*4 bytes + write the merge d*4.
-        return 2 * d * 4 / dt / 1e9
+        rep_gbps = [2 * d * 4 / m / 1e9 for m in medians]
+        gbps = float(np.median(rep_gbps))
+        q25, q75 = np.percentile(rep_gbps, [25, 75])
+        return {
+            "gbps": gbps,
+            "rep_gbps": [round(g, 4) for g in rep_gbps],
+            "spread_iqr_frac": (
+                round(float(q75 - q25) / gbps, 4) if gbps > 0 else None
+            ),
+            "warmups": int(warmups),
+            "repeats": int(max(1, repeats)),
+            "iters": int(iters),
+        }
     finally:
         for t in ts:
             t.close()
@@ -412,6 +433,11 @@ def bench_tcp(
 
 TCP_GATE_WINDOW = 8
 TCP_GATE_REL_TOL = 0.5
+# A baseline whose measurement passes disagree by more than this
+# (IQR / median of the per-pass GB/s) is not a baseline — the verdict
+# becomes "unstable" and vs_baseline is suspect regardless of where the
+# headline number happened to land inside the band.
+TCP_GATE_SPREAD_TOL = 0.25
 
 # Measurement-methodology version stamped on every history entry this
 # bench writes (``bench_methodology``).  The gates below only median
@@ -433,6 +459,8 @@ def tcp_gate(
     window: int = TCP_GATE_WINDOW,
     rel_tol: float = TCP_GATE_REL_TOL,
     methodology: int = BENCH_METHODOLOGY,
+    spread_iqr_frac=None,
+    spread_tol: float = TCP_GATE_SPREAD_TOL,
 ) -> dict:
     """Regression gate for the TCP baseline (pure; tests/test_fleet.py).
 
@@ -445,7 +473,14 @@ def tcp_gate(
     a hard failure): a "regressed" TCP baseline silently *inflates*
     ``vs_baseline``, so the 21x-127x headline is only trusted when the
     gate says "ok".  Until two comparable samples exist the verdict is
-    ``no_data`` — never a judgement against an incomparable era."""
+    ``no_data`` — never a judgement against an incomparable era.
+
+    ``spread_iqr_frac`` is :func:`bench_tcp`'s own dispersion measure
+    (IQR of the per-pass GB/s over their median).  When it exceeds
+    ``spread_tol`` the verdict is ``unstable`` BEFORE any band
+    comparison: a measurement whose passes disagree by >25% can land
+    anywhere in the band by luck, so neither "ok" nor "regressed" would
+    mean anything."""
     samples = [
         float(e["tcp_baseline_gbps"])
         for e in history
@@ -466,7 +501,19 @@ def tcp_gate(
             round(float(current_gbps), 3)
             if current_gbps is not None else None
         ),
+        "spread_iqr_frac": (
+            round(float(spread_iqr_frac), 4)
+            if spread_iqr_frac is not None else None
+        ),
+        "spread_tol": float(spread_tol),
     }
+    if (
+        current_gbps is not None
+        and spread_iqr_frac is not None
+        and float(spread_iqr_frac) > spread_tol
+    ):
+        gate["verdict"] = "unstable"
+        return gate
     if current_gbps is None or len(samples) < 2:
         gate["verdict"] = "no_data"
         return gate
@@ -1059,6 +1106,148 @@ def bench_serve(frame_floats: int, fps_seconds: float) -> dict:
     }
 
 
+# Frame sizes for the zero-copy leg: 16 MiB (a mid-size replica) and
+# ~100 MB (the ResNet-50-scale default the headline bench ships).
+COPY_SWEEP_FRAME_FLOATS = (4 * 1024 * 1024, 24 * 1024 * 1024)
+
+
+def _legacy_fetch_blob(host: str, port: int, timeout_ms: int = 20000):
+    """The pre-ring fetch loop, preserved as the copy-leg baseline.
+
+    This is what ``fetch_blob_full`` did before the zero-copy hot path
+    landed: grow a bytearray chunk by chunk (every growth past the
+    allocator's slack recopies the accumulated payload), then pay one
+    more full-payload copy materializing ``bytes(buf)`` for
+    ``np.frombuffer``.  Kept verbatim — same chunk cap, same EOF
+    semantics — so the leg measures the copies, not a strawman."""
+    import socket as _socket
+
+    from dpwa_tpu.parallel.tcp import _HDR, _MAGIC, _REQ
+
+    with _socket.create_connection(
+        (host, port), timeout=timeout_ms / 1e3
+    ) as sock:
+        sock.settimeout(timeout_ms / 1e3)
+        sock.sendall(_REQ)
+
+        def recv_n(n: int) -> bytes:
+            buf = bytearray()
+            while len(buf) < n:
+                chunk = sock.recv(min(1 << 20, n - len(buf)))
+                if not chunk:
+                    raise ConnectionError("peer closed mid-message")
+                buf += chunk
+            return bytes(buf)  # the full-payload copy the ring removed
+
+        magic, version, code, clock, loss, nbytes = _HDR.unpack(
+            recv_n(_HDR.size)
+        )
+        assert magic == _MAGIC and version == 1 and code == 0
+        return np.frombuffer(recv_n(nbytes), np.float32), clock, loss
+
+
+def bench_copy(
+    sizes=COPY_SWEEP_FRAME_FLOATS, iters: int = 5, timeout_ms: int = 20000
+) -> dict:
+    """Zero-copy frame-path leg: old fetch loop vs the receive ring.
+
+    For each frame size and each Rx server (threaded and reactor), one
+    fetcher runs ``iters`` sequential f32-blob fetches down each path:
+
+    - **legacy** — :func:`_legacy_fetch_blob`, the pre-ring chunk-grow
+      loop with its ``bytes()`` materialization;
+    - **zerocopy** — ``fetch_blob_full`` with an owned ring lease
+      (``lease_box``, released per frame): ``recv_into`` straight into
+      the pooled buffer, decode as a view, scatter-gather serve.
+
+    Reports frames/sec and GB/s per path, the speedup, and — the
+    O(header) proof — tracemalloc's peak allocation across one warmed
+    zerocopy fetch (``decode_alloc_per_frame_bytes``), which stays
+    thousands of times below the frame size when nothing copies."""
+    from dpwa_tpu.config import FlowctlConfig
+    from dpwa_tpu.health.detector import Outcome
+    from dpwa_tpu.parallel.reactor import ReactorPeerServer
+    from dpwa_tpu.parallel.tcp import PeerServer, fetch_blob_full
+
+    fc = FlowctlConfig(token_rate=1e9, token_burst=1e9)
+    makers = {
+        "threaded": lambda: PeerServer("127.0.0.1", 0, flowctl=fc),
+        "reactor": lambda: ReactorPeerServer("127.0.0.1", 0, flowctl=fc),
+    }
+    frames: dict = {}
+    for floats in sizes:
+        vec = np.zeros(int(floats), np.float32)
+        servers: dict = {}
+        for name, make in makers.items():
+            srv = make()
+            try:
+                srv.publish(vec, 1.0, 0.0)
+
+                def legacy_fetch():
+                    got, _, _ = _legacy_fetch_blob(
+                        "127.0.0.1", srv.port, timeout_ms
+                    )
+                    assert got.nbytes == vec.nbytes
+
+                def zerocopy_fetch():
+                    box: list = []
+                    res, outcome, _, _, _, _ = fetch_blob_full(
+                        "127.0.0.1", srv.port, timeout_ms, lease_box=box
+                    )
+                    assert outcome == Outcome.SUCCESS, outcome
+                    assert res[0].nbytes == vec.nbytes
+                    del res  # views die before the lease goes back
+                    box[0].release()
+
+                def timed(fn) -> float:
+                    durs = []
+                    for _ in range(max(1, iters)):
+                        t0 = time.perf_counter()
+                        fn()
+                        durs.append(time.perf_counter() - t0)
+                    return float(np.median(durs))
+
+                # Warm both paths: TCP windows, allocator slack, and the
+                # ring's size classes (probe + payload) all settle.
+                legacy_fetch()
+                zerocopy_fetch()
+                legacy_dt = timed(legacy_fetch)
+                zerocopy_dt = timed(zerocopy_fetch)
+                tracemalloc.start()
+                try:
+                    zerocopy_fetch()
+                    _, alloc_peak = tracemalloc.get_traced_memory()
+                finally:
+                    tracemalloc.stop()
+                servers[name] = {
+                    "legacy_fps": round(1.0 / legacy_dt, 2),
+                    "legacy_gbps": round(vec.nbytes / legacy_dt / 1e9, 3),
+                    "zerocopy_fps": round(1.0 / zerocopy_dt, 2),
+                    "zerocopy_gbps": round(
+                        vec.nbytes / zerocopy_dt / 1e9, 3
+                    ),
+                    "speedup": round(legacy_dt / zerocopy_dt, 2),
+                    "decode_alloc_per_frame_bytes": int(alloc_peak),
+                }
+            finally:
+                srv.close()
+        frames[f"{vec.nbytes >> 20}MiB"] = {
+            "frame_bytes": int(vec.nbytes),
+            "servers": servers,
+        }
+    best = max(
+        leg["speedup"]
+        for fr in frames.values()
+        for leg in fr["servers"].values()
+    )
+    return {
+        "iters": int(iters),
+        "sizes_floats": [int(s) for s in sizes],
+        "frames": frames,
+        "best_speedup": best,
+    }
+
+
 # ---------------------------------------------------------------------------
 # Watchdog'd subprocess orchestration (main process never imports JAX).
 # ---------------------------------------------------------------------------
@@ -1100,26 +1289,38 @@ def probe_backend(timeout_s: float) -> tuple[str | None, bool]:
 
 
 def run_leg(
-    leg: str, extra: list[str], tag: str, timeout_s: float, env: dict
-) -> float | None:
-    """Run one benchmark leg as a watchdog'd subprocess; GB/s or None."""
+    leg: str, extra: list[str], tag: str, timeout_s: float, env: dict,
+    json_tag: str | None = None,
+):
+    """Run one benchmark leg as a watchdog'd subprocess; GB/s or None.
+
+    With ``json_tag`` set, also parses that tag's JSON payload line and
+    returns ``(gbps, payload_dict | None)`` instead of the bare float —
+    the TCP leg ships its spread statistics alongside the headline."""
     cmd = [sys.executable, os.path.abspath(__file__), leg, *extra]
+    val = payload = None
     try:
         proc = subprocess.run(
             cmd, capture_output=True, text=True, timeout=timeout_s, env=env
         )
     except subprocess.TimeoutExpired:
         log(f"{leg} HUNG past {timeout_s:.0f}s — killed")
-        return None
+        return (None, None) if json_tag else None
     sys.stderr.write(proc.stderr or "")
     if proc.returncode != 0:
         log(f"{leg} failed rc={proc.returncode}")
-        return None
+        return (None, None) if json_tag else None
     for line in proc.stdout.splitlines():
         if line.startswith(tag + " "):
-            return float(line.split()[1])
-    log(f"{leg} produced no {tag} line")
-    return None
+            val = float(line.split()[1])
+        elif json_tag and line.startswith(json_tag + " "):
+            try:
+                payload = json.loads(line.split(None, 1)[1])
+            except json.JSONDecodeError:
+                log(f"{leg} produced an unparseable {json_tag} line")
+    if val is None:
+        log(f"{leg} produced no {tag} line")
+    return (val, payload) if json_tag else val
 
 
 def main() -> None:
@@ -1141,6 +1342,11 @@ def main() -> None:
         "--tcp-repeats", type=int, default=3,
         help="independent TCP-leg measurement passes; the reported "
         "baseline is the median of the per-pass medians",
+    )
+    ap.add_argument(
+        "--tcp-warmups", type=int, default=3,
+        help="throwaway TCP exchanges before the measured passes "
+        "(sockets, allocator pools, and the receive ring start cold)",
     )
     ap.add_argument(
         "--tcp-size", type=int, default=0,
@@ -1249,6 +1455,23 @@ def main() -> None:
         "baseline the reductions are measured against)",
     )
     ap.add_argument(
+        "--copy-leg", action="store_true",
+        help="run ONLY the zero-copy frame-path leg: old chunk-grow "
+        "fetch loop vs the recv_into receive ring, per Rx server and "
+        "frame size — frames/sec, GB/s, speedup, and tracemalloc's "
+        "per-frame decode allocation; appends its own "
+        "bench_history.jsonl record",
+    )
+    ap.add_argument(
+        "--copy-frame-floats", type=str,
+        default=",".join(str(s) for s in COPY_SWEEP_FRAME_FLOATS),
+        help="comma-separated frame sizes (floats) for the copy leg",
+    )
+    ap.add_argument(
+        "--copy-iters", type=int, default=5,
+        help="timed fetches per (server, size, path) copy-leg cell",
+    )
+    ap.add_argument(
         "--confirm-timeout", type=float, default=DEAD_CONFIRM_TIMEOUT_S,
         help="capped single-probe timeout once the backend dead-streak "
         "has tripped (the cheap re-confirmation instead of the full "
@@ -1264,11 +1487,12 @@ def main() -> None:
         pinned = pin_cpu_budget(TCP_LEG_CPU_BUDGET)
         if not pinned:
             log("tcp leg: CPU pinning unavailable; baseline is unpinned")
-        gbps = bench_tcp(
+        stats = bench_tcp(
             args.tcp_size or args.size, args.tcp_iters,
-            repeats=args.tcp_repeats,
+            repeats=args.tcp_repeats, warmups=args.tcp_warmups,
         )
-        print(f"TCP_GBPS {gbps:.6f}", flush=True)
+        print(f"TCP_GBPS {stats['gbps']:.6f}", flush=True)
+        print("TCP_STATS " + json.dumps(stats), flush=True)
         return
     if args.wire_leg:
         sweep = bench_wire(args.wire_size, args.wire_iters)
@@ -1369,6 +1593,49 @@ def main() -> None:
         except OSError:
             pass
         return
+    if args.copy_leg:
+        # Standalone mode (the --shard-leg pattern): raw servers +
+        # fetchers in-process on the CPU backend.  Appends its own
+        # record="bench" history line stamped with the methodology.
+        sizes = [
+            int(s) for s in args.copy_frame_floats.split(",") if s.strip()
+        ]
+        log(
+            f"copy leg: frames {[s * 4 // (1 << 20) for s in sizes]} MiB, "
+            f"x{args.copy_iters} fetches per cell ..."
+        )
+        sweep = bench_copy(sizes, args.copy_iters)
+        for fr_name, fr in sweep["frames"].items():
+            for srv_name, leg in fr["servers"].items():
+                log(
+                    f"copy leg: {fr_name} [{srv_name}] "
+                    f"{leg['legacy_fps']} -> {leg['zerocopy_fps']} "
+                    f"frames/s ({leg['speedup']}x, "
+                    f"{leg['zerocopy_gbps']} GB/s), decode alloc "
+                    f"{leg['decode_alloc_per_frame_bytes']} B/frame"
+                )
+        log(f"copy leg: best speedup {sweep['best_speedup']}x")
+        out = {
+            "metric": "zero_copy_frame_path",
+            "bench_methodology": BENCH_METHODOLOGY,
+            "copy": sweep,
+        }
+        print("COPY_LEG " + json.dumps(sweep), flush=True)
+        print(json.dumps(out), flush=True)
+        history_path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "artifacts", "bench_history.jsonl",
+        )
+        try:
+            os.makedirs(os.path.dirname(history_path), exist_ok=True)
+            with open(history_path, "a", encoding="utf-8") as f:
+                f.write(
+                    json.dumps({"record": "bench", "t": time.time(), **out})
+                    + "\n"
+                )
+        except OSError:
+            pass
+        return
 
     # --- TCP baseline.  Subprocess pinned to the CPU backend: the transport
     # itself is pure stdlib, but its schedule/interpolation imports touch
@@ -1384,17 +1651,22 @@ def main() -> None:
         p for p in cpu_env.get("PYTHONPATH", "").split(os.pathsep)
         if p and "axon" not in p
     )
-    tcp_gbps = run_leg(
+    tcp_gbps, tcp_stats = run_leg(
         "--tcp-leg",
         [
             "--tcp-size", str(tcp_d),
             "--tcp-iters", str(args.tcp_iters),
             "--tcp-repeats", str(args.tcp_repeats),
+            "--tcp-warmups", str(args.tcp_warmups),
         ],
-        "TCP_GBPS", args.device_timeout, cpu_env,
+        "TCP_GBPS", args.device_timeout, cpu_env, json_tag="TCP_STATS",
     )
     if tcp_gbps is not None:
-        log(f"TCP baseline: {tcp_gbps:.3f} GB/s/peer")
+        spread = (tcp_stats or {}).get("spread_iqr_frac")
+        log(
+            f"TCP baseline: {tcp_gbps:.3f} GB/s/peer"
+            + (f" (pass spread {spread:.1%})" if spread is not None else "")
+        )
 
     # --- Wire-codec sweep (BENCH_r06): bytes/frame + compression ratio per
     # codec and a prefetch-overlap leg, in the same scrubbed CPU subprocess
@@ -1606,6 +1878,10 @@ def main() -> None:
         "tcp_baseline_gbps": (
             round(tcp_gbps, 3) if tcp_gbps is not None else None
         ),
+        # Pass dispersion of the baseline measurement itself (IQR of
+        # per-pass GB/s over their median): the gate below refuses a
+        # verdict when this wobbles past its tolerance.
+        "tcp_baseline_spread": (tcp_stats or {}).get("spread_iqr_frac"),
     }
     if wire_sweep is not None:
         out["wire_sweep"] = wire_sweep
@@ -1692,7 +1968,10 @@ def main() -> None:
         os.path.dirname(os.path.abspath(__file__)),
         "artifacts", "bench_history.jsonl",
     )
-    out["tcp_gate"] = tcp_gate(read_bench_history(history_path), tcp_gbps)
+    out["tcp_gate"] = tcp_gate(
+        read_bench_history(history_path), tcp_gbps,
+        spread_iqr_frac=(tcp_stats or {}).get("spread_iqr_frac"),
+    )
     if out["tcp_gate"]["verdict"] not in ("ok", "no_data"):
         log(
             f"tcp gate: baseline {out['tcp_gate']['verdict']} "

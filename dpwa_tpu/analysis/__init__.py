@@ -1,6 +1,6 @@
 """dpwalint — the repo's own static-analysis framework.
 
-Five checkers over one shared core (``tools/dpwalint.py`` is the CLI,
+Six checkers over one shared core (``tools/dpwalint.py`` is the CLI,
 ``tests/test_static_checks.py`` the tier-1 gate):
 
 - :mod:`.lock_discipline` — cross-thread ``self._*`` state must be
@@ -12,7 +12,9 @@ Five checkers over one shared core (``tools/dpwalint.py`` is the CLI,
   :mod:`dpwa_tpu.parallel.protocol_constants`;
 - :mod:`.config_keys` — config reads, the schema, and the docs agree;
 - :mod:`.emit_kinds` — JSONL emit sites use registered kinds (the old
-  ``tools/lint_emitters.py`` pass, folded in).
+  ``tools/lint_emitters.py`` pass, folded in);
+- :mod:`.zerocopy` — frame-path modules never copy payload bytes with
+  ``.tobytes()``/``bytes(...)`` (the zero-copy hot-path discipline).
 """
 
 from __future__ import annotations
@@ -33,6 +35,7 @@ from dpwa_tpu.analysis.emit_kinds import EmitKindsChecker
 from dpwa_tpu.analysis.lock_discipline import LockDisciplineChecker
 from dpwa_tpu.analysis.rules import RULE_DESCRIPTIONS, RULE_IDS
 from dpwa_tpu.analysis.wire_protocol import WireProtocolChecker
+from dpwa_tpu.analysis.zerocopy import ZeroCopyChecker
 
 
 def all_checkers():
@@ -43,6 +46,7 @@ def all_checkers():
         WireProtocolChecker(),
         ConfigKeysChecker(),
         EmitKindsChecker(),
+        ZeroCopyChecker(),
     ]
 
 

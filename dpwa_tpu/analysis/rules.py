@@ -59,6 +59,12 @@ RULE_DESCRIPTIONS = {
         "record=/event= emit sites must use kinds registered in "
         "tools/schema_check.py"
     ),
+    # zero-copy frame-path checker
+    "zerocopy-tobytes": (
+        "no .tobytes()/bytes(...) copies on frame-path modules — "
+        "decode and serve through memoryviews/np views, or justify "
+        "the copy with an inline ignore"
+    ),
     # the framework's own hygiene rule
     "dpwalint-annotation": (
         "dpwalint directives must be well-formed, with reasons where "

@@ -758,6 +758,13 @@ class ChaosReactorPeerServer(_ReactorBase):
         if sh is None:
             super()._on_writable(conn)
             return
+        if conn.outsegs is not None:
+            # Shaped writes meter one sliceable buffer by offset, so a
+            # scatter-gather response (base _serve_state under a delay
+            # gate) is coalesced first.  Chaos-only copy: fault
+            # injection is off the zero-copy hot path by design.
+            conn.outbuf = memoryview(b"".join(conn.outsegs))
+            conn.outsegs = None
         buf = conn.outbuf
         if buf is None:
             return

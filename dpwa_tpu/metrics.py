@@ -208,6 +208,9 @@ class MetricsLogger:
           ``overlap_prefetched`` / ``overlap_straddled`` — the wire
           plane's codec accounting and prefetch-overlap view (present
           only when the topk codec or the prefetch pipeline is on);
+        - ``copies_per_frame`` / ``ring_occupancy`` — the zero-copy
+          frame path's decode-copy tally and receive-ring occupancy
+          (ride the wire group when the snapshot carries them);
         - ``disagreement_rms`` / ``disagreement_rel`` / ``sketch_peers``
           — the obs plane's sketch-based ring-disagreement estimate
           (present only when ``obs.sketch`` is on);
@@ -282,6 +285,16 @@ class MetricsLogger:
                 wire_bytes=wire.get("wire_bytes"),
                 compression_ratio=wire.get("compression_ratio"),
             )
+            if wire.get("copies_per_frame") is not None:
+                # Zero-copy columns (docs/transport.md): mean payload-
+                # sized copies per decoded frame (0.0 = views straight
+                # out of the receive ring) and the fraction of ring
+                # bytes currently leased out.
+                extra = dict(
+                    extra,
+                    copies_per_frame=wire.get("copies_per_frame"),
+                    ring_occupancy=wire.get("ring_occupancy"),
+                )
             overlap = wire.get("overlap")
             if overlap is not None:
                 extra = dict(

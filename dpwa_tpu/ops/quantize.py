@@ -228,36 +228,51 @@ def dequantize_np(
 _LEN = np.dtype("<u8")
 
 
+def _le_view(raw: np.ndarray, dtype: str) -> np.ndarray:
+    """Reinterpret a contiguous uint8 slice as little-endian ``dtype``
+    WITHOUT copying (numpy views are fine at unaligned offsets).  On a
+    little-endian host ``np.dtype("<f4")`` IS the native dtype, so the
+    view is the final array; only a big-endian host pays a byte-swapping
+    ``astype`` — the zero-copy decode contract is LE-host-only, which is
+    every deployment target (tests/test_zerocopy.py pins the LE case)."""
+    out = raw.view(dtype)
+    if out.dtype.byteorder == "<" and out.dtype.itemsize > 1:
+        out = out.astype(out.dtype.newbyteorder("="))  # pragma: no cover
+    return out
+
+
 def encode_int8_payload(
     vec: np.ndarray, seed: int, clock: float, sender: int
 ) -> np.ndarray:
     q, scale = quantize_np(vec, seed, clock, sender)
     n = q.shape[0]
-    buf = np.empty(8 + 4 * scale.shape[0] + n, np.uint8)
-    buf[:8] = np.frombuffer(np.uint64(n).tobytes(), np.uint8)
-    buf[8:8 + 4 * scale.shape[0]] = np.frombuffer(
-        scale.astype("<f4").tobytes(), np.uint8
-    )
-    buf[8 + 4 * scale.shape[0]:] = q.view(np.uint8)
+    kb = 4 * scale.shape[0]
+    buf = np.empty(8 + kb + n, np.uint8)
+    buf[:8].view("<u8")[0] = n
+    buf[8:8 + kb].view("<f4")[:] = scale
+    buf[8 + kb:] = q.view(np.uint8)
     return buf
 
 
 def decode_int8_payload(buf: np.ndarray) -> np.ndarray:
     """uint8 payload -> f32[n]; raises ValueError on malformed payloads
-    (callers treat that as a skipped fetch)."""
+    (callers treat that as a skipped fetch).
+
+    Zero-copy discipline: the length/scale fields are read as views
+    straight out of ``buf`` (which may alias a receive-ring buffer); the
+    only payload-sized allocation is the dequantized f32 output itself.
+    """
     raw = np.ascontiguousarray(buf, dtype=np.uint8)
     if raw.size < 8:
         raise ValueError("int8 wire payload shorter than its length field")
-    n = int(np.frombuffer(raw[:8].tobytes(), "<u8")[0])
+    n = int(raw[:8].view("<u8")[0])
     k = _n_chunks(n)
     if raw.size != 8 + 4 * k + n:
         raise ValueError(
             f"int8 wire payload size {raw.size} != {8 + 4 * k + n} "
             f"expected for n={n}"
         )
-    scale = np.frombuffer(raw[8:8 + 4 * k].tobytes(), "<f4").astype(
-        np.float32
-    )
+    scale = _le_view(raw[8:8 + 4 * k], "<f4")
     q = raw[8 + 4 * k:].view(np.int8)
     return dequantize_np(q, scale)
 
@@ -409,24 +424,29 @@ class TopkEncoder:
             q, scale = quantize_np(vals, seed, clock, sender)
             shipped = dequantize_np(q, scale)
             code = TOPK_VALUE_INT8
-            vblock = np.concatenate([
-                np.frombuffer(scale.astype("<f4").tobytes(), np.uint8),
-                q.view(np.uint8),
-            ])
+            sb = 4 * scale.shape[0]
+            vb = sb + k
         else:
+            q = scale = None
+            sb = 0
             shipped = vals
             code = TOPK_VALUE_F32
-            vblock = np.frombuffer(vals.astype("<f4").tobytes(), np.uint8)
+            vb = 4 * k
         self.base[idx] = shipped
-        head = np.empty(13, np.uint8)
-        head[:8] = np.frombuffer(np.uint64(n).tobytes(), np.uint8)
-        head[8:12] = np.frombuffer(np.uint32(k).tobytes(), np.uint8)
-        head[12] = code
-        return np.concatenate([
-            head,
-            np.frombuffer(idx.astype("<u4").tobytes(), np.uint8),
-            vblock,
-        ])
+        # One preallocated buffer, header and blocks written through
+        # views — no per-section tobytes round-trips, no concatenate.
+        buf = np.empty(13 + 4 * k + vb, np.uint8)
+        buf[:8].view("<u8")[0] = n
+        buf[8:12].view("<u4")[0] = k
+        buf[12] = code
+        buf[13:13 + 4 * k].view("<u4")[:] = idx
+        vstart = 13 + 4 * k
+        if code == TOPK_VALUE_INT8:
+            buf[vstart:vstart + sb].view("<f4")[:] = scale
+            buf[vstart + sb:] = q.view(np.uint8)
+        else:
+            buf[vstart:].view("<f4")[:] = vals
+        return buf
 
 
 def decode_topk_payload(buf: np.ndarray) -> TopkPayload:
@@ -437,8 +457,8 @@ def decode_topk_payload(buf: np.ndarray) -> TopkPayload:
     raw = np.ascontiguousarray(buf, dtype=np.uint8)
     if raw.size < 13:
         raise ValueError("top-k wire payload shorter than its header")
-    n = int(np.frombuffer(raw[:8].tobytes(), "<u8")[0])
-    k = int(np.frombuffer(raw[8:12].tobytes(), "<u4")[0])
+    n = int(raw[:8].view("<u8")[0])
+    k = int(raw[8:12].view("<u4")[0])
     code = int(raw[12])
     if n < 1 or k < 1:
         raise ValueError(f"top-k wire payload with n={n}, k={k}")
@@ -453,9 +473,7 @@ def decode_topk_payload(buf: np.ndarray) -> TopkPayload:
             f"top-k wire payload size {raw.size} != {expect} expected "
             f"for n={n}, k={k}, value_code={code}"
         )
-    idx = np.frombuffer(raw[13:13 + 4 * k].tobytes(), "<u4").astype(
-        np.uint32
-    )
+    idx = _le_view(raw[13:13 + 4 * k], "<u4")
     if int(idx[-1]) >= n:
         raise ValueError(
             f"top-k wire payload index {int(idx[-1])} out of range for "
@@ -467,13 +485,14 @@ def decode_topk_payload(buf: np.ndarray) -> TopkPayload:
         )
     body = raw[13 + 4 * k:]
     if code == TOPK_VALUE_F32:
-        vals = np.frombuffer(body.tobytes(), "<f4").astype(np.float32)
+        # Values stay a VIEW into the receive buffer — the ownership
+        # contract (docs/transport.md) is that the buffer's lease was
+        # detached before these views escape.
+        vals = _le_view(body, "<f4")
         vdtype = "f32"
     else:
         kc = _n_chunks(k)
-        scale = np.frombuffer(body[:4 * kc].tobytes(), "<f4").astype(
-            np.float32
-        )
+        scale = _le_view(body[:4 * kc], "<f4")
         vals = dequantize_np(body[4 * kc:].view(np.int8), scale)
         vdtype = "int8"
     return TopkPayload(n, idx, vals, value_dtype=vdtype, nbytes=raw.size)

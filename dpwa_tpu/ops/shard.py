@@ -37,6 +37,7 @@ import numpy as np
 
 from dpwa_tpu.ops.quantize import (
     TopkPayload,
+    _le_view,
     decode_int8_payload,
     decode_topk_payload,
 )
@@ -140,12 +141,13 @@ def encode_shard_payload(
         raise ValueError(f"shard inner_code {inner_code} not shippable")
     lo, hi = shard_bounds(d, k, shard_idx)  # validates k / shard_idx
     del lo, hi
-    head = np.frombuffer(
-        _HDR.pack(int(shard_idx), int(k), int(d), int(inner_code)),
-        np.uint8,
-    )
     body = np.ascontiguousarray(inner_payload, dtype=np.uint8).reshape(-1)
-    return np.concatenate([head, body])
+    out = np.empty(_HDR.size + body.size, np.uint8)
+    _HDR.pack_into(
+        out, 0, int(shard_idx), int(k), int(d), int(inner_code)
+    )
+    out[_HDR.size:] = body
+    return out
 
 
 def decode_shard_payload(buf: np.ndarray) -> ShardPayload:
@@ -159,9 +161,7 @@ def decode_shard_payload(buf: np.ndarray) -> ShardPayload:
     raw = np.ascontiguousarray(buf, dtype=np.uint8)
     if raw.size < _HDR.size:
         raise ValueError("shard wire payload shorter than its preamble")
-    shard_idx, k, d, inner_code = _HDR.unpack(
-        raw[: _HDR.size].tobytes()
-    )
+    shard_idx, k, d, inner_code = _HDR.unpack_from(raw, 0)
     if k < 1:
         raise ValueError(f"shard wire payload with k={k}")
     if shard_idx >= k:
@@ -180,9 +180,9 @@ def decode_shard_payload(buf: np.ndarray) -> ShardPayload:
                 f"shard f32 body is {body.size} bytes; {4 * m} expected "
                 f"for slice length {m}"
             )
-        inner: Union[np.ndarray, TopkPayload] = np.frombuffer(
-            body.tobytes(), "<f4"
-        ).astype(np.float32)
+        # A VIEW into the receive buffer (lease-detach contract,
+        # docs/transport.md) — the merge reads it once and never mutates.
+        inner: Union[np.ndarray, TopkPayload] = _le_view(body, "<f4")
     elif inner_code == _pc.PAYLOAD_BF16:
         if ml_dtypes is None:  # pragma: no cover - jax dependency
             raise ValueError("bf16 shard payload requires ml_dtypes")
@@ -191,9 +191,10 @@ def decode_shard_payload(buf: np.ndarray) -> ShardPayload:
                 f"shard bf16 body is {body.size} bytes; {2 * m} expected "
                 f"for slice length {m}"
             )
+        # The astype is the required bf16 -> f32 upcast (the one copy a
+        # bf16 frame pays); the view itself costs nothing.
         inner = (
-            np.frombuffer(body.tobytes(), dtype=np.dtype(ml_dtypes.bfloat16))
-            .astype(np.float32)
+            body.view(np.dtype(ml_dtypes.bfloat16)).astype(np.float32)
         )
     elif inner_code == _pc.PAYLOAD_INT8_CHUNKED:
         inner = decode_int8_payload(body)

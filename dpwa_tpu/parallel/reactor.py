@@ -42,9 +42,16 @@ probes (``DPWA!`` asks us to synchronously probe a third peer, up to
 completions post back through a queue and a self-pipe wakeup.  That is
 O(1) threads regardless of ring size, vs O(connections) threaded.
 
-The eventual zero-copy landing zone is ``native/rx_server.cpp`` — the
-same reactor shape with the GIL out of the serve path entirely (see
-docs/transport.md).
+Data movement is the pure-Python zero-copy pass shipped with the frame
+hot path (docs/transport.md "The zero-copy landing zone"): reads land
+via ``recv_into`` on one preallocated loop-thread scratch buffer, the
+decode loop parses requests in place (``startswith`` / ``unpack_from``
+against the connection buffer, no per-request ``bytes`` copies), and
+blob/state responses go out as segment lists — header and payload are
+never concatenated; the writable callback walks them with a
+non-blocking ``sendmsg``.  The eventual native landing zone is
+``native/rx_server.cpp`` — the same reactor shape with the GIL out of
+the serve path entirely.
 """
 
 from __future__ import annotations
@@ -69,6 +76,7 @@ from dpwa_tpu.health.detector import Outcome
 # into protocol_constants; reusing them (never re-deriving) is what
 # makes "byte-for-byte identical responses" true by construction.
 from dpwa_tpu.parallel import tcp as _tcp
+from dpwa_tpu.parallel import ingest as _ingest
 
 # Connection phases (strings, compared by identity in the hot loop).
 _PH_REQ = "req"
@@ -95,9 +103,9 @@ class _Conn:
 
     __slots__ = (
         "sock", "host", "admitted", "phase", "inbuf", "need", "outbuf",
-        "sent", "base_deadline", "deadline", "per_byte", "ingested",
-        "write_timeout", "reserved", "is_blob", "trace_id", "t0",
-        "relay", "seq", "slot", "closed",
+        "outsegs", "sent", "base_deadline", "deadline", "per_byte",
+        "ingested", "write_timeout", "reserved", "is_blob", "trace_id",
+        "t0", "relay", "seq", "slot", "closed",
     )
 
     def __init__(self, sock: socket.socket, host: str, admitted: bool):
@@ -107,7 +115,12 @@ class _Conn:
         self.phase = _PH_REQ
         self.inbuf = bytearray()
         self.need = len(_tcp._REQ)
+        # Exactly one response representation is active at a time:
+        # ``outbuf`` for single-buffer replies (busy, relay, chaos),
+        # ``outsegs`` for scatter-gather blob/state serves — a list of
+        # memoryviews the writable callback advances in place.
         self.outbuf: Optional[memoryview] = None
+        self.outsegs: Optional[List[memoryview]] = None
         self.sent = 0
         self.base_deadline = 0.0
         self.deadline = 0.0
@@ -200,7 +213,10 @@ class ReactorPeerServer:
         flowctl: Optional[FlowctlConfig] = None,
     ):
         self._lock = threading.Lock()
-        self._payload: Optional[bytes] = None  # pre-framed header+data
+        # Pre-framed (header, payload[, digest][, obs]) segment tuple;
+        # the _payload property joins them for chaos/test readers.
+        self._segments: Optional[Tuple[bytes, ...]] = None
+        self._payload_nbytes = 0
         self._payload_trace_id: Optional[str] = None
         self._state: Optional[bytes] = None
         self._state_gen = 0
@@ -241,6 +257,11 @@ class ReactorPeerServer:
         self._relay_done: queue.SimpleQueue = queue.SimpleQueue()
         self._relay_pending: Dict[int, _Conn] = {}  # loop thread only
         self._relay_seq = itertools.count(1)
+        # Loop-thread-only receive scratch: every readable callback
+        # recv_intos here, so the read path allocates nothing per chunk
+        # (requests are tiny; the bytes that matter leave via inbuf).
+        self._scratch = bytearray(_RECV_CHUNK)
+        self._scratch_view = memoryview(self._scratch)
         self._wheel = _TimerWheel()
         self._stats_lock = threading.Lock()
         self._stats = {
@@ -280,13 +301,24 @@ class ReactorPeerServer:
         obs: Optional[bytes] = None,
         trace_id: Optional[str] = None,
     ) -> None:
-        payload = _tcp._frame(vec, clock, loss, code, digest, obs)
+        segments = _tcp._frame_segments(vec, clock, loss, code, digest, obs)
         with self._lock:
-            self._payload = payload
+            self._segments = segments
+            self._payload_nbytes = sum(len(s) for s in segments)
             self._payload_trace_id = trace_id
+
+    @property
+    def _payload(self) -> Optional[bytes]:
+        """The published frame as one bytes object — back-compat for
+        chaos wrappers and tests that inspect the served frame.  Reads
+        the segments tuple atomically; deliberately lock-free so chaos
+        callers already holding ``_lock`` can use it."""
+        segs = self._segments
+        return b"".join(segs) if segs is not None else None
 
     def publish_state(self, blob: bytes) -> None:
         with self._lock:
+            # dpwalint: ignore[zerocopy-tobytes] -- publish-time snapshot: served views must outlive the caller's buffer
             self._state = bytes(blob)
             self._state_gen = (self._state_gen + 1) & 0xFFFFFFFF
 
@@ -471,13 +503,13 @@ class ReactorPeerServer:
 
     def _on_readable(self, conn: _Conn) -> None:
         try:
-            data = conn.sock.recv(_RECV_CHUNK)
+            got = conn.sock.recv_into(self._scratch)
         except (BlockingIOError, InterruptedError):
             return
         except OSError:
             self._close_conn(conn)
             return
-        if not data:
+        if not got:
             # EOF: mid-request it is the client abandoning us; during
             # RELAY_WAIT it means nobody is left to answer.
             self._close_conn(conn)
@@ -486,8 +518,8 @@ class ReactorPeerServer:
             # Bytes past the request are ignored, exactly like the
             # threaded handler that simply never reads them.
             return
-        conn.ingested += len(data)
-        conn.inbuf += data
+        conn.ingested += got
+        conn.inbuf += self._scratch_view[:got]
         now = time.monotonic()
         if conn.per_byte > 0.0 and conn.phase in _INGEST_PHASES:
             # Slow-loris discipline (flowctl): cumulative deadline
@@ -505,18 +537,27 @@ class ReactorPeerServer:
     def _advance(self, conn: _Conn, now: float) -> None:
         """Run the decode pipeline as far as the buffered bytes allow
         (plane hook #2: frame grammar decode + dispatch)."""
+        # Requests parse IN PLACE against the connection buffer
+        # (startswith prefix compares, struct.unpack_from at offset 0)
+        # before the consumed bytes are deleted — no per-request
+        # ``bytes`` copies, and no memoryview may be held across the
+        # ``del`` (a live exported view makes bytearray resize raise).
         while not conn.closed and len(conn.inbuf) >= conn.need:
             if conn.phase == _PH_REQ:
-                req = bytes(conn.inbuf[: conn.need])
-                del conn.inbuf[: conn.need]
-                if req == _tcp._REQ:
+                # The three request magics share one length, so the
+                # prefix compare over ``need`` bytes IS the equality
+                # compare the threaded handler does.
+                if conn.inbuf.startswith(_tcp._REQ):
+                    del conn.inbuf[: conn.need]
                     self._serve_blob(conn, now)
                     return
-                if req == _tcp._STATE_REQ:
+                if conn.inbuf.startswith(_tcp._STATE_REQ):
+                    del conn.inbuf[: conn.need]
                     conn.phase = _PH_STATE_BODY
                     conn.need = _tcp._STATE_REQ_BODY.size
                     continue
-                if req == _tcp._RELAY_REQ:
+                if conn.inbuf.startswith(_tcp._RELAY_REQ):
+                    del conn.inbuf[: conn.need]
                     conn.phase = _PH_RELAY_BODY
                     conn.need = _tcp._RELAY_BODY.size
                     continue
@@ -524,17 +565,17 @@ class ReactorPeerServer:
                 self._close_conn(conn)
                 return
             if conn.phase == _PH_STATE_BODY:
-                body = bytes(conn.inbuf[: conn.need])
+                offset, max_chunk = _tcp._STATE_REQ_BODY.unpack_from(
+                    conn.inbuf, 0
+                )
                 del conn.inbuf[: conn.need]
-                offset, max_chunk = _tcp._STATE_REQ_BODY.unpack(body)
                 self._serve_state(conn, offset, max_chunk, now)
                 return
             if conn.phase == _PH_RELAY_BODY:
-                body = bytes(conn.inbuf[: conn.need])
-                del conn.inbuf[: conn.need]
                 target, port, timeout_ms, hostlen = (
-                    _tcp._RELAY_BODY.unpack(body)
+                    _tcp._RELAY_BODY.unpack_from(conn.inbuf, 0)
                 )
+                del conn.inbuf[: conn.need]
                 conn.relay = (int(target), int(port), int(timeout_ms))
                 if hostlen:
                     conn.phase = _PH_RELAY_HOST
@@ -543,11 +584,9 @@ class ReactorPeerServer:
                 self._start_relay(conn, "127.0.0.1", now)
                 return
             if conn.phase == _PH_RELAY_HOST:
-                raw = bytes(conn.inbuf[: conn.need])
+                host = conn.inbuf[: conn.need].decode("ascii", "replace")
                 del conn.inbuf[: conn.need]
-                self._start_relay(
-                    conn, raw.decode("ascii", "replace"), now
-                )
+                self._start_relay(conn, host, now)
                 return
             return
 
@@ -558,20 +597,21 @@ class ReactorPeerServer:
         digest + DPWT obs trailers, baked in at publish time — plane
         hooks #3/#4 ride the buffer) under the in-flight ceiling."""
         with self._lock:
-            payload = self._payload
+            segments = self._segments
+            nbytes = self._payload_nbytes
             trace_id = self._payload_trace_id
-        if payload is None:
+        if segments is None:
             self._close_conn(conn)  # nothing published yet: clean EOF
             return
         adm = self.admission
-        if adm is not None and not adm.reserve_bytes(len(payload)):
+        if adm is not None and not adm.reserve_bytes(nbytes):
             self._queue_busy(conn, self.flowctl.busy_retry_ms, now)
             return
-        conn.reserved = len(payload)
+        conn.reserved = nbytes
         conn.is_blob = True
         conn.trace_id = trace_id
         conn.t0 = now
-        self._queue_write(conn, payload, now)
+        self._queue_segments(conn, segments, now)
 
     def _queue_busy(self, conn: _Conn, retry_ms: int, now: float) -> None:
         with self._stats_lock:
@@ -589,12 +629,15 @@ class ReactorPeerServer:
         total = len(blob)
         off = min(max(offset, 0), total)
         n = min(max(max_chunk, 0), total - off, _tcp._MAX_STATE_CHUNK)
-        chunk = blob[off : off + n]
+        # A view of the published blob, never a slice copy: the blob is
+        # immutable bytes and a republish swaps the OBJECT, so the view
+        # stays valid for the life of this response.
+        chunk = memoryview(blob)[off : off + n]
         header = _tcp._STATE_HDR.pack(
             _tcp._STATE_MAGIC, 1, gen, total, off, len(chunk),
             zlib.crc32(chunk),
         )
-        self._queue_write(conn, header + chunk, now)
+        self._queue_segments(conn, (header, chunk), now)
 
     # --- relay probes (the one blocking verb, offloaded) ---
 
@@ -671,8 +714,23 @@ class ReactorPeerServer:
     # --- buffered writes ---
 
     def _queue_write(self, conn: _Conn, data: bytes, now: float) -> None:
-        conn.phase = _PH_WRITE
         conn.outbuf = memoryview(data)
+        conn.outsegs = None
+        self._arm_write(conn, now)
+
+    def _queue_segments(
+        self, conn: _Conn, segments, now: float
+    ) -> None:
+        """Scatter-gather response: the segments go out as-is (header,
+        payload, trailers), never concatenated into a scratch buffer."""
+        conn.outbuf = None
+        conn.outsegs = [
+            memoryview(s).cast("B") for s in segments if len(s)
+        ]
+        self._arm_write(conn, now)
+
+    def _arm_write(self, conn: _Conn, now: float) -> None:
+        conn.phase = _PH_WRITE
         conn.sent = 0
         conn.deadline = now + conn.write_timeout
         self._wheel.file(conn)
@@ -684,6 +742,9 @@ class ReactorPeerServer:
         self._on_writable(conn)  # short responses finish in one call
 
     def _on_writable(self, conn: _Conn) -> None:
+        if conn.outsegs is not None:
+            self._write_segments(conn)
+            return
         buf = conn.outbuf
         if buf is None:
             return
@@ -712,6 +773,53 @@ class ReactorPeerServer:
         if progressed:
             # A draining peer keeps its connection; a stalled one hits
             # the unrefreshed deadline on the wheel.
+            conn.deadline = time.monotonic() + conn.write_timeout
+
+    def _write_segments(self, conn: _Conn) -> None:
+        """Drain ``conn.outsegs`` with non-blocking ``sendmsg``: one
+        syscall covers every remaining segment; partial sends advance
+        the view list in place (fully-sent heads pop, a split head is
+        sliced).  Falls back to plain ``send`` of the head segment
+        where ``sendmsg`` is unavailable or refused."""
+        segs = conn.outsegs
+        sendmsg = getattr(conn.sock, "sendmsg", None)
+        progressed = False
+        while segs:
+            try:
+                if sendmsg is not None:
+                    n = sendmsg(segs)
+                else:
+                    n = conn.sock.send(segs[0])
+            except (BlockingIOError, InterruptedError):
+                break
+            except OSError as exc:
+                if (
+                    sendmsg is not None
+                    and exc.errno in _ingest._SENDMSG_UNSUPPORTED
+                ):
+                    sendmsg = None
+                    continue
+                self._close_conn(conn)
+                return
+            if n <= 0:
+                break
+            conn.sent += n
+            progressed = True
+            while n > 0 and segs:
+                head = segs[0]
+                if n >= len(head):
+                    n -= len(head)
+                    segs.pop(0)
+                else:
+                    segs[0] = head[n:]
+                    n = 0
+        if not segs:
+            if conn.is_blob:
+                with self._stats_lock:
+                    self._stats["frames"] += 1
+            self._close_conn(conn)
+            return
+        if progressed:
             conn.deadline = time.monotonic() + conn.write_timeout
 
     # --- deadlines + teardown ---

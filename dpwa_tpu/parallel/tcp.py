@@ -58,6 +58,10 @@ from dpwa_tpu.parallel.schedules import Schedule, build_schedule
 # The old underscored names are kept as module-level aliases because
 # chaos/recovery/test code imports them from here.
 from dpwa_tpu.parallel import protocol_constants as _pc
+# Zero-copy data movement: the shared recv_into loop, the receive-buffer
+# ring every fetch leases from, and scatter-gather sends
+# (docs/transport.md "The zero-copy landing zone").
+from dpwa_tpu.parallel import ingest as _ingest
 
 # Gossip blob wire: request is the 5-byte magic; response is
 # BLOB_HDR (magic version dtype clock loss nbytes) + nbytes of payload.
@@ -84,6 +88,15 @@ _TOPK_DELTA = _pc.PAYLOAD_TOPK_DELTA
 _SHARD = _pc.PAYLOAD_SHARD
 _PAYLOAD_CODES = _pc.CODEC_PAYLOAD_CODES
 _MAX_BLOB = _pc.MAX_BLOB_BYTES
+
+# Probe-before-commit bound for the payload ring lease: advertisements
+# above the threshold read a probe's worth of real bytes before the
+# full-size buffer is allocated, so a peer that lies about nbytes and
+# hangs up costs a 64 KiB lease, not a multi-GB upfront allocation
+# (the old grow-by-chunk loop had the same received-bytes-proportional
+# property; _MAX_BLOB alone is a 16 GiB bound).
+_PROBE_THRESHOLD = 1 << 20
+_PROBE_BYTES = 1 << 16
 
 # STATE transfer wire (crash recovery, dpwa_tpu/recovery/): a restarted
 # worker bootstraps a donor's full serialized train state over the same
@@ -153,8 +166,11 @@ def _recv_exact(
     deadline: Optional[float] = None,
     per_byte_s: float = 0.0,
     progress: Optional[list] = None,
-) -> bytes:
-    """Read exactly ``n`` bytes.
+    out: Optional[bytearray] = None,
+) -> memoryview:
+    """Read exactly ``n`` bytes (thin wrapper over
+    :func:`dpwa_tpu.parallel.ingest.recv_exact_into` — the one buffered
+    read loop both the gossip fetch and the state transfer share).
 
     With ``deadline`` (a ``time.monotonic`` instant) the WHOLE read must
     finish by that wall-clock point: the socket timeout is re-derived from
@@ -173,35 +189,31 @@ def _recv_exact(
     received across a SEQUENCE of reads, surviving the timeout this
     function raises — the caller's classifier uses it to tell a peer
     that streamed something and lapsed (``slow``) from one that never
-    answered at all (``timeout``)."""
-    buf = bytearray()
-    while len(buf) < n:
-        if deadline is not None:
-            remaining = (
-                deadline + len(buf) * per_byte_s - time.monotonic()
-            )
-            if remaining <= 0:
-                raise socket.timeout("cumulative fetch deadline exceeded")
-            sock.settimeout(remaining)
-        chunk = sock.recv(min(1 << 20, n - len(buf)))
-        if not chunk:
-            raise ConnectionError("peer closed mid-message")
-        buf += chunk
-        if progress is not None:
-            progress[0] += len(chunk)
-    return bytes(buf)
+    answered at all (``timeout``).
+
+    ``out`` is an optional destination buffer (bytearray or writable
+    memoryview, at least ``n`` bytes); the bytes land there via
+    ``recv_into`` and the returned memoryview aliases it — the zero-copy
+    ingest path (a fresh bytearray is allocated when omitted).  Returns
+    a memoryview, which compares equal to ``bytes`` by content; callers
+    needing an owning copy take ``bytes(view)`` explicitly."""
+    return _ingest.recv_exact_into(sock, n, deadline, per_byte_s, progress, out)
 
 
-def _frame(
+def _frame_segments(
     vec: np.ndarray,
     clock: float,
     loss: float,
     code: Optional[int] = None,
     digest: Optional[bytes] = None,
     obs: Optional[bytes] = None,
-) -> bytes:
-    """Header + raw vector bytes — the one definition of the wire format,
-    shared by the Python and native Rx servers.
+) -> Tuple[bytes, ...]:
+    """The wire frame as ordered segments ``(header, payload[, digest]
+    [, obs])`` — the one definition of the wire format, shared by the
+    Python and native Rx servers.  Serve paths send the tuple via
+    scatter-gather (:func:`ingest.sendall_segments`) so the header is
+    never concatenated onto a multi-MB payload; :func:`_frame` joins it
+    for consumers that need one contiguous byte string.
 
     ``code`` overrides the dtype byte for structured payloads
     (``_INT8_CHUNKED``: ``vec`` is the already-encoded uint8 buffer).
@@ -236,11 +248,33 @@ def _frame(
         if code is None:
             vec = vec.astype("<f4")
             code = _DTYPE_CODES[np.dtype("<f4")]
-    data = vec.tobytes()
+    # The one deliberate copy on the publish path: the frame must
+    # snapshot the replica — the training thread mutates ``vec`` right
+    # after publish, and serving a live view would tear frames mid-send.
+    data = vec.tobytes()  # dpwalint: ignore[zerocopy-tobytes] -- publish-time snapshot; serving a view of the live replica would tear frames
     header = _HDR.pack(_MAGIC, 1, code, float(clock), float(loss), len(data))
     if digest or obs:
-        return header + data + (digest or b"") + (obs or b"")
-    return header + data
+        segs = [header, data]
+        if digest:
+            segs.append(digest)
+        if obs:
+            segs.append(obs)
+        return tuple(segs)
+    return (header, data)
+
+
+def _frame(
+    vec: np.ndarray,
+    clock: float,
+    loss: float,
+    code: Optional[int] = None,
+    digest: Optional[bytes] = None,
+    obs: Optional[bytes] = None,
+) -> bytes:
+    """:func:`_frame_segments` joined into one contiguous byte string —
+    for the native server's ``publish_framed``, the chaos mutators, and
+    golden-frame tests."""
+    return b"".join(_frame_segments(vec, clock, loss, code, digest, obs))
 
 
 class PeerServer:
@@ -271,7 +305,10 @@ class PeerServer:
         flowctl: Optional[FlowctlConfig] = None,
     ):
         self._lock = threading.Lock()
-        self._payload: Optional[bytes] = None  # pre-framed header+data
+        # Pre-framed (header, payload[, digest][, obs]) segments; served
+        # via scatter-gather so publish never joins them into one blob.
+        self._segments: Optional[Tuple[bytes, ...]] = None
+        self._payload_nbytes = 0
         self._payload_trace_id: Optional[str] = None
         self._state: Optional[bytes] = None  # serialized bootstrap state
         self._state_gen = 0
@@ -306,10 +343,20 @@ class PeerServer:
         obs: Optional[bytes] = None,
         trace_id: Optional[str] = None,
     ) -> None:
-        payload = _frame(vec, clock, loss, code, digest, obs)
+        segments = _frame_segments(vec, clock, loss, code, digest, obs)
         with self._lock:
-            self._payload = payload
+            self._segments = segments
+            self._payload_nbytes = sum(len(s) for s in segments)
             self._payload_trace_id = trace_id
+
+    @property
+    def _payload(self) -> Optional[bytes]:
+        """The published frame as one contiguous byte string — the
+        pre-segment representation, kept for the chaos harness and
+        tests.  Lock-free: a single attribute read of the segments tuple
+        is atomic, and the tuple itself is immutable."""
+        segs = self._segments
+        return b"".join(segs) if segs is not None else None
 
     def publish_state(self, blob: bytes) -> None:
         """Expose a serialized train state for peer-assisted bootstrap.
@@ -319,6 +366,7 @@ class PeerServer:
         bumps the generation, so an in-flight transfer against the old
         blob restarts instead of splicing."""
         with self._lock:
+            # dpwalint: ignore[zerocopy-tobytes] -- publish-time snapshot: served views must outlive the caller's buffer
             self._state = bytes(blob)
             self._state_gen = (self._state_gen + 1) & 0xFFFFFFFF
 
@@ -431,14 +479,19 @@ class PeerServer:
         self._serve_blob(conn)
 
     def _serve_blob(self, conn: socket.socket) -> None:
-        """Send the published frame under the in-flight-bytes ceiling."""
+        """Send the published frame under the in-flight-bytes ceiling.
+
+        Scatter-gather: the (header, payload, trailers) segments go out
+        via one ``sendmsg`` instead of being concatenated first — the
+        serve path never allocates payload-sized scratch."""
         with self._lock:
-            payload = self._payload
+            segments = self._segments
+            nbytes = self._payload_nbytes
             trace_id = self._payload_trace_id
-        if payload is None:
+        if segments is None:
             return
         adm = self.admission
-        if adm is not None and not adm.reserve_bytes(len(payload)):
+        if adm is not None and not adm.reserve_bytes(nbytes):
             # Ceiling crossed: shed this send explicitly rather than
             # queue unbounded payload bytes behind slow readers.
             try:
@@ -449,13 +502,13 @@ class PeerServer:
         hook = self.obs_serve_hook
         t0 = time.monotonic() if hook is not None else 0.0
         try:
-            conn.sendall(payload)
+            _ingest.sendall_segments(conn, segments)
         finally:
             if adm is not None:
-                adm.release_bytes(len(payload))
+                adm.release_bytes(nbytes)
             if hook is not None and trace_id is not None:
                 try:
-                    hook(trace_id, len(payload), time.monotonic() - t0)
+                    hook(trace_id, nbytes, time.monotonic() - t0)
                 except Exception:
                     pass  # observability must never break a serve
 
@@ -469,7 +522,7 @@ class PeerServer:
         body = _recv_exact(conn, _RELAY_BODY.size)
         target, port, timeout_ms, hostlen = _RELAY_BODY.unpack(body)
         host = (
-            _recv_exact(conn, hostlen).decode("ascii", "replace")
+            str(_recv_exact(conn, hostlen), "ascii", "replace")
             if hostlen
             else "127.0.0.1"
         )
@@ -502,11 +555,14 @@ class PeerServer:
         total = len(blob)
         off = min(max(offset, 0), total)
         n = min(max(max_chunk, 0), total - off, _MAX_STATE_CHUNK)
-        chunk = blob[off : off + n]
+        # A VIEW of the published blob, not a slice copy: ``blob`` is an
+        # immutable bytes object and a re-publish replaces the object,
+        # so the view stays valid for the duration of the send.
+        chunk = memoryview(blob)[off : off + n]
         header = _STATE_HDR.pack(
             _STATE_MAGIC, 1, gen, total, off, len(chunk), zlib.crc32(chunk)
         )
-        conn.sendall(header + chunk)
+        _ingest.sendall_segments(conn, (header, chunk))
 
     def close(self) -> None:
         self._stop.set()
@@ -580,7 +636,7 @@ def make_peer_server(
 
 def _recv_trailing(
     sock: socket.socket, n: int, deadline: float
-) -> Optional[bytes]:
+) -> Optional[memoryview]:
     """Best-effort exact read for an OPTIONAL trailing section.
 
     Returns None — never raises — on timeout/EOF/reset: a peer that
@@ -618,7 +674,9 @@ def _read_digest_trailer(
     body = _recv_trailing(sock, nbytes, deadline)
     if body is None:
         return None
-    return head + body
+    # join, not +: _recv_trailing hands back memoryviews now, and the
+    # digest contract returns owning bytes (tiny — ~11 B/peer).
+    return b"".join((head, body))
 
 
 def _read_trailers(
@@ -665,24 +723,26 @@ def _read_trailers(
             rest = _recv_trailing(sock, HEADER_SIZE - 4, deadline)
             if rest is None:
                 break
-            nbytes = header_entries_nbytes(magic + rest)
+            head = b"".join((magic, rest))
+            nbytes = header_entries_nbytes(head)
             if nbytes is None:
                 break
             body = _recv_trailing(sock, nbytes, deadline)
             if body is None:
                 break
-            digest = magic + rest + body
+            digest = b"".join((head, body))
         elif magic == OBS_MAGIC and obs is None:
             rest = _recv_trailing(sock, OBS_HEADER_SIZE - 4, deadline)
             if rest is None:
                 break
-            n = header_sketch_count(magic + rest)
+            head = b"".join((magic, rest))
+            n = header_sketch_count(head)
             if n is None:
                 break
             body = _recv_trailing(sock, values_size(n), deadline)
             if body is None:
                 break
-            obs = magic + rest + body
+            obs = b"".join((head, body))
         else:
             break
     return (digest if want_digest else None, obs if want_obs else None)
@@ -696,6 +756,7 @@ def fetch_blob_full(
     want_digest: bool = False,
     sock_box: Optional[list] = None,
     want_obs: bool = False,
+    lease_box: Optional[list] = None,
 ) -> Tuple[
     Optional[Tuple[np.ndarray, float, float]], str, float, int,
     Optional[bytes], Optional[bytes],
@@ -730,6 +791,15 @@ def fetch_blob_full(
     to cancel the losing leg promptly instead of waiting out its
     deadline.
 
+    ``lease_box`` (a plain list) opts into explicit receive-buffer
+    ownership: the payload's ring :class:`~dpwa_tpu.parallel.ingest
+    .Lease` is appended on success and the CALLER must ``release()`` it
+    once every view of the decoded vector is dead — the allocation-flat
+    steady state (the bench and the tracemalloc tier-1 test drive this).
+    Without it, leases whose decode produced escaping views (dense /
+    top-k / shard) are detached — correct but unpooled — and fully
+    consumed payloads (int8) are released here.
+
     ``timeout_ms`` is a CUMULATIVE wall-clock budget enforced via a
     monotonic deadline threaded through :func:`_recv_exact` — not a
     per-recv timer a trickling peer could keep resetting.  It covers
@@ -747,6 +817,9 @@ def fetch_blob_full(
     # timeout: >0 at deadline lapse means the peer was STREAMING, which
     # classifies as ``slow`` (soft evidence) rather than ``timeout``.
     rx = [0]
+    # The payload's ring lease, once taken: every non-success exit must
+    # release it back to the ring (the except arms below do).
+    lease = None
     try:
         sock = socket.create_connection(
             (host, port), timeout=timeout_ms / 1000.0
@@ -775,23 +848,30 @@ def fetch_blob_full(
             sock.sendall(_REQ)
             # Magic peek: 4 bytes decide DPWB (busy shed) vs DPWA (blob
             # header).  An old server never sends DPWB, so the peek is
-            # just the header's first read split in two.
-            peek = _recv_exact(sock, 4, deadline, progress=rx)
+            # just the header's first read split in two — both halves
+            # land in ONE scratch buffer so the header is never
+            # reassembled by concatenation.
+            hdr_buf = bytearray(max(_HDR.size, _BUSY_HDR.size))
+            peek = _recv_exact(sock, 4, deadline, progress=rx, out=hdr_buf)
             if peek == _BUSY_MAGIC:
-                rest = _recv_exact(
-                    sock, _BUSY_HDR.size - 4, deadline, progress=rx
+                _recv_exact(
+                    sock, _BUSY_HDR.size - 4, deadline, progress=rx,
+                    out=memoryview(hdr_buf)[4:],
                 )
-                _m, bversion, _retry_ms = _BUSY_HDR.unpack(peek + rest)
+                _m, bversion, _retry_ms = _BUSY_HDR.unpack_from(hdr_buf, 0)
                 if bversion != 1:
                     return (
                         None, Outcome.CORRUPT, time.monotonic() - t0, 0,
                         None, None,
                     )
                 return None, Outcome.BUSY, time.monotonic() - t0, 0, None, None
-            raw = peek + _recv_exact(
-                sock, _HDR.size - 4, deadline, progress=rx
+            _recv_exact(
+                sock, _HDR.size - 4, deadline, progress=rx,
+                out=memoryview(hdr_buf)[4:],
             )
-            magic, version, code, clock, loss, nbytes = _HDR.unpack(raw)
+            magic, version, code, clock, loss, nbytes = _HDR.unpack_from(
+                hdr_buf, 0
+            )
             if magic != _MAGIC or version != 1 or (
                 code not in _DTYPES and code not in _PAYLOAD_CODES
             ):
@@ -804,11 +884,52 @@ def fetch_blob_full(
                     None, Outcome.CORRUPT, time.monotonic() - t0, 0, None,
                     None,
                 )
+            # Payload lands straight in a ring buffer via recv_into —
+            # no chunk-grow bytearray, no final bytes() copy.  For a
+            # large advertisement the full-size lease is deferred behind
+            # a small probe read: the old grow-by-chunk loop only ever
+            # allocated in proportion to bytes actually RECEIVED, so a
+            # peer that advertises gigabytes and hangs up must not cost
+            # a huge upfront allocation here either.
+            per_byte = 1.0 / min_bandwidth_bps
+            pre = 0
+            if nbytes > _PROBE_THRESHOLD:
+                lease = _ingest.default_ring().lease(_PROBE_BYTES)
+                _recv_exact(
+                    sock, _PROBE_BYTES, deadline, per_byte,
+                    progress=rx, out=lease.view,
+                )
+                try:
+                    full = _ingest.default_ring().lease(nbytes)
+                except (MemoryError, OverflowError):
+                    # Advertised size within _MAX_BLOB but beyond this
+                    # host: a frame this process can never hold is
+                    # malformed from its point of view.
+                    lease.release()
+                    lease = None
+                    return (
+                        None, Outcome.CORRUPT,
+                        time.monotonic() - t0, rx[0], None, None,
+                    )
+                full.view[:_PROBE_BYTES] = lease.view
+                lease.release()
+                lease = full
+                pre = _PROBE_BYTES
+            else:
+                lease = _ingest.default_ring().lease(nbytes)
+            # The probe already earned its per-byte budget: shift the
+            # deadline so the cumulative contract spans both reads.
             data = _recv_exact(
-                sock, nbytes, deadline, 1.0 / min_bandwidth_bps,
-                progress=rx,
+                sock, nbytes - pre, deadline + pre * per_byte,
+                per_byte, progress=rx, out=lease.view[pre:],
             )
+            data = lease.view
             nbytes_rx = len(data)
+            # Payload-sized copies this decode performs (0 = the decoded
+            # vector is a view into the ring buffer); feeds the
+            # copies_per_frame health column.
+            copies = 0
+            escapes = True
             if code == _TOPK_DELTA:
                 # Sparse top-k frame: validated and decoded here (the
                 # full malformed-input taxonomy — truncated index list,
@@ -824,10 +945,14 @@ def fetch_blob_full(
                         np.frombuffer(data, dtype=np.uint8)
                     )
                 except ValueError:
+                    lease.release()
                     return (
                         None, Outcome.CORRUPT,
                         time.monotonic() - t0, nbytes_rx, None, None,
                     )
+                # f32 value blocks decode as views into the buffer; an
+                # int8 block materializes fresh f32 values (one copy).
+                copies = 0 if vec.value_dtype == "f32" else 1
             elif code == _SHARD:
                 # Sharded frame: one contiguous slice of the replica in
                 # any inner encoding.  Decoded and validated here (lying
@@ -843,10 +968,19 @@ def fetch_blob_full(
                         np.frombuffer(data, dtype=np.uint8)
                     )
                 except ValueError:
+                    lease.release()
                     return (
                         None, Outcome.CORRUPT,
                         time.monotonic() - t0, nbytes_rx, None, None,
                     )
+                # Dense-f32 inner slices (and top-k f32 value blocks)
+                # stay views; bf16/int8 inners materialize f32.
+                if vec.inner_code == _pc.PAYLOAD_F32:
+                    copies = 0
+                elif vec.inner_code == _TOPK_DELTA:
+                    copies = 0 if vec.inner.value_dtype == "f32" else 1
+                else:
+                    copies = 1
             elif code == _INT8_CHUNKED:
                 # Receiver-side dequantize: the wire moved 1 byte/elem
                 # (+ scales); the merge math runs on the f32 decode.
@@ -858,20 +992,32 @@ def fetch_blob_full(
                     )
                 except ValueError:
                     # malformed payload == skipped fetch
+                    lease.release()
                     return (
                         None, Outcome.CORRUPT,
-                        time.monotonic() - t0, nbytes_rx, None,
+                        time.monotonic() - t0, nbytes_rx, None, None,
                     )
+                # Dequantize materialized a fresh f32 vector: the wire
+                # bytes are fully consumed, nothing views the buffer.
+                copies = 1
+                escapes = False
             else:
                 try:
-                    vec = np.frombuffer(data, dtype=_DTYPES[code]).copy()
+                    # A VIEW over the ring buffer, not .copy(): the
+                    # lease below keeps the bytes alive for exactly as
+                    # long as the vector does.
+                    vec = np.frombuffer(data, dtype=_DTYPES[code])
                 except ValueError:
                     # Payload length not a multiple of the advertised
                     # dtype's itemsize: malformed frame.
+                    lease.release()
                     return (
                         None, Outcome.CORRUPT,
-                        time.monotonic() - t0, nbytes_rx, None,
+                        time.monotonic() - t0, nbytes_rx, None, None,
                     )
+                # f32 merges straight off the view; bf16/f64/u16 pay
+                # their one upcast copy downstream in _weigh_remote.
+                copies = 0 if _DTYPES[code] == np.dtype("<f4") else 1
             # Optional trailing sections (epidemic-membership digest,
             # DPWT observability): attempted only after a fully valid
             # payload (a frame that failed above carries no trustworthy
@@ -881,6 +1027,18 @@ def fetch_blob_full(
                 digest, obs = _read_trailers(sock, want_digest, want_obs)
             else:
                 digest = obs = None
+            # Buffer ownership handoff (docs/transport.md): the caller
+            # takes the lease explicitly (lease_box), or the views keep
+            # the detached buffer alive, or — payload fully consumed —
+            # the buffer goes straight back to the ring.
+            if lease_box is not None:
+                lease_box.append(lease)
+            elif escapes:
+                lease.detach()
+            else:
+                lease.release()
+            lease = None
+            _ingest.note_rx_frame(copies)
             return (
                 (vec, clock, loss), Outcome.SUCCESS,
                 time.monotonic() - t0, nbytes_rx, digest, obs,
@@ -888,11 +1046,15 @@ def fetch_blob_full(
     except socket.timeout:
         # Bytes flowed and the budget still lapsed: a live-but-slow peer
         # (trickle, overload) — soft evidence, not a death mark.
+        if lease is not None:
+            lease.release()
         outcome = Outcome.SLOW if rx[0] > 0 else Outcome.TIMEOUT
         return None, outcome, time.monotonic() - t0, nbytes_rx, None, None
     except (ConnectionError, OSError):
         # Accepted, then closed/reset mid-frame: the peer process is
         # alive enough to accept but served a broken stream.
+        if lease is not None:
+            lease.release()
         return (
             None, Outcome.SHORT_READ, time.monotonic() - t0, nbytes_rx, None,
             None,
@@ -936,15 +1098,23 @@ def fetch_state_chunk(
     max_chunk: int,
     timeout_ms: int,
     min_bandwidth_bps: float = _MIN_WIRE_BANDWIDTH,
-) -> Tuple[Optional[Tuple[bytes, int, int]], str, float, int]:
+    out: Optional[memoryview] = None,
+) -> Tuple[Optional[Tuple[memoryview, int, int]], str, float, int]:
     """Fetch one STATE chunk: ``(result, outcome, latency_s, nbytes_rx)``
-    where ``result`` is ``(chunk_bytes, total_len, generation)`` or None.
+    where ``result`` is ``(chunk_view, total_len, generation)`` or None.
 
     Same cumulative-deadline discipline as :func:`fetch_blob_ex`: the
     budget covers connect + request + header outright and the chunk read
     earns per-byte extension.  A CRC mismatch or malformed header is
     ``corrupt``; the caller (:func:`fetch_state`) decides whether to
-    resume, restart, or give up."""
+    resume, restart, or give up.
+
+    ``out`` (a writable memoryview) receives the chunk bytes in place —
+    :func:`fetch_state` passes a window of its preassembled blob so
+    chunks land at their final offset with no accumulation copy.  A
+    server-advertised ``chunk_len`` that would overflow ``out`` is
+    ``corrupt`` (the blob shrank or the donor is lying).  The returned
+    chunk is a memoryview either way; it compares equal to ``bytes``."""
     t0 = time.monotonic()
     deadline = t0 + timeout_ms / 1000.0
     nbytes_rx = 0
@@ -976,10 +1146,12 @@ def fetch_state_chunk(
                 or version != 1
                 or total > _MAX_BLOB
                 or chunk_len > max(total - off, 0)
+                or (out is not None and chunk_len > len(out))
             ):
                 return None, Outcome.CORRUPT, time.monotonic() - t0, 0
             data = _recv_exact(
-                sock, chunk_len, deadline, 1.0 / min_bandwidth_bps
+                sock, chunk_len, deadline, 1.0 / min_bandwidth_bps,
+                out=out,
             )
             nbytes_rx = len(data)
             if zlib.crc32(data) != crc or off != min(max(offset, 0), total):
@@ -1018,14 +1190,24 @@ def fetch_state(
     for the caller to interpret; ``outcome`` on failure is the LAST
     chunk's classification."""
     t0 = time.monotonic()
-    buf = bytearray()
+    # Chunks land DIRECTLY at their final offset: the first successful
+    # chunk learns ``total`` and sizes the blob once; every later chunk
+    # recv_into's a window of it — no chunk-grow accumulation buffer,
+    # no per-chunk splice copy (the tcp.py:1021 twin of the old
+    # _recv_n loop, now shared via ingest.recv_exact_into).
+    blob: Optional[bytearray] = None
+    filled = 0
     total: Optional[int] = None
     gen: Optional[int] = None
     retries = 0
     nbytes_rx = 0
     while True:
+        window = (
+            memoryview(blob)[filled:] if blob is not None else None
+        )
         got, outcome, _lat, nrx = fetch_state_chunk(
-            host, port, len(buf), chunk_bytes, timeout_ms, min_bandwidth_bps
+            host, port, filled, chunk_bytes, timeout_ms,
+            min_bandwidth_bps, out=window,
         )
         nbytes_rx += nrx
         if got is None:
@@ -1035,7 +1217,7 @@ def fetch_state(
                 return None, outcome, time.monotonic() - t0, nbytes_rx
             retries += 1
             if outcome == Outcome.CORRUPT:
-                buf.clear()
+                blob, filled = None, 0
                 total = gen = None
             continue
         data, tot, g = got
@@ -1045,17 +1227,27 @@ def fetch_state(
             if retries >= max_retries:
                 return None, Outcome.CORRUPT, time.monotonic() - t0, nbytes_rx
             retries += 1
-            buf.clear()
+            blob, filled = None, 0
             total = gen = None
             continue
         gen, total = g, tot
-        buf += data
-        if len(buf) >= total:
+        if blob is None:
+            # First chunk of a (re)started transfer: size the blob from
+            # the donor's advertisement and bank what just arrived.
+            blob = bytearray(total)
+            blob[: len(data)] = data
+            filled = len(data)
+        else:
+            # ``data`` IS blob[filled:filled+len] (recv_into'd there).
+            filled += len(data)
+        if filled >= total:
+            # bytes() here is the public immutable-contract copy, not a
+            # frame-path one — bootstrap runs once per restart.
             return (
-                bytes(buf[:total]), Outcome.SUCCESS,
+                bytes(memoryview(blob)[:total]), Outcome.SUCCESS,  # dpwalint: ignore[zerocopy-tobytes] -- one-shot bootstrap transfer returns owning bytes by contract
                 time.monotonic() - t0, nbytes_rx,
             )
-        if not data:
+        if not len(data):
             # Zero-byte chunk while bytes remain: malformed server.
             if retries >= max_retries:
                 return None, Outcome.CORRUPT, time.monotonic() - t0, nbytes_rx
@@ -1088,18 +1280,26 @@ def probe_header_classified(
                 return Outcome.TIMEOUT, None
             sock.settimeout(remaining)
             sock.sendall(_REQ)
-            peek = _recv_exact(sock, 4, deadline)
+            hdr_buf = bytearray(max(_HDR.size, _BUSY_HDR.size))
+            peek = _recv_exact(sock, 4, deadline, out=hdr_buf)
             if peek == _BUSY_MAGIC:
                 # A shedding server answers probes with DPWB too: the
                 # peer is ALIVE but loaded — the caller records the
                 # low-weight busy outcome, never a hard failure.
-                rest = _recv_exact(sock, _BUSY_HDR.size - 4, deadline)
-                _m, bversion, _retry = _BUSY_HDR.unpack(peek + rest)
+                _recv_exact(
+                    sock, _BUSY_HDR.size - 4, deadline,
+                    out=memoryview(hdr_buf)[4:],
+                )
+                _m, bversion, _retry = _BUSY_HDR.unpack_from(hdr_buf, 0)
                 if bversion != 1:
                     return Outcome.CORRUPT, None
                 return Outcome.BUSY, None
-            raw = peek + _recv_exact(sock, _HDR.size - 4, deadline)
-            magic, version, code, clock, _loss, nbytes = _HDR.unpack(raw)
+            _recv_exact(
+                sock, _HDR.size - 4, deadline, out=memoryview(hdr_buf)[4:]
+            )
+            magic, version, code, clock, _loss, nbytes = _HDR.unpack_from(
+                hdr_buf, 0
+            )
             if (
                 magic != _MAGIC
                 or version != 1
@@ -1907,12 +2107,14 @@ class TcpTransport:
             )
             inner_code = _INT8_CHUNKED
         elif self._wire_bf16:
-            inner = np.frombuffer(
-                sl.astype(_DTYPES[3]).tobytes(), np.uint8
-            )
+            # astype is the required downcast; the uint8 reinterpret is
+            # a free view (the old frombuffer(tobytes()) round-trip
+            # copied the slice twice).
+            inner = sl.astype(_DTYPES[3]).view(np.uint8)
             inner_code = _pc.PAYLOAD_BF16
         else:
-            inner = np.frombuffer(sl.astype("<f4").tobytes(), np.uint8)
+            arr = sl if sl.dtype == np.dtype("<f4") else sl.astype("<f4")
+            inner = arr.view(np.uint8)
             inner_code = _pc.PAYLOAD_F32
         payload = _shard_ops.encode_shard_payload(
             inner, flat.size, k, idx, inner_code
@@ -2669,6 +2871,7 @@ class TcpTransport:
         codec = "topk" if self._wire_topk else self.config.protocol.wire_dtype
         if self._shard_on:
             codec = f"shard+{codec}"
+        zc = _ingest.rx_stats()
         out = {
             "codec": codec,
             "frames": t["frames"],
@@ -2679,6 +2882,13 @@ class TcpTransport:
                 if t["wire_bytes"]
                 else 0.0
             ),
+            # Zero-copy hot-path accounting (process-wide: the receive
+            # ring and the copy tally are shared across transports, like
+            # the frame path itself): payload-sized copies per decoded
+            # frame (0.0 = views straight out of the ring) and the
+            # fraction of ring bytes currently leased out.
+            "copies_per_frame": round(zc["copies_per_frame"], 4),
+            "ring_occupancy": round(zc["ring_occupancy"], 4),
         }
         if self._wire_topk:
             out["topk_fraction"] = self.config.protocol.topk_fraction

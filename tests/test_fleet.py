@@ -631,6 +631,34 @@ def test_tcp_gate_compares_like_with_like_only():
     assert bench.tcp_gate(old_stamp, 0.45)["verdict"] == "no_data"
 
 
+def test_tcp_gate_flags_wobbling_baseline_as_unstable():
+    # A measurement whose passes disagree by more than the spread
+    # tolerance gets no band verdict at all: it could land anywhere in
+    # the band by luck, so "ok"/"regressed" would mean nothing.
+    hist = _hist([0.20, 0.22, 0.21, 0.23])
+    gate = bench.tcp_gate(hist, 0.22, spread_iqr_frac=0.40)
+    assert gate["verdict"] == "unstable"
+    assert gate["spread_iqr_frac"] == 0.40
+    # Unstable wins even over what would otherwise read "regressed",
+    # and even when history is too thin for a band verdict.
+    assert bench.tcp_gate(hist, 0.05, spread_iqr_frac=0.6)[
+        "verdict"
+    ] == "unstable"
+    assert bench.tcp_gate([], 0.22, spread_iqr_frac=0.6)[
+        "verdict"
+    ] == "unstable"
+    # At or under the tolerance the band logic is untouched; absent
+    # spread (older records, failed stats parse) behaves as before.
+    assert bench.tcp_gate(hist, 0.22, spread_iqr_frac=0.25)[
+        "verdict"
+    ] == "ok"
+    assert bench.tcp_gate(hist, 0.22)["verdict"] == "ok"
+    # No measurement at all stays no_data regardless of spread.
+    assert bench.tcp_gate(hist, None, spread_iqr_frac=0.6)[
+        "verdict"
+    ] == "no_data"
+
+
 def test_hier_gate_compares_like_with_like_only():
     def mk(v, m):
         e = {"record": "bench", "hier": {"wide_multiplier_min": v}}

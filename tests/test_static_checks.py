@@ -194,6 +194,7 @@ from dpwa_tpu.analysis.lock_discipline import (  # noqa: E402
 from dpwa_tpu.analysis.wire_protocol import WireProtocolChecker  # noqa: E402
 from dpwa_tpu.analysis.config_keys import ConfigKeysChecker  # noqa: E402
 from dpwa_tpu.analysis.emit_kinds import EmitKindsChecker  # noqa: E402
+from dpwa_tpu.analysis.zerocopy import ZeroCopyChecker  # noqa: E402
 
 _BASELINE = os.path.join(_ROOT, "tools", "dpwalint_baseline.json")
 
@@ -237,6 +238,7 @@ def test_rule_ids_are_frozen():
         "config-undocumented-key",
         "config-unparsed-block",
         "emit-kind",
+        "zerocopy-tobytes",
         "dpwalint-annotation",
     })
 
@@ -429,6 +431,59 @@ def test_wire_registry_itself_is_exempt():
     result = _run_on_source(
         [WireProtocolChecker()],
         {"dpwa_tpu/parallel/protocol_constants.py": src},
+    )
+    assert result.errors == []
+
+
+# --- zero-copy fixtures ---
+
+_ZC_BAD = (
+    "def decode(raw):\n"
+    "    body = raw[4:].tobytes()\n"
+    "    owned = bytes(raw[:4])\n"
+    "    return body, owned\n"
+)
+
+
+def test_zerocopy_flags_copies_on_frame_path_only():
+    on_path = _run_on_source(
+        [ZeroCopyChecker()], {"dpwa_tpu/ops/quantize.py": _ZC_BAD}
+    )
+    assert [f.rule for f in on_path.errors] == [
+        "zerocopy-tobytes", "zerocopy-tobytes"
+    ]
+    # The symbol carries the enclosing def and the copy's spelling.
+    assert sorted(f.symbol for f in on_path.errors) == [
+        "decode:.tobytes()", "decode:bytes(...)"
+    ]
+    off_path = _run_on_source(
+        [ZeroCopyChecker()], {"dpwa_tpu/health/chaos.py": _ZC_BAD}
+    )
+    assert off_path.errors == []
+
+
+def test_zerocopy_honors_standard_suppression_grammar():
+    src = (
+        "def snapshot(vec):\n"
+        "    return vec.tobytes()  "
+        "# dpwalint: ignore[zerocopy-tobytes] -- fixture proving the grammar\n"
+    )
+    result = _run_on_source(
+        [ZeroCopyChecker()], {"dpwa_tpu/parallel/tcp.py": src}
+    )
+    assert result.errors == []
+    assert len(result.suppressed) == 1
+
+
+def test_zerocopy_passes_view_clean_decode():
+    src = (
+        "import numpy as np\n"
+        "def decode(raw):\n"
+        "    n = int(raw[:8].view('<u8')[0])\n"
+        "    return raw[8:8 + 4 * n].view('<f4')\n"
+    )
+    result = _run_on_source(
+        [ZeroCopyChecker()], {"dpwa_tpu/ops/shard.py": src}
     )
     assert result.errors == []
 

@@ -39,7 +39,9 @@ codec, cumulative on-wire bytes and the final wire-vs-dense compression
 ratio, the number of sparse (top-k) fetches consumed, the sharded-wire
 view when ``shard.k > 1`` (k, round-robin coverage, shard fetches
 consumed), and — when the prefetch pipeline contributed — the overlap
-occupancy and hidden-fetch-fraction trajectory.
+occupancy and hidden-fetch-fraction trajectory.  Runs on the zero-copy
+receive ring additionally report copies/frame (final and max) and the
+ring-buffer occupancy (docs/transport.md).
 
 ``--reactor`` prints the reactor Rx scheduler digest
 (docs/transport.md): the event-loop lag trajectory (final/max EWMA ms),
@@ -168,6 +170,10 @@ def summarize(
         "shard_k": None,
         "shard_coverage_final": None,
         "shard_fetches": 0,  # exchange records consumed as shard frames
+        "zerocopy_seen": False,  # any copies_per_frame column
+        "copies_per_frame_final": None,
+        "copies_per_frame_max": None,  # worst decode = copy regression
+        "ring_occupancy_final": None,
     }
 
     reactor: Dict[str, Any] = {
@@ -376,6 +382,18 @@ def summarize(
                     wire["shard_coverage_final"] = rec.get(
                         "shard_coverage"
                     )
+                cpf = rec.get("copies_per_frame")
+                if cpf is not None:
+                    wire["zerocopy_seen"] = True
+                    wire["copies_per_frame_final"] = cpf
+                    if (
+                        wire["copies_per_frame_max"] is None
+                        or cpf > wire["copies_per_frame_max"]
+                    ):
+                        wire["copies_per_frame_max"] = cpf
+                    wire["ring_occupancy_final"] = rec.get(
+                        "ring_occupancy"
+                    )
             lag = rec.get("reactor_loop_lag_ms")
             if lag is not None:
                 reactor["seen"] = True
@@ -575,6 +593,14 @@ def _print_wire(summary: Dict[str, Any]) -> None:
             f"hidden fetch fraction {w.get('hidden_frac_final')}; "
             f"prefetched {w.get('prefetched')} rounds "
             f"({w.get('straddled')} straddled a local publish)"
+        )
+    if w.get("zerocopy_seen"):
+        print(
+            f"  zero-copy: copies/frame final "
+            f"{w.get('copies_per_frame_final')}, max "
+            f"{w.get('copies_per_frame_max')} (0.0 = decoded views "
+            f"straight off the receive ring); ring occupancy "
+            f"{w.get('ring_occupancy_final')}"
         )
 
 

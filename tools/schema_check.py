@@ -111,6 +111,12 @@ _HEALTH_GROUPS: Dict[str, Dict[str, tuple]] = {
         "wire_bytes": (int,),
         "compression_ratio": _NUM,
     },
+    # Zero-copy frame path (its own group, not folded into "wire":
+    # wire records written before the ring existed stay valid).
+    "zerocopy": {
+        "copies_per_frame": _NUM,
+        "ring_occupancy": _NUM,
+    },
     "overlap": {
         "overlap_occupancy": _NUM,
         "overlap_hidden_frac": _NUM,
