@@ -639,6 +639,74 @@ def hier_gate(
     return gate
 
 
+MERGE_GATE_WINDOW = 8
+MERGE_GATE_REL_TOL = 0.5
+MERGE_GATE_SPREAD_TOL = 0.25
+
+
+def merge_gate(
+    history: list,
+    current_gbps,
+    window: int = MERGE_GATE_WINDOW,
+    rel_tol: float = MERGE_GATE_REL_TOL,
+    methodology: int = BENCH_METHODOLOGY,
+    spread_iqr_frac=None,
+    spread_tol: float = MERGE_GATE_SPREAD_TOL,
+) -> dict:
+    """Regression gate for the fused merge leg (the ``tcp_gate``
+    pattern, keyed on ``merge_fused_gbps``): median of the last
+    ``window`` same-methodology history samples, symmetric relative
+    band, ``unstable`` short-circuit when the run's own per-iteration
+    dispersion exceeds ``spread_tol`` — a measurement whose iterations
+    disagree by >25% can land anywhere in the band by luck.  The
+    verdict rides in the merge-leg record (not a hard failure) exactly
+    like ``tcp_gate``'s does in the headline record."""
+    samples = [
+        float(e["merge_fused_gbps"])
+        for e in history
+        if isinstance(e, dict)
+        and e.get("record") == "bench"
+        and e.get("bench_methodology") == methodology
+        and isinstance(e.get("merge_fused_gbps"), (int, float))
+        and not isinstance(e.get("merge_fused_gbps"), bool)
+    ][-int(window):]
+    median = float(np.median(samples)) if samples else None
+    gate = {
+        "samples": len(samples),
+        "window": int(window),
+        "rel_tol": float(rel_tol),
+        "methodology": int(methodology),
+        "median_gbps": round(median, 3) if median is not None else None,
+        "current_gbps": (
+            round(float(current_gbps), 3)
+            if current_gbps is not None else None
+        ),
+        "spread_iqr_frac": (
+            round(float(spread_iqr_frac), 4)
+            if spread_iqr_frac is not None else None
+        ),
+        "spread_tol": float(spread_tol),
+    }
+    if (
+        current_gbps is not None
+        and spread_iqr_frac is not None
+        and float(spread_iqr_frac) > spread_tol
+    ):
+        gate["verdict"] = "unstable"
+        return gate
+    if current_gbps is None or len(samples) < 2:
+        gate["verdict"] = "no_data"
+        return gate
+    cur = float(current_gbps)
+    if cur < median * (1.0 - rel_tol):
+        gate["verdict"] = "regressed"
+    elif cur > median * (1.0 + rel_tol):
+        gate["verdict"] = "improved"
+    else:
+        gate["verdict"] = "ok"
+    return gate
+
+
 def read_bench_history(path: str, max_lines: int = 512) -> list:
     """Parse the tail of ``bench_history.jsonl``; [] when absent."""
     entries: list = []
@@ -1248,6 +1316,272 @@ def bench_copy(
     }
 
 
+# Replica sizes for the merge leg: 16/48/96 MiB — mid-size replica up
+# to the ResNet-50-scale default the headline bench ships.
+MERGE_SWEEP_FRAME_FLOATS = (4 * 1024 * 1024, 12 * 1024 * 1024,
+                            24 * 1024 * 1024)
+
+
+def bench_merge(
+    sizes=MERGE_SWEEP_FRAME_FLOATS,
+    iters: int = 5,
+    fold_ks=(2, 4, 8),
+    topk_frac: float = 0.05,
+    shard_k: int = 4,
+) -> dict:
+    """Device merge leg: the pre-engine merge path vs the fused kernels.
+
+    For each replica size and codec family the **legacy** cell replays
+    exactly what ``exchange_on_device`` did before the device engine
+    landed (the single-slot ``_LERP_CACHE`` era): read the replica back
+    to the host (``np.asarray`` — the per-exchange readback), decode or
+    densify the frame host-side (int8 dequant, top-k densify, bf16
+    upcast, shard merge on the host copy), then upload a FULL dense
+    vector and lerp.  The **fused** cell is one ``MergeEngine``
+    dispatch off the frame's raw wire views — no dense intermediate, no
+    readback, the replica device-resident between rounds.
+
+    GB/s is effective replica bandwidth: replica bytes maintained per
+    merge over wall time, the same numerator down both paths, so the
+    speedup is a pure path comparison.  Every cell first asserts the
+    two paths produce bit-identical replicas (the engine's acceptance
+    contract), then reports tracemalloc's host-allocation peak across
+    one merge per path — O(frame) for the legacy densify cells,
+    O(header) fused.
+
+    CPU-backend honesty (docs/device.md "Reading the numbers"): on the
+    forced-CPU backend ``np.asarray`` of a device array is zero-copy
+    and XLA scatters are scalar loops, so the measured speedups are a
+    conservative FLOOR — a real accelerator pays PCIe/DMA for exactly
+    the crossings the fused path deletes.  The fold cells additionally
+    report dispatch amortization (k frames : 1 dispatch), the
+    structural win a compute-bound CPU's wall clock understates."""
+    import jax
+    import jax.numpy as jnp
+
+    from dpwa_tpu import native
+    from dpwa_tpu.device import MergeEngine
+    from dpwa_tpu.ops import quantize as qz
+    from dpwa_tpu.ops import shard as shard_ops
+
+    try:
+        import ml_dtypes
+    except ImportError:  # pragma: no cover - ships with jax
+        ml_dtypes = None
+
+    alpha = 0.3
+    # The pre-engine jitted lerp, verbatim: one compiled slot, alpha
+    # traced, remote uploaded with a plain jnp.asarray copy.
+    legacy_lerp = jax.jit(lambda x, y, t: (1.0 - t) * x + t * y)
+    eng = MergeEngine()
+
+    def timed(fn):
+        fn()  # warm: compile, allocator slack, page faults
+        durs = []
+        for _ in range(max(1, int(iters))):
+            t0 = time.perf_counter()
+            fn()
+            durs.append(time.perf_counter() - t0)
+        return float(np.median(durs)), durs
+
+    def alloc_peak(fn) -> int:
+        tracemalloc.start()
+        try:
+            fn()
+            _, peak = tracemalloc.get_traced_memory()
+        finally:
+            tracemalloc.stop()
+        return int(peak)
+
+    frames: dict = {}
+    headline = None
+    spread = None
+    for floats in sizes:
+        d = int(floats)
+        rng = np.random.default_rng(d)
+        local = rng.standard_normal(d).astype(np.float32)
+        remote = rng.standard_normal(d).astype(np.float32)
+        dev = jnp.asarray(local)
+        nbytes = d * 4
+
+        # One decoded-frame fixture per codec family.
+        int8_payload = qz.encode_int8_payload(remote, 7, 1.0, 0)
+        sp = qz.decode_topk_payload(
+            qz.TopkEncoder(topk_frac, "f32").encode(remote, 0, 1.0, 0)
+        )
+        lo, hi = shard_ops.shard_bounds(d, int(shard_k), 1)
+        est_slice = np.ascontiguousarray(remote[lo:hi])
+
+        def legacy_dense():
+            np.asarray(dev)  # the old per-exchange readback
+            return legacy_lerp(dev, jnp.asarray(remote), np.float32(alpha))
+
+        def fused_dense():
+            return eng.merge_dense(dev, remote, alpha)
+
+        def legacy_int8():
+            np.asarray(dev)
+            dense = qz.decode_int8_payload(int8_payload)
+            return legacy_lerp(dev, jnp.asarray(dense), np.float32(alpha))
+
+        def fused_int8():
+            return eng.merge_int8(dev, int8_payload, alpha)
+
+        def legacy_topk():
+            host = np.asarray(dev)
+            dense = sp.densify(host)
+            return legacy_lerp(dev, jnp.asarray(dense), np.float32(alpha))
+
+        def fused_topk():
+            return eng.merge_topk(dev, sp.indices, sp.values, alpha)
+
+        def legacy_shard():
+            host = np.asarray(dev)
+            merged = host.copy()
+            merged[lo:hi] = native.merge_out(
+                np.ascontiguousarray(merged[lo:hi]), est_slice, alpha
+            )
+            return jnp.asarray(merged)  # the old full re-upload
+
+        def fused_shard():
+            return eng.merge_shard(dev, lo, est_slice, alpha)
+
+        pairs = [
+            ("f32", legacy_dense, fused_dense),
+            ("int8", legacy_int8, fused_int8),
+            ("topk", legacy_topk, fused_topk),
+            ("shard", legacy_shard, fused_shard),
+        ]
+        if ml_dtypes is not None:
+            remote_bf16 = remote.astype(ml_dtypes.bfloat16)
+
+            def legacy_bf16():
+                np.asarray(dev)
+                dense = remote_bf16.astype(np.float32)  # old host upcast
+                return legacy_lerp(
+                    dev, jnp.asarray(dense), np.float32(alpha)
+                )
+
+            def fused_bf16():
+                return eng.merge_bf16(dev, remote_bf16, alpha)
+
+            pairs.insert(1, ("bf16", legacy_bf16, fused_bf16))
+
+        cells: dict = {}
+        for name, legacy, fused in pairs:
+            if (
+                np.asarray(legacy()).tobytes()
+                != np.asarray(fused()).tobytes()
+            ):
+                raise AssertionError(
+                    f"fused {name} diverged from the legacy merge "
+                    f"at d={d}"
+                )
+            legacy_dt, _ = timed(
+                lambda: legacy().block_until_ready()
+            )
+            fused_dt, fused_durs = timed(
+                lambda: fused().block_until_ready()
+            )
+            cells[name] = {
+                "legacy_gbps": round(nbytes / legacy_dt / 1e9, 3),
+                "fused_gbps": round(nbytes / fused_dt / 1e9, 3),
+                "speedup": round(legacy_dt / fused_dt, 2),
+                "bit_identical": True,
+                "legacy_alloc_bytes": alloc_peak(
+                    lambda: legacy().block_until_ready()
+                ),
+                "fused_alloc_bytes": alloc_peak(
+                    lambda: fused().block_until_ready()
+                ),
+            }
+            if name == "f32":
+                headline = nbytes / fused_dt / 1e9
+                med = float(np.median(fused_durs))
+                q1, q3 = np.percentile(fused_durs, [25, 75])
+                spread = float((q3 - q1) / med) if med > 0 else None
+        frames[f"{nbytes >> 20}MiB"] = {
+            "frame_bytes": int(nbytes),
+            "codecs": cells,
+        }
+
+    # Batched multi-peer folds at the smallest replica size: k legacy
+    # round-trip merges vs k fused dispatches vs ONE fold dispatch.
+    d0 = int(sizes[0])
+    rng = np.random.default_rng(99)
+    dev0 = jnp.asarray(rng.standard_normal(d0).astype(np.float32))
+    fold_cells: dict = {}
+    for k in fold_ks:
+        k = int(k)
+        remotes = [
+            rng.standard_normal(d0).astype(np.float32) for _ in range(k)
+        ]
+        alphas = [alpha] * k
+
+        def legacy_seq():
+            x = dev0
+            for r in remotes:
+                np.asarray(x)  # per-merge readback, the old cadence
+                x = legacy_lerp(x, jnp.asarray(r), np.float32(alpha))
+            return x
+
+        def fused_seq():
+            x = dev0
+            for r in remotes:
+                x = eng.merge_dense(x, r, alpha)
+            return x
+
+        def fold_once():
+            return eng.fold(dev0, remotes, alphas)
+
+        if (
+            np.asarray(fused_seq()).tobytes()
+            != np.asarray(fold_once()).tobytes()
+        ):
+            raise AssertionError(
+                f"k={k} fold diverged from sequential merges"
+            )
+        legacy_dt, _ = timed(lambda: legacy_seq().block_until_ready())
+        seq_dt, _ = timed(lambda: fused_seq().block_until_ready())
+        fold_dt, _ = timed(lambda: fold_once().block_until_ready())
+        fold_cells[f"k{k}"] = {
+            "frames": k,
+            "legacy_sequential_gbps": round(
+                k * d0 * 4 / legacy_dt / 1e9, 3
+            ),
+            "fused_sequential_gbps": round(k * d0 * 4 / seq_dt / 1e9, 3),
+            "fold_gbps": round(k * d0 * 4 / fold_dt / 1e9, 3),
+            "speedup_vs_legacy": round(legacy_dt / fold_dt, 2),
+            "dispatch_amortization": k,
+            "bit_identical": True,
+        }
+
+    best = max(
+        cell["speedup"]
+        for fr in frames.values()
+        for cell in fr["codecs"].values()
+    )
+    return {
+        "iters": int(iters),
+        "sizes_floats": [int(s) for s in sizes],
+        "alpha": alpha,
+        "topk_frac": float(topk_frac),
+        "shard_k": int(shard_k),
+        "frames": frames,
+        "fold_frame_floats": d0,
+        "fold": fold_cells,
+        "best_speedup": best,
+        "merge_fused_gbps": (
+            round(headline, 3) if headline is not None else None
+        ),
+        "spread_iqr_frac": (
+            round(spread, 4) if spread is not None else None
+        ),
+        "backend": jax.default_backend(),
+        "engine": eng.snapshot(),
+    }
+
+
 # ---------------------------------------------------------------------------
 # Watchdog'd subprocess orchestration (main process never imports JAX).
 # ---------------------------------------------------------------------------
@@ -1472,6 +1806,34 @@ def main() -> None:
         help="timed fetches per (server, size, path) copy-leg cell",
     )
     ap.add_argument(
+        "--merge-leg", action="store_true",
+        help="run ONLY the device merge-engine leg: the pre-engine "
+        "readback+densify+upload merge vs the fused decode+lerp "
+        "kernels, per codec family and replica size, plus batched "
+        "multi-peer folds — GB/s, speedup, bit-identity, per-merge "
+        "host allocation; appends its own bench_history.jsonl record "
+        "carrying a merge_gate verdict",
+    )
+    ap.add_argument(
+        "--merge-leg-run", action="store_true",
+        help="internal: the merge leg's backend-pinned subprocess "
+        "entry (use --merge-leg)",
+    )
+    ap.add_argument(
+        "--merge-frame-floats", type=str,
+        default=",".join(str(s) for s in MERGE_SWEEP_FRAME_FLOATS),
+        help="comma-separated replica sizes (floats) for the merge leg",
+    )
+    ap.add_argument(
+        "--merge-iters", type=int, default=5,
+        help="timed merges per (codec, size, path) merge-leg cell",
+    )
+    ap.add_argument(
+        "--merge-fold-ks", type=str, default="2,4,8",
+        help="comma-separated fold widths (frames per batched "
+        "dispatch) for the merge leg's multi-peer fold cells",
+    )
+    ap.add_argument(
         "--confirm-timeout", type=float, default=DEAD_CONFIRM_TIMEOUT_S,
         help="capped single-probe timeout once the backend dead-streak "
         "has tripped (the cheap re-confirmation instead of the full "
@@ -1497,6 +1859,18 @@ def main() -> None:
     if args.wire_leg:
         sweep = bench_wire(args.wire_size, args.wire_iters)
         print("WIRE_SWEEP " + json.dumps(sweep), flush=True)
+        return
+    if args.merge_leg_run:
+        sizes = [
+            int(s) for s in args.merge_frame_floats.split(",") if s.strip()
+        ]
+        ks = [int(s) for s in args.merge_fold_ks.split(",") if s.strip()]
+        sweep = bench_merge(sizes, args.merge_iters, ks)
+        print("MERGE_SWEEP " + json.dumps(sweep), flush=True)
+        if sweep.get("merge_fused_gbps") is not None:
+            print(
+                f"MERGE_GBPS {sweep['merge_fused_gbps']:.6f}", flush=True
+            )
         return
     if args.serve_leg:
         res = bench_serve(args.serve_frame_floats, args.serve_seconds)
@@ -1626,6 +2000,80 @@ def main() -> None:
             os.path.dirname(os.path.abspath(__file__)),
             "artifacts", "bench_history.jsonl",
         )
+        try:
+            os.makedirs(os.path.dirname(history_path), exist_ok=True)
+            with open(history_path, "a", encoding="utf-8") as f:
+                f.write(
+                    json.dumps({"record": "bench", "t": time.time(), **out})
+                    + "\n"
+                )
+        except OSError:
+            pass
+        return
+    if args.merge_leg:
+        # The leg imports jax, so it runs as a backend-pinned watchdog'd
+        # subprocess (the TCP-baseline pattern) — the main process never
+        # imports JAX, and backend init on this box can hang.
+        mib = [
+            int(s) * 4 // (1 << 20)
+            for s in args.merge_frame_floats.split(",") if s.strip()
+        ]
+        log(
+            f"merge leg: replicas {mib} MiB, x{args.merge_iters} merges "
+            "per cell ..."
+        )
+        cpu_env = os.environ.copy()
+        cpu_env["JAX_PLATFORMS"] = "cpu"
+        cpu_env["PYTHONPATH"] = os.pathsep.join(
+            p for p in cpu_env.get("PYTHONPATH", "").split(os.pathsep)
+            if p and "axon" not in p
+        )
+        gbps, sweep = run_leg(
+            "--merge-leg-run",
+            [
+                "--merge-frame-floats", args.merge_frame_floats,
+                "--merge-iters", str(args.merge_iters),
+                "--merge-fold-ks", args.merge_fold_ks,
+            ],
+            "MERGE_GBPS", args.device_timeout, cpu_env,
+            json_tag="MERGE_SWEEP",
+        )
+        if sweep:
+            for fr_name, fr in sweep["frames"].items():
+                for codec, cell in fr["codecs"].items():
+                    log(
+                        f"merge leg: {fr_name} [{codec}] "
+                        f"{cell['legacy_gbps']} -> {cell['fused_gbps']} "
+                        f"GB/s ({cell['speedup']}x), fused alloc "
+                        f"{cell['fused_alloc_bytes']} B/merge"
+                    )
+            for kname, cell in sweep["fold"].items():
+                log(
+                    f"merge leg: fold {kname} "
+                    f"{cell['legacy_sequential_gbps']} -> "
+                    f"{cell['fold_gbps']} GB/s "
+                    f"({cell['speedup_vs_legacy']}x, "
+                    f"{cell['dispatch_amortization']} frames/dispatch)"
+                )
+            log(f"merge leg: best speedup {sweep['best_speedup']}x")
+        history_path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "artifacts", "bench_history.jsonl",
+        )
+        gate = merge_gate(
+            read_bench_history(history_path), gbps,
+            spread_iqr_frac=(sweep or {}).get("spread_iqr_frac"),
+        )
+        log(f"merge leg: gate {gate['verdict']}")
+        out = {
+            "metric": "device_merge_engine",
+            "bench_methodology": BENCH_METHODOLOGY,
+            "merge": sweep,
+            "merge_fused_gbps": gbps,
+            "merge_gate": gate,
+        }
+        print("MERGE_LEG " + json.dumps(sweep), flush=True)
+        print(json.dumps(out), flush=True)
         try:
             os.makedirs(os.path.dirname(history_path), exist_ok=True)
             with open(history_path, "a", encoding="utf-8") as f:

@@ -1,6 +1,6 @@
 """dpwalint — the repo's own static-analysis framework.
 
-Six checkers over one shared core (``tools/dpwalint.py`` is the CLI,
+Seven checkers over one shared core (``tools/dpwalint.py`` is the CLI,
 ``tests/test_static_checks.py`` the tier-1 gate):
 
 - :mod:`.lock_discipline` — cross-thread ``self._*`` state must be
@@ -14,7 +14,10 @@ Six checkers over one shared core (``tools/dpwalint.py`` is the CLI,
 - :mod:`.emit_kinds` — JSONL emit sites use registered kinds (the old
   ``tools/lint_emitters.py`` pass, folded in);
 - :mod:`.zerocopy` — frame-path modules never copy payload bytes with
-  ``.tobytes()``/``bytes(...)`` (the zero-copy hot-path discipline).
+  ``.tobytes()``/``bytes(...)`` (the zero-copy hot-path discipline);
+- :mod:`.device_roundtrip` — merge-path modules never cross the
+  numpy↔JAX seam outside :mod:`dpwa_tpu.device.handoff` (the
+  device-resident replica discipline).
 """
 
 from __future__ import annotations
@@ -31,6 +34,7 @@ from dpwa_tpu.analysis.core import (
     save_baseline,
 )
 from dpwa_tpu.analysis.determinism import DeterminismChecker
+from dpwa_tpu.analysis.device_roundtrip import DeviceRoundtripChecker
 from dpwa_tpu.analysis.emit_kinds import EmitKindsChecker
 from dpwa_tpu.analysis.lock_discipline import LockDisciplineChecker
 from dpwa_tpu.analysis.rules import RULE_DESCRIPTIONS, RULE_IDS
@@ -47,6 +51,7 @@ def all_checkers():
         ConfigKeysChecker(),
         EmitKindsChecker(),
         ZeroCopyChecker(),
+        DeviceRoundtripChecker(),
     ]
 
 

@@ -1,0 +1,115 @@
+"""Device↔host round-trip checker for the merge path.
+
+The device merge engine's whole point (docs/device.md "Readback
+boundaries") is that the replica crosses the numpy↔JAX seam exactly
+once per direction: frames go up through
+:func:`dpwa_tpu.device.handoff.to_device`, the replica comes back down
+through :func:`~dpwa_tpu.device.handoff.to_host` — and only at the
+publish/checkpoint/trust boundaries.  One stray
+``np.asarray(device_array)`` in a merge-path module silently
+reintroduces the per-exchange readback PR 16 deleted, and on a real
+accelerator that is a full-replica PCIe DMA per round; ``jnp.asarray``
+is the same mistake in the upload direction (a staging copy where the
+handoff would have adopted the buffer), and ``.tobytes()`` on a device
+array is a readback AND a copy.
+
+``device-host-roundtrip`` makes the boundary structural: in the modules
+listed below (plus the device-resident exchange methods of
+``parallel/tcp.py``), every ``np.asarray``/``numpy.asarray``/
+``jnp.asarray`` call and every ``.tobytes()`` attribute call is an
+error unless annotated with the standard suppression grammar and a
+reason (``# dpwalint: ignore[device-host-roundtrip] -- why this
+crossing is the boundary``).  ``handoff.to_host`` itself carries the
+one sanctioned ignore — it IS the boundary.
+
+AST-level honesty: the checker cannot type the operand, so it flags the
+*call form*, not proven device arrays.  That is deliberate — on these
+few modules every ``asarray`` is either the seam (route it through the
+handoff) or a host-side construction that reads identically as
+``np.array``/``np.frombuffer``, so the rule stays high-signal.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Sequence
+
+from dpwa_tpu.analysis.core import Finding, SourceFile
+
+# Modules that ARE the merge path: everything under the device engine
+# package.  handoff.py is included on purpose — its to_host is the one
+# sanctioned readback and carries the one sanctioned suppression.
+_MERGE_PATH_MARKERS = (
+    "dpwa_tpu/device/",
+)
+
+# In parallel/tcp.py only the device-resident exchange methods are
+# merge path; the host exchange() legitimately lives in numpy.
+_TCP_MARKER = "parallel/tcp.py"
+_TCP_FUNCTION_PREFIX = "exchange_on_device"
+
+# numpy/jax module aliases whose ``.asarray`` is a seam crossing.
+_ASARRAY_OWNERS = ("np", "numpy", "jnp")
+
+
+def _norm(path: str) -> str:
+    return path.replace("\\", "/")
+
+
+def _enclosing_functions(tree: ast.AST) -> Dict[int, str]:
+    """line -> name of the innermost def containing it (module-level
+    lines are absent).  Later (deeper) defs overwrite their enclosing
+    def's lines, so the innermost name wins."""
+    spans: Dict[int, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            end = getattr(node, "end_lineno", node.lineno)
+            for line in range(node.lineno, end + 1):
+                spans[line] = node.name
+    return spans
+
+
+class DeviceRoundtripChecker:
+    name = "device-roundtrip"
+    rules = ("device-host-roundtrip",)
+
+    def check(self, files: Sequence[SourceFile]) -> List[Finding]:
+        out: List[Finding] = []
+        for src in files:
+            if src.tree is None:
+                continue
+            path = _norm(src.path)
+            on_device_pkg = any(m in path for m in _MERGE_PATH_MARKERS)
+            on_tcp = _TCP_MARKER in path
+            if not (on_device_pkg or on_tcp):
+                continue
+            owners = _enclosing_functions(src.tree)
+            for node in ast.walk(src.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                fn = node.func
+                if (
+                    isinstance(fn, ast.Attribute)
+                    and fn.attr == "asarray"
+                    and isinstance(fn.value, ast.Name)
+                    and fn.value.id in _ASARRAY_OWNERS
+                ):
+                    what = f"{fn.value.id}.asarray(...)"
+                elif isinstance(fn, ast.Attribute) and fn.attr == "tobytes":
+                    what = ".tobytes()"
+                else:
+                    continue
+                sym = owners.get(node.lineno, "<module>")
+                if on_tcp and not sym.startswith(_TCP_FUNCTION_PREFIX):
+                    continue
+                out.append(Finding(
+                    "device-host-roundtrip", src.path, node.lineno,
+                    f"{sym}:{what}",
+                    f"{what} on the merge path is a device-host "
+                    "round-trip — route uploads through "
+                    "dpwa_tpu.device.handoff.to_device and readbacks "
+                    "through handoff.to_host (the one sanctioned "
+                    "boundary), or justify the crossing with an inline "
+                    "ignore and a reason",
+                ))
+        return out

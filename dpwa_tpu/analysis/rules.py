@@ -65,6 +65,13 @@ RULE_DESCRIPTIONS = {
         "decode and serve through memoryviews/np views, or justify "
         "the copy with an inline ignore"
     ),
+    # device-host round-trip checker
+    "device-host-roundtrip": (
+        "no np.asarray/jnp.asarray/.tobytes() crossings in merge-path "
+        "modules — uploads go through device.handoff.to_device, "
+        "readbacks through handoff.to_host, or justify the crossing "
+        "with an inline ignore"
+    ),
     # the framework's own hygiene rule
     "dpwalint-annotation": (
         "dpwalint directives must be well-formed, with reasons where "

@@ -1,0 +1,257 @@
+"""Fused decode+lerp kernels and the keyed jit cache behind them.
+
+One jitted program per codec family, compiled once per
+``(family, shape, dtype[, extras])`` key and held in an explicit LRU
+(:class:`JitCache`) — the replacement for the single-slot
+``_LERP_CACHE`` that used to live in ``parallel/tcp.py`` and silently
+served every shape from one compilation.  alpha always arrives as a
+TRACED f32 scalar, so one compiled program serves every interpolation
+value, and the ``(1-α)`` subtraction rounds in f32 — the exact
+discipline that makes every kernel here bit-identical to the host
+reference (``native.merge_out``'s single-pass axpy; both contract the
+lerp's mul+add the same way, verified by tests/test_device_engine.py).
+
+Families (docs/device.md "Kernel families"):
+
+- ``dense``     — ``(1-t)·a + t·b`` over the full replica (f32 wire).
+- ``bf16``      — the wire's u16 view bitcast to bf16 and upcast
+  IN-KERNEL, fused into the lerp: the host-side ``astype(np.float32)``
+  copy a bf16 frame used to pay disappears.
+- ``int8``      — dequant-lerp: per-chunk scale expansion
+  (``q.f32 · s[chunk]``, CHUNK=256, zero-padded in-graph) fused into
+  the lerp; the dense f32 remote never exists anywhere.
+- ``topk``      — scatter-lerp: self-lerp the full vector, overwrite
+  the k support coordinates with their gathered lerp.  Off-support
+  coordinates get ``(1-α)x + αx`` — deliberately, because that is what
+  the reference merge of the DENSIFIED estimate computes (the estimate
+  equals the local value there, so the expressions agree elementwise
+  and bit-identity holds) — while the scatter shrinks from full-width
+  to k elements.
+- ``shard``     — dynamic-slice lerp over ``[lo, lo+m)``: only the
+  shipped slice is lerped; the other k−1 slices pass through the
+  ``dynamic_update_slice`` untouched, preserving the slice-only merge
+  invariant structurally (``ops/shard.py`` module docstring).
+- ``shard_topk``— top-k-within-shard: scatter into the slice, lerp the
+  slice, splice back.  Composes the two sparse families without a
+  densified slice on either side of the seam.
+- ``fold``      — batched multi-peer fold: k remotes applied as k
+  IN-GRAPH sequential lerps in one dispatch.  The unrolled loop keeps
+  the op order of k separate dispatches, so a fold is bit-identical to
+  the sequential merges it replaces while paying one dispatch + zero
+  intermediate readbacks.
+
+Local-operand donation: on non-CPU backends every kernel donates its
+first argument, so the device-resident replica updates in place (XLA
+reuses the buffer).  The CPU client ignores donation with a warning, so
+it is requested only where it works.
+
+jax imports live inside the builders — this module must be importable
+without a backend (same contract as ``parallel/tcp.py``).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Callable, Tuple
+
+# int8 codec chunk size — must match ops/quantize.py's CHUNK.
+_CHUNK = 256
+
+# Compiled programs kept per engine cache.  Gossip touches a handful of
+# (shape, codec) keys per run (one replica shape x a few codecs x the
+# fold widths seen), so a small LRU holds the working set while a
+# reshard or model swap can still retire dead compilations.
+DEFAULT_CACHE_CAPACITY = 32
+
+
+class JitCache:
+    """Keyed LRU of compiled kernels with hit/miss accounting.
+
+    ``get(key, build)`` returns the cached callable for ``key`` or
+    builds, caches, and returns it — evicting the least-recently-used
+    entry past ``capacity``.  Hits/misses feed the
+    ``jit_cache_hits``/``jit_cache_misses`` health columns: a miss per
+    round means shapes are churning and every round pays a compile."""
+
+    def __init__(self, capacity: int = DEFAULT_CACHE_CAPACITY):
+        self._capacity = max(int(capacity), 1)
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[tuple, Callable]" = OrderedDict()
+        self._hits = 0
+        self._misses = 0
+
+    def get(self, key: tuple, build: Callable[[], Callable]) -> Callable:
+        with self._lock:
+            fn = self._entries.get(key)
+            if fn is not None:
+                self._entries.move_to_end(key)
+                self._hits += 1
+                return fn
+            self._misses += 1
+        # Build OUTSIDE the lock: tracing/compiling can take seconds and
+        # must not serialize unrelated shapes.  A racing duplicate build
+        # is harmless — last writer wins, both callables are correct.
+        fn = build()
+        with self._lock:
+            self._entries[key] = fn
+            self._entries.move_to_end(key)
+            while len(self._entries) > self._capacity:
+                self._entries.popitem(last=False)
+        return fn
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "hits": self._hits,
+                "misses": self._misses,
+                "entries": len(self._entries),
+                "capacity": self._capacity,
+            }
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+
+
+def _donate_argnums() -> Tuple[int, ...]:
+    """Donate the local replica's buffer where the backend honors it;
+    the CPU client ignores donation (with a UserWarning per program),
+    so request it only off-CPU."""
+    import jax
+
+    return (0,) if jax.default_backend() != "cpu" else ()
+
+
+def build_dense(n: int) -> Callable:
+    """``lerp(a, b, t)`` over ``n`` f32 elements."""
+    import jax
+
+    del n  # shape is the cache key; the trace specializes on operands
+
+    def _k(a, b, t):
+        return (1.0 - t) * a + t * b
+
+    return jax.jit(_k, donate_argnums=_donate_argnums())
+
+
+def build_bf16(n: int) -> Callable:
+    """``lerp(a, upcast(b_u16), t)``: the remote crosses as its raw u16
+    wire view; bitcast+upcast runs fused in-graph (exact — bf16→f32 is
+    widening), replacing the host-side upcast copy."""
+    import jax
+    import jax.numpy as jnp
+
+    del n
+
+    def _k(a, b_u16, t):
+        b = jax.lax.bitcast_convert_type(b_u16, jnp.bfloat16).astype(
+            jnp.float32
+        )
+        return (1.0 - t) * a + t * b
+
+    return jax.jit(_k, donate_argnums=_donate_argnums())
+
+
+def build_int8(n: int, chunks: int) -> Callable:
+    """Fused dequant-lerp: ``q`` crosses as the wire's int8 block,
+    ``s`` as the f32 scale table; the per-chunk expansion
+    (``ops/quantize.py`` layout, CHUNK=256, zero-pad in-graph) feeds the
+    lerp directly — the dense f32 remote is never materialized, on
+    either side of the seam."""
+    import jax
+    import jax.numpy as jnp
+
+    def _k(a, q, s, t):
+        pad = chunks * _CHUNK - n
+        qp = jnp.pad(q, (0, pad)) if pad else q
+        deq = (
+            qp.astype(jnp.float32).reshape(chunks, _CHUNK) * s[:, None]
+        ).reshape(-1)[:n]
+        return (1.0 - t) * a + t * deq
+
+    return jax.jit(_k, donate_argnums=_donate_argnums())
+
+
+def build_topk(n: int, k: int) -> Callable:
+    """Scatter-lerp matching the reference merge of the densified
+    estimate bit-for-bit.  Off the support the estimate equals the
+    local value, so ``lerp(a, est)`` there is elementwise the self-lerp
+    ``(1-t)·a + t·a`` — computing it that way and scattering only the k
+    gathered lerps (indices validated sorted/unique/in-range by the
+    codec decoder) gives the same bits as a full-width
+    scatter-then-lerp while touching k elements instead of n in the
+    scatter (XLA:CPU scatters are scalar loops; see docs/device.md)."""
+    import jax
+
+    del n, k
+
+    def _k(a, idx, v, t):
+        base = (1.0 - t) * a + t * a
+        merged_v = (1.0 - t) * a[idx] + t * v
+        return base.at[idx].set(
+            merged_v, indices_are_sorted=True, unique_indices=True
+        )
+
+    return jax.jit(_k, donate_argnums=_donate_argnums())
+
+
+def build_shard(n: int, m: int) -> Callable:
+    """Dynamic-slice lerp: lerp ONLY ``[lo, lo+m)``, splice back.  The
+    k−1 unshipped slices ride through ``dynamic_update_slice``
+    bit-identically — the slice-only merge invariant is structural, not
+    a host-side copy discipline."""
+    import jax
+
+    del n
+
+    def _k(a, r, lo, t):
+        seg = jax.lax.dynamic_slice(a, (lo,), (m,))
+        merged = (1.0 - t) * seg + t * r
+        return jax.lax.dynamic_update_slice(a, merged, (lo,))
+
+    return jax.jit(_k, donate_argnums=_donate_argnums())
+
+
+def build_shard_topk(n: int, m: int, k: int) -> Callable:
+    """Top-k within a shard: scatter the k values into the ``[lo,
+    lo+m)`` slice, lerp the slice, splice back — no densified slice on
+    the host, no dense intermediate on the device."""
+    import jax
+
+    del n, k
+
+    def _k(a, idx, v, lo, t):
+        seg = jax.lax.dynamic_slice(a, (lo,), (m,))
+        base = (1.0 - t) * seg + t * seg
+        merged_v = (1.0 - t) * seg[idx] + t * v
+        merged = base.at[idx].set(
+            merged_v, indices_are_sorted=True, unique_indices=True
+        )
+        return jax.lax.dynamic_update_slice(a, merged, (lo,))
+
+    return jax.jit(_k, donate_argnums=_donate_argnums())
+
+
+def build_fold(n: int, k: int) -> Callable:
+    """Batched k-peer fold: ``k`` sequential lerps IN-GRAPH —
+    ``x ← (1-t_i)·x + t_i·r_i`` in arrival order — so one dispatch
+    reproduces k sequential merges while the replica never surfaces
+    between them.  ``lax.scan`` (not a Python unroll): the carry is a
+    fusion barrier per step, so each lerp contracts exactly like a
+    standalone dispatch would — an unrolled loop lets XLA fuse ACROSS
+    steps and drifts a ulp off the sequential reference."""
+    import jax
+    import jax.numpy as jnp
+
+    del n, k
+
+    def _k(a, ts, *remotes):
+        def body(x, rt):
+            r, t = rt
+            return (1.0 - t) * x + t * r, None
+
+        x, _ = jax.lax.scan(body, a, (jnp.stack(remotes), ts))
+        return x
+
+    return jax.jit(_k, donate_argnums=_donate_argnums())

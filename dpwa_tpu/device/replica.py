@@ -1,0 +1,66 @@
+"""Device-resident replica handle with a lazy host mirror.
+
+``exchange_on_device`` used to pay ``np.asarray(vec_dev)`` — a full
+d2h readback — at the TOP of every round, merged or skipped, because
+the publish leg needs host bytes.  :class:`DeviceReplica` makes the
+readback lazy and versioned instead: the replica lives on the device,
+``host()`` materializes the mirror through
+:func:`~dpwa_tpu.device.handoff.to_host` only when the device state has
+changed since the last readback, and a skipped round (self-pair,
+masked, timeout — the common case on a sparse schedule) republishes
+from the cached mirror for free.  ``swap()`` is the single mutation
+point: the merge engine's output replaces ``dev`` and invalidates the
+mirror, so staleness is impossible by construction — there is no
+"refresh" call to forget.
+
+The mirror is held immutable by the same convention as every decoded
+frame view: publish encodes FROM it, trust/guard compare AGAINST it,
+nobody writes it.  On the CPU backend it aliases the device buffer
+(free); on a real device it is the one d2h DMA a publish boundary
+costs, paid at most once per merge.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from dpwa_tpu.device import handoff
+
+
+class DeviceReplica:
+    """One worker's device-resident replica across gossip rounds."""
+
+    __slots__ = ("dev", "_mirror", "_readbacks", "_mirror_hits")
+
+    def __init__(self, dev):
+        self.dev = dev
+        self._mirror: Optional[np.ndarray] = None
+        self._readbacks = 0
+        self._mirror_hits = 0
+
+    def host(self) -> np.ndarray:
+        """The host mirror — read back only if a merge landed since the
+        last call (the lazy-readback contract; docs/device.md
+        "Readback boundaries")."""
+        if self._mirror is None:
+            self._mirror = handoff.to_host(self.dev)
+            self._readbacks += 1
+        else:
+            self._mirror_hits += 1
+        return self._mirror
+
+    def swap(self, new_dev) -> None:
+        """Adopt the merge output as the current replica.  The old
+        device buffer stays alive as long as escaped mirrors/views
+        reference it — dropping the handle here never invalidates a
+        host view already handed to publish or trust."""
+        self.dev = new_dev
+        self._mirror = None
+
+    def stats(self) -> dict:
+        return {
+            "readbacks": self._readbacks,
+            "mirror_hits": self._mirror_hits,
+        }

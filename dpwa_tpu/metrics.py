@@ -211,6 +211,11 @@ class MetricsLogger:
         - ``copies_per_frame`` / ``ring_occupancy`` — the zero-copy
           frame path's decode-copy tally and receive-ring occupancy
           (ride the wire group when the snapshot carries them);
+        - ``device_rounds`` / ``jit_cache_hits`` / ``jit_cache_misses``
+          / ``device_dispatches_per_round`` / ``h2d_zero_copy_frac`` /
+          ``fold_frames`` — the device merge engine's jit-cache and
+          dispatch accounting (present only once a device-resident
+          exchange has served a round, docs/device.md);
         - ``disagreement_rms`` / ``disagreement_rel`` / ``sketch_peers``
           — the obs plane's sketch-based ring-disagreement estimate
           (present only when ``obs.sketch`` is on);
@@ -303,6 +308,24 @@ class MetricsLogger:
                     overlap_hidden_frac=overlap.get("hidden_frac"),
                     overlap_prefetched=overlap.get("prefetched"),
                     overlap_straddled=overlap.get("straddled"),
+                )
+            device = wire.get("device")
+            if device is not None and device.get("device_rounds"):
+                # Device merge engine columns (docs/device.md; absent
+                # until a device-resident exchange has served a round,
+                # keeping host-only records byte-identical): jit-cache
+                # health, fused dispatches per round, and the fraction
+                # of host→device crossings that were pointer adoptions.
+                extra = dict(
+                    extra,
+                    device_rounds=device.get("device_rounds"),
+                    jit_cache_hits=device.get("jit_cache_hits"),
+                    jit_cache_misses=device.get("jit_cache_misses"),
+                    device_dispatches_per_round=device.get(
+                        "device_dispatches_per_round"
+                    ),
+                    h2d_zero_copy_frac=device.get("h2d_zero_copy_frac"),
+                    fold_frames=device.get("fold_frames"),
                 )
             shard = wire.get("shard")
             if shard is not None:

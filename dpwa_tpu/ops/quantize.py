@@ -277,6 +277,25 @@ def decode_int8_payload(buf: np.ndarray) -> np.ndarray:
     return dequantize_np(q, scale)
 
 
+def int8_payload_views(buf: np.ndarray):
+    """``(n, scales_view, q_view)`` of an int8 wire body WITHOUT
+    dequantizing — the raw operands of the device engine's fused
+    dequant-lerp kernel (dpwa_tpu/device/kernels.py), validated exactly
+    like :func:`decode_int8_payload` but with the dense f32 output never
+    materialized: both returned arrays are views into ``buf``."""
+    raw = np.ascontiguousarray(buf, dtype=np.uint8)
+    if raw.size < 8:
+        raise ValueError("int8 wire payload shorter than its length field")
+    n = int(raw[:8].view("<u8")[0])
+    k = _n_chunks(n)
+    if raw.size != 8 + 4 * k + n:
+        raise ValueError(
+            f"int8 wire payload size {raw.size} != {8 + 4 * k + n} "
+            f"expected for n={n}"
+        )
+    return n, _le_view(raw[8:8 + 4 * k], "<f4"), raw[8 + 4 * k:].view(np.int8)
+
+
 # --------------------------------------------------------------------------
 # Top-k delta codec (TCP wire payload code 5)
 # --------------------------------------------------------------------------

@@ -38,6 +38,7 @@ top-k f32 views, 1 for an int8 dequantize or a bf16 upcast).
 
 from __future__ import annotations
 
+import ctypes
 import errno
 import socket
 import threading
@@ -52,6 +53,23 @@ _MIN_CLASS = 4096
 # dropped and the allocator reclaims them.  Gossip is one frame per
 # peer per round, so a handful per class covers hedged + prefetch legs.
 _MAX_FREE_PER_CLASS = 4
+# Lease views start 64-byte aligned (one cacheline): dense f32 payloads
+# land at offset 0 of their lease, so the decoded vector view is dlpack-
+# eligible and crosses to the device by pointer adoption instead of a
+# staging copy (dpwa_tpu/device/handoff.py's ALIGN — the two constants
+# are the same contract).  bytearray gives no alignment promise of its
+# own (pymalloc is 8-byte, large mallocs 16), so each pooled buffer
+# carries LEASE_ALIGN slack and the lease view starts at the first
+# aligned byte.
+LEASE_ALIGN = 64
+
+
+def _aligned_offset(buf: bytearray) -> int:
+    """Offset of the first LEASE_ALIGN-aligned byte of ``buf`` (stable
+    for the buffer's lifetime — CPython never relocates a bytearray's
+    storage unless it is resized, and pooled buffers never are)."""
+    base = ctypes.addressof(ctypes.c_char.from_buffer(buf))
+    return (-base) % LEASE_ALIGN
 
 
 def recv_exact_into(
@@ -103,7 +121,8 @@ class Lease:
     def __init__(self, ring: "BufferRing", buf: bytearray, n: int) -> None:
         self._ring = ring
         self._buf = buf
-        self.view = memoryview(buf)[:n]
+        off = _aligned_offset(buf)
+        self.view = memoryview(buf)[off:off + n]
         self._done = False
 
     def release(self) -> None:
@@ -127,7 +146,9 @@ class BufferRing:
     """Size-classed pool of receive buffers (powers of two ≥ 4 KiB).
 
     ``lease(n)`` hands back a :class:`Lease` whose ``view`` is exactly
-    ``n`` bytes of a pooled (or freshly allocated) buffer.  Stats feed
+    ``n`` bytes of a pooled (or freshly allocated) buffer, starting on
+    a ``LEASE_ALIGN`` boundary (the device-handoff dlpack contract —
+    each buffer carries the slack to guarantee it).  Stats feed
     the ``ring_occupancy`` health column: occupancy is the fraction of
     ring-managed bytes currently leased out — near zero when fetchers
     release promptly, climbing when decoded views pin buffers."""
@@ -165,11 +186,13 @@ class BufferRing:
                 self._misses += 1
             self._leased_bytes += size
         if buf is None:
-            buf = bytearray(size)
+            # LEASE_ALIGN slack so the lease view can start on the first
+            # aligned byte whatever base address the allocator hands out.
+            buf = bytearray(size + LEASE_ALIGN)
         return Lease(self, buf, n)
 
     def _put(self, buf: bytearray) -> None:
-        size = len(buf)
+        size = len(buf) - LEASE_ALIGN
         with self._lock:
             self._leased_bytes -= size
             pool = self._free.setdefault(size, [])
@@ -178,11 +201,15 @@ class BufferRing:
 
     def _forget(self, buf: bytearray) -> None:
         with self._lock:
-            self._leased_bytes -= len(buf)
+            self._leased_bytes -= len(buf) - LEASE_ALIGN
 
     def stats(self) -> dict:
         with self._lock:
-            pooled = sum(len(b) for p in self._free.values() for b in p)
+            pooled = sum(
+                len(b) - LEASE_ALIGN
+                for p in self._free.values()
+                for b in p
+            )
             leased = self._leased_bytes
             total = leased + pooled
             return {

@@ -33,7 +33,7 @@ import socket
 import threading
 import time
 import zlib
-from typing import Dict, Optional, Tuple
+from typing import Dict, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -1518,25 +1518,17 @@ class _OverlappedExchange:
         return merged, alpha, self.partner
 
 
-# Jitted on first use, never at import: this module stays importable (and
-# its CPU exchange usable) without touching a JAX backend — bench.py's TCP
-# leg runs it in a backend-pinned subprocess for exactly that reason.
-_LERP_CACHE = []
+# Device-side merging lives in dpwa_tpu/device/ (docs/device.md): the
+# single-slot jitted lerp that used to sit here (_LERP_CACHE) became the
+# engine's keyed LRU jit cache, and the per-frame jnp.asarray upload
+# became the zero-copy handoff.  The import stays deferred to the device
+# substrates so this module remains importable (and its CPU exchange
+# usable) without touching a JAX backend — bench.py's TCP leg runs it in
+# a backend-pinned subprocess for exactly that reason.
+def _merge_engine():
+    from dpwa_tpu.device import default_engine
 
-
-def _device_lerp(local_dev, remote_host: np.ndarray, alpha: float):
-    """On-device ``(1-alpha)*local + alpha*remote``; uploads the fetched
-    host vector to the local replica's device.  alpha arrives as a traced
-    argument, so one compiled program serves every interpolation value."""
-    import jax
-
-    if not _LERP_CACHE:
-        _LERP_CACHE.append(
-            jax.jit(lambda a, b, t: (1.0 - t) * a + t * b)
-        )
-    import jax.numpy as jnp
-
-    return _LERP_CACHE[0](local_dev, jnp.asarray(remote_host), alpha)
+    return default_engine()
 
 
 class TcpTransport:
@@ -1640,6 +1632,19 @@ class TcpTransport:
         # NOT x in f32).  None for dense/topk/full-vector fetches.
         # dpwalint: double_buffered(_pending_shard) -- written by the fetch leg alongside _pending_trust_scale before finish() joins it; the merge reads strictly after the join
         self._pending_shard: Optional[Tuple[int, int]] = None
+        # Device merge mode (docs/device.md): exchange_on_device flips
+        # _sparse_consume around its _round so _consume_fetch keeps
+        # sparse frames SPARSE — no host densify; the fused scatter /
+        # dynamic-slice kernels splice on the device instead.  The
+        # pending support rides next to _pending_shard under the same
+        # double-buffer discipline.
+        # dpwalint: double_buffered(_pending_topk) -- written by the fetch leg alongside _pending_shard before finish() joins it; the device merge reads strictly after the join
+        self._pending_topk: Optional[Tuple[np.ndarray, np.ndarray]] = None
+        # dpwalint: double_buffered(_sparse_consume) -- flipped by the round driver strictly before the fetch starts and restored strictly after finish() joins it; the fetch leg only reads inside that window
+        self._sparse_consume = False
+        # Device-resident replica handle, cached across rounds so the
+        # host mirror (lazy readback) survives between exchanges.
+        self._dev_replica = None
         # Per-shard wire accounting under _stats_lock: frames and bytes
         # per shard index, behind wire_snapshot()["shard"] and the
         # health_report --wire coverage columns.
@@ -2253,6 +2258,7 @@ class TcpTransport:
         # a successfully decoded shard frame below overwrites it with
         # its [lo, hi) before finish() joins the round.
         self._pending_shard = None
+        self._pending_topk = None
         if got is not None and not isinstance(got[0], np.ndarray):
             t_stage = time.monotonic() if timing else 0.0
             # Sparse frame: fetch_blob_full returns the decoded payload
@@ -2300,9 +2306,15 @@ class TcpTransport:
                     # byzantine signal k-fold.
                     trust_local = local_slice
                     trust_remote = est_slice
-                    remote = lv.astype(np.float32, copy=True)
-                    remote[lo:hi] = est_slice
-                    got = (remote, got[1], got[2])
+                    if self._sparse_consume:
+                        # Device merge: ship the m-sized slice estimate
+                        # straight to the dynamic-slice kernel — the
+                        # full-vector densified copy never exists.
+                        got = (est_slice, got[1], got[2])
+                    else:
+                        remote = lv.astype(np.float32, copy=True)
+                        remote[lo:hi] = est_slice
+                        got = (remote, got[1], got[2])
                     self._pending_shard = (lo, hi)
             elif lv is None or int(lv.size) != int(sp.n):
                 got = None
@@ -2310,7 +2322,20 @@ class TcpTransport:
             else:
                 codec = "topk"
                 local_sel = lv[sp.indices.astype(np.intp)]
-                got = (sp.densify(lv), got[1], got[2])
+                if self._sparse_consume:
+                    # Device merge: keep the support sparse for the
+                    # scatter-lerp kernel.  Trust still screens the
+                    # frame on its SUPPORT via payload_stats_sparse —
+                    # the dense remote argument is only a shape check
+                    # there, so the local replica stands in for the
+                    # densified estimate bit-identically.  The guard
+                    # judges the shipped values (sparse_guard) rather
+                    # than a densified vector it would have to build.
+                    got = (sp.values, got[1], got[2])
+                    self._pending_topk = (sp.indices, sp.values)
+                    trust_remote = lv
+                else:
+                    got = (sp.densify(lv), got[1], got[2])
                 sparse_guard = (sp.values, local_sel)
                 sparse_trust = (sp.indices, sp.values)
             if timing:
@@ -2833,9 +2858,15 @@ class TcpTransport:
             # Present exactly when the reactor serves this node, so
             # threaded runs keep their health records byte-identical.
             snap["reactor"] = reactor_snap()
-        if self._wire_topk or self._prefetch_on or self._shard_on:
-            # Gated on the new planes being ON: a dense sequential run
-            # keeps its health records byte-identical to PR 5.
+        from dpwa_tpu.device import device_snapshot as _device_snapshot
+
+        if (
+            self._wire_topk or self._prefetch_on or self._shard_on
+            or _device_snapshot()["device_rounds"] > 0
+        ):
+            # Gated on the new planes being ON (or the device merge
+            # engine having served a round): a dense sequential host
+            # run keeps its health records byte-identical to PR 5.
             snap["wire"] = self.wire_snapshot()
         if self.tracer is not None or self.sketchboard is not None:
             snap["obs"] = self.obs_snapshot()
@@ -2889,6 +2920,23 @@ class TcpTransport:
             # fraction of ring bytes currently leased out.
             "copies_per_frame": round(zc["copies_per_frame"], 4),
             "ring_occupancy": round(zc["ring_occupancy"], 4),
+        }
+        # Device-plane accounting (process-wide, like the receive ring):
+        # the merge engine's jit cache and dispatch tallies, plus the
+        # zero-copy fraction of host→device crossings.  All zeros until
+        # a device exchange runs; never imports a JAX backend.
+        from dpwa_tpu.device import device_snapshot
+
+        dv = device_snapshot()
+        out["device"] = {
+            "device_rounds": dv["device_rounds"],
+            "jit_cache_hits": dv["jit_cache_hits"],
+            "jit_cache_misses": dv["jit_cache_misses"],
+            "device_dispatches_per_round": dv[
+                "device_dispatches_per_round"
+            ],
+            "h2d_zero_copy_frac": round(dv["h2d_zero_copy_frac"], 4),
+            "fold_frames": dv["fold_frames"],
         }
         if self._wire_topk:
             out["topk_fraction"] = self.config.protocol.topk_fraction
@@ -3135,9 +3183,15 @@ class TcpTransport:
             # bit-exact no-op) so a small island doesn't overcommit to
             # its own consensus before the heal.
             alpha *= self.membership.alpha_scale()
-        if ml_dtypes is not None and remote_vec.dtype == _DTYPES[3]:
+        if (
+            not self._sparse_consume
+            and ml_dtypes is not None
+            and remote_vec.dtype == _DTYPES[3]
+        ):
             # bf16 off the wire: upcast once, merge in f32 (same math as
-            # the ICI transport's bf16-wire merge).
+            # the ICI transport's bf16-wire merge).  The device engine
+            # skips this copy — its bf16 kernel bitcasts and upcasts
+            # in-graph, so the raw u16 wire view crosses the seam as-is.
             remote_vec = remote_vec.astype(np.float32)
         return remote_vec, alpha
 
@@ -3628,21 +3682,152 @@ class TcpTransport:
         This is the reference's free-running async semantics executed on
         the rebuild's actual data plane — each OS process free-runs its
         own device-resident replica — where the lock-step SPMD paths
-        emulate it with masked merges."""
-        host_vec = np.asarray(vec_dev)
-        remote_vec, alpha, partner = self._round(host_vec, clock, loss, step)
-        if remote_vec is None:
-            return vec_dev, alpha, partner
-        if self._pending_shard is not None:
-            # Sharded round: the slice-only merge must keep the k−1
-            # unshipped slices bit-identical, which a full-vector device
-            # lerp cannot (f32 (1-α)x + αx ≠ x).  Merge on the host copy
-            # the publish leg already downloaded, upload the result.
-            import jax.numpy as jnp
+        emulate it with masked merges.
 
-            merged = self._merge_remote(host_vec, remote_vec, alpha)
-            return jnp.asarray(merged), alpha, partner
-        return _device_lerp(vec_dev, remote_vec, alpha), alpha, partner
+        The data plane is the device merge engine (docs/device.md): the
+        publish-side readback is LAZY (a skipped round republishes from
+        the cached host mirror for free), the consume leg keeps sparse
+        frames sparse (``_sparse_consume``), and every codec family
+        merges through one fused kernel — scatter-lerp for top-k,
+        dynamic-slice lerp for shards (the slice-only invariant is
+        structural, no host round-trip), in-kernel bitcast+upcast for
+        bf16 wires."""
+        from dpwa_tpu.device import DeviceReplica, default_engine
+
+        eng = default_engine()
+        rep = self._dev_replica
+        if rep is None or rep.dev is not vec_dev:
+            # A replica the engine didn't produce (first round, or the
+            # caller trained on a fresh array): adopt it; its mirror is
+            # read back once below and cached until the next merge.
+            rep = DeviceReplica(vec_dev)
+            self._dev_replica = rep
+        host_vec = rep.host()
+        self._sparse_consume = True
+        try:
+            remote_vec, alpha, partner = self._round(
+                host_vec, clock, loss, step
+            )
+        finally:
+            self._sparse_consume = False
+        eng.note_round()
+        if remote_vec is None:
+            return rep.dev, alpha, partner
+        if self._pending_topk is not None:
+            idx, vals = self._pending_topk
+            merged = eng.merge_topk(rep.dev, idx, vals, alpha)
+        elif self._pending_shard is not None:
+            # remote_vec IS the m-sized slice estimate (the consume leg
+            # never densified); the kernel lerps [lo, lo+m) in-graph and
+            # rides the other k−1 slices through bit-identically.
+            lo, _hi = self._pending_shard
+            merged = eng.merge_shard(rep.dev, lo, remote_vec, alpha)
+        elif ml_dtypes is not None and remote_vec.dtype == _DTYPES[3]:
+            merged = eng.merge_bf16(rep.dev, remote_vec, alpha)
+        else:
+            if remote_vec.dtype != np.float32:
+                remote_vec = remote_vec.astype(np.float32)
+            merged = eng.merge_dense(rep.dev, remote_vec, alpha)
+        rep.swap(merged)
+        return merged, alpha, partner
+
+    def exchange_on_device_fold(
+        self, vec_dev, clock: float, loss: float, step: int,
+        peers: Sequence[int],
+    ):
+        """Fan-in round: fetch a frame from EACH listed peer and fold
+        every accepted one into the device replica, batching runs of
+        consecutive dense frames into single ``fold`` dispatches.
+
+        Where :meth:`exchange_on_device` is the schedule-driven pairwise
+        round (one partner, one frame), this is the explicit fan-in the
+        batched-fold kernel exists for: hedged/prefetch legs or an
+        experiment harness that drains several ready peers at once.
+        Each frame still runs the full consume leg — decode, guard,
+        trust screen, scoreboard — exactly as a pairwise round would,
+        and the result is bit-identical to applying the accepted frames
+        as sequential :meth:`exchange_on_device` merges in arrival
+        order (the fold kernel's ``lax.scan`` contract).  Sparse and
+        bf16 frames break a dense run and dispatch their own fused
+        kernel, preserving arrival order.
+
+        Returns ``(merged_device_vec, merges)`` where ``merges`` is the
+        arrival-ordered list of ``(peer, alpha)`` actually applied."""
+        from dpwa_tpu.device import DeviceReplica, default_engine
+
+        eng = default_engine()
+        rep = self._dev_replica
+        if rep is None or rep.dev is not vec_dev:
+            rep = DeviceReplica(vec_dev)
+            self._dev_replica = rep
+        self.publish(rep.host(), clock, loss)
+        frames = []  # (kind, payload, peer, alpha) in arrival order
+        self._sparse_consume = True
+        try:
+            for peer in peers:
+                if peer == self.me:
+                    continue
+                got = self.fetch(peer, step=step)
+                if got is None:
+                    continue
+                remote_vec, alpha = self._weigh_remote(got, clock, loss)
+                if self._pending_topk is not None:
+                    frames.append(
+                        ("topk", self._pending_topk, peer, alpha)
+                    )
+                elif self._pending_shard is not None:
+                    frames.append((
+                        "shard",
+                        (self._pending_shard[0], remote_vec),
+                        peer, alpha,
+                    ))
+                elif (
+                    ml_dtypes is not None
+                    and remote_vec.dtype == _DTYPES[3]
+                ):
+                    frames.append(("bf16", remote_vec, peer, alpha))
+                else:
+                    if remote_vec.dtype != np.float32:
+                        remote_vec = remote_vec.astype(np.float32)
+                    frames.append(("dense", remote_vec, peer, alpha))
+        finally:
+            self._sparse_consume = False
+            self._membership_end_round(step)
+        merged = rep.dev
+        merges = [(peer, alpha) for _, _, peer, alpha in frames]
+        run_r: list = []
+        run_a: list = []
+
+        def _flush_dense():
+            nonlocal merged
+            if not run_r:
+                return
+            if len(run_r) == 1:
+                merged = eng.merge_dense(merged, run_r[0], run_a[0])
+            else:
+                merged = eng.fold(merged, list(run_r), list(run_a))
+            run_r.clear()
+            run_a.clear()
+
+        for kind, payload, _peer, alpha in frames:
+            if kind == "dense":
+                run_r.append(payload)
+                run_a.append(alpha)
+                continue
+            _flush_dense()
+            if kind == "topk":
+                idx, vals = payload
+                merged = eng.merge_topk(merged, idx, vals, alpha)
+            elif kind == "shard":
+                lo, est_slice = payload
+                merged = eng.merge_shard(merged, lo, est_slice, alpha)
+            else:
+                merged = eng.merge_bf16(merged, payload, alpha)
+        _flush_dense()
+        eng.note_round()
+        if merged is not rep.dev:
+            rep.swap(merged)
+        return merged, merges
 
     def close(self) -> None:
         if self.flight is not None:

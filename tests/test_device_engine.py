@@ -1,0 +1,489 @@
+"""Device merge engine tests (docs/device.md).
+
+The acceptance contract, in test form:
+
+- every fused kernel family is BIT-identical to the host reference
+  merge (``native.merge_out`` / ``_host_merge``) — dense f32, bf16
+  in-kernel upcast, int8 fused dequant, top-k scatter, shard
+  dynamic-slice, top-k-within-shard;
+- a batched k-fold equals k sequential merges bit-exactly (the
+  ``lax.scan`` carry-barrier contract);
+- the transport's device exchange produces the same bits as its host
+  exchange for every codec × shard × trailer combination, on both Rx
+  servers;
+- the guard still rejects sick sparse frames in device mode (where the
+  densified vector never exists to judge);
+- the replica stays device-resident: a skipped round republishes from
+  the cached mirror with zero extra readbacks;
+- the merge leg allocates O(header) host memory, not O(payload)
+  (tracemalloc — the densify copies really are gone);
+- the jit cache is a real keyed LRU with hit/miss accounting.
+
+Everything runs on the forced-CPU backend (``JAX_PLATFORMS=cpu``) —
+bit-identity between XLA's lerp and the native axpy holds there, which
+is exactly why the engine can promise it.
+"""
+
+import tracemalloc
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from dpwa_tpu import native
+from dpwa_tpu.config import make_local_config
+from dpwa_tpu.device import (
+    DeviceReplica,
+    JitCache,
+    MergeEngine,
+    device_snapshot,
+    reset_device_stats,
+)
+from dpwa_tpu.device import handoff
+from dpwa_tpu.ops import quantize as qz
+from dpwa_tpu.ops import shard as shard_ops
+from dpwa_tpu.parallel import protocol_constants as pc
+from dpwa_tpu.parallel.tcp import TcpTransport, _host_merge
+
+ALPHAS = (0.5, 0.3, 0.125, 0.9)
+
+
+def _bits(a) -> bytes:
+    return np.ascontiguousarray(np.asarray(a)).tobytes()
+
+
+def _vec(n, seed=0):
+    return (
+        np.random.default_rng(seed).standard_normal(n).astype(np.float32)
+    )
+
+
+# ---------------------------------------------------------------------------
+# Kernel families: bit-identity against the host reference
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("n", [257, 4096, 65_537])
+@pytest.mark.parametrize("alpha", ALPHAS)
+def test_dense_kernel_bit_identical_to_native_axpy(n, alpha):
+    eng = MergeEngine()
+    a, b = _vec(n, 1), _vec(n, 2)
+    ref = native.merge_out(a, b, alpha)
+    got = eng.merge_dense(handoff.to_device(a), b, alpha)
+    assert _bits(got) == _bits(ref)
+
+
+@pytest.mark.parametrize("alpha", ALPHAS)
+def test_bf16_kernel_matches_host_upcast_merge(alpha):
+    ml_dtypes = pytest.importorskip("ml_dtypes")
+    eng = MergeEngine()
+    n = 4096
+    a = _vec(n, 3)
+    r16 = _vec(n, 4).astype(ml_dtypes.bfloat16)
+    ref = _host_merge(a, r16.astype(np.float32), alpha)
+    got = eng.merge_bf16(handoff.to_device(a), r16, alpha)
+    assert _bits(got) == _bits(ref)
+
+
+@pytest.mark.parametrize("n", [256, 1000, 8192])
+@pytest.mark.parametrize("alpha", ALPHAS)
+def test_int8_fused_dequant_matches_decode_then_merge(n, alpha):
+    eng = MergeEngine()
+    a = _vec(n, 5)
+    payload = qz.encode_int8_payload(_vec(n, 6), 7, 3.0, 1)
+    ref = native.merge_out(a, qz.decode_int8_payload(payload), alpha)
+    got = eng.merge_int8(handoff.to_device(a), payload, alpha)
+    assert _bits(got) == _bits(ref)
+
+
+@pytest.mark.parametrize("fraction", [0.01, 0.25])
+@pytest.mark.parametrize("alpha", ALPHAS)
+def test_topk_scatter_matches_densified_reference(fraction, alpha):
+    eng = MergeEngine()
+    n = 16_384
+    a, sender = _vec(n, 8), _vec(n, 9)
+    sp = qz.decode_topk_payload(
+        qz.TopkEncoder(fraction, "f32").encode(sender, 0, 1.0, 0)
+    )
+    # Reference: merge the DENSIFIED estimate over the full vector —
+    # off-support coordinates get (1-α)x + αx, deliberately.
+    ref = native.merge_out(a, sp.densify(a), alpha)
+    got = eng.merge_topk(
+        handoff.to_device(a), sp.indices, sp.values, alpha
+    )
+    assert _bits(got) == _bits(ref)
+
+
+@pytest.mark.parametrize("k", [2, 4, 7])
+@pytest.mark.parametrize("alpha", ALPHAS[:2])
+def test_shard_kernel_matches_host_slice_merge(k, alpha):
+    eng = MergeEngine()
+    n = 12_288
+    a = _vec(n, 10)
+    for shard_idx in range(k):
+        lo, hi = shard_ops.shard_bounds(n, k, shard_idx)
+        est = _vec(hi - lo, 11 + shard_idx)
+        ref = a.copy()
+        ref[lo:hi] = native.merge_out(
+            np.ascontiguousarray(a[lo:hi]), est, alpha
+        )
+        got = eng.merge_shard(handoff.to_device(a), lo, est, alpha)
+        assert _bits(got) == _bits(ref), (k, shard_idx)
+        # The k−1 unshipped slices ride through bit-identically — the
+        # slice-only invariant is structural in the kernel.
+        out = np.asarray(got)
+        assert _bits(out[:lo]) == _bits(a[:lo])
+        assert _bits(out[hi:]) == _bits(a[hi:])
+
+
+@pytest.mark.parametrize("alpha", ALPHAS[:2])
+def test_shard_topk_kernel_matches_host_reference(alpha):
+    eng = MergeEngine()
+    n, k, shard_idx = 8192, 4, 2
+    lo, hi = shard_ops.shard_bounds(n, k, shard_idx)
+    a = _vec(n, 12)
+    sp = qz.decode_topk_payload(
+        qz.TopkEncoder(0.1, "f32").encode(_vec(hi - lo, 13), 0, 1.0, 0)
+    )
+    est = sp.densify(np.ascontiguousarray(a[lo:hi]))
+    ref = a.copy()
+    ref[lo:hi] = native.merge_out(np.ascontiguousarray(a[lo:hi]), est, alpha)
+    got = eng.merge_shard_topk(
+        handoff.to_device(a), lo, hi - lo, sp.indices, sp.values, alpha
+    )
+    assert _bits(got) == _bits(ref)
+
+
+@pytest.mark.parametrize("k", [1, 2, 3, 8])
+def test_fold_bit_identical_to_sequential_merges(k):
+    eng = MergeEngine()
+    n = 10_000
+    a = _vec(n, 14)
+    remotes = [_vec(n, 20 + i) for i in range(k)]
+    alphas = [0.5, 0.3, 0.7, 0.2, 0.9, 0.1, 0.4, 0.6][:k]
+    ref = a
+    for r, t in zip(remotes, alphas):
+        ref = native.merge_out(ref, r, t)
+    got = eng.fold(handoff.to_device(a), remotes, alphas)
+    assert _bits(got) == _bits(ref)
+    # And equals k sequential ENGINE merges (same kernels, k dispatches).
+    seq = handoff.to_device(a)
+    for r, t in zip(remotes, alphas):
+        seq = eng.merge_dense(seq, r, t)
+    assert _bits(got) == _bits(seq)
+
+
+def test_fold_length_mismatch_and_empty():
+    eng = MergeEngine()
+    dev = handoff.to_device(_vec(64))
+    with pytest.raises(ValueError):
+        eng.fold(dev, [_vec(64)], [0.5, 0.5])
+    assert eng.fold(dev, [], []) is dev
+
+
+# ---------------------------------------------------------------------------
+# Jit cache: keyed LRU with accounting
+# ---------------------------------------------------------------------------
+
+
+def test_jit_cache_lru_eviction_and_hit_accounting():
+    cache = JitCache(capacity=2)
+    builds = []
+
+    def make(tag):
+        def build():
+            builds.append(tag)
+            return lambda: tag
+
+        return build
+
+    assert cache.get(("a",), make("a"))() == "a"
+    assert cache.get(("b",), make("b"))() == "b"
+    assert cache.get(("a",), make("a2"))() == "a"  # hit, refreshes LRU
+    assert cache.get(("c",), make("c"))() == "c"   # evicts ("b",)
+    assert cache.get(("b",), make("b2"))() == "b2"
+    snap = cache.snapshot()
+    assert builds == ["a", "b", "c", "b2"]
+    assert snap["hits"] == 1 and snap["misses"] == 4
+    assert snap["entries"] == 2 and snap["capacity"] == 2
+
+
+def test_engine_reuses_compiled_kernels_across_alphas_and_counts():
+    eng = MergeEngine()
+    a = _vec(512)
+    dev = handoff.to_device(a)
+    for alpha in ALPHAS:
+        dev = eng.merge_dense(dev, _vec(512, int(alpha * 100)), alpha)
+    snap = eng.snapshot()
+    # alpha is traced, so ONE compilation serves every value.
+    assert snap["jit_cache_misses"] == 1
+    assert snap["jit_cache_hits"] == len(ALPHAS) - 1
+    assert snap["device_dispatches"] == len(ALPHAS)
+
+
+# ---------------------------------------------------------------------------
+# Transport: device exchange ≡ host exchange, per codec × shard × trailer
+# ---------------------------------------------------------------------------
+
+
+def _make_pair(rx, trailers, **cfg_kwargs):
+    kwargs = dict(
+        schedule="ring", fetch_probability=1.0,
+        interpolation="constant", factor=0.3,
+        rx_server=rx,
+    )
+    if trailers:
+        # Membership digest + obs sketch trailers ride on every frame;
+        # decode must strip them identically on both merge paths.
+        kwargs["membership"] = dict(quorum_fraction=0.5)
+        kwargs["obs"] = dict(sketch=True)
+    kwargs.update(cfg_kwargs)
+    cfg = make_local_config(2, base_port=0, **kwargs)
+    ts = [TcpTransport(cfg, f"node{i}") for i in range(2)]
+    for t in ts:
+        for i, other in enumerate(ts):
+            t.set_peer_port(i, other.port)
+    return ts
+
+
+_CODECS = {
+    "f32": dict(),
+    "bf16": dict(wire_dtype="bf16"),
+    "int8": dict(wire_dtype="int8"),
+    "topk": dict(wire_codec="topk", topk_values="f32"),
+    "topk-int8": dict(wire_codec="topk", topk_values="int8"),
+    "shard-f32": dict(shard=dict(k=4)),
+    "shard-topk": dict(
+        shard=dict(k=4), wire_codec="topk", topk_values="f32"
+    ),
+}
+
+
+@pytest.mark.parametrize("rx", ["threaded", "reactor"])
+@pytest.mark.parametrize("trailers", [False, True], ids=["bare", "trailers"])
+@pytest.mark.parametrize("codec", sorted(_CODECS))
+def test_device_exchange_bit_identical_to_host_exchange(
+    rx, trailers, codec
+):
+    ts = _make_pair(rx, trailers, **_CODECS[codec])
+    try:
+        d = 2048
+        v0, v1 = _vec(d, 30), _vec(d, 31)
+        ts[1].publish(v1, 1.0, 0.5)
+        host_merged, host_alpha, host_partner = ts[0].exchange(
+            v0, 1.0, 0.5, 0
+        )
+        assert host_alpha != 0.0
+        dev_merged, dev_alpha, dev_partner = ts[0].exchange_on_device(
+            jnp.asarray(v0), 1.0, 0.5, 0
+        )
+        assert isinstance(dev_merged, jax.Array)
+        assert (dev_partner, dev_alpha) == (host_partner, host_alpha)
+        assert _bits(dev_merged) == _bits(host_merged), codec
+    finally:
+        for t in ts:
+            t.close()
+
+
+def test_exchange_on_device_fold_matches_sequential_merges():
+    cfg = make_local_config(
+        3, base_port=0, schedule="ring", fetch_probability=1.0,
+        interpolation="constant", factor=0.3,
+    )
+    ts = [TcpTransport(cfg, f"node{i}") for i in range(3)]
+    for t in ts:
+        for i, other in enumerate(ts):
+            t.set_peer_port(i, other.port)
+    try:
+        d = 4096
+        v0, v1, v2 = _vec(d, 40), _vec(d, 41), _vec(d, 42)
+        ts[1].publish(v1, 1.0, 0.5)
+        ts[2].publish(v2, 1.0, 0.5)
+        merged, merges = ts[0].exchange_on_device_fold(
+            jnp.asarray(v0), 1.0, 0.5, 0, peers=[1, 2]
+        )
+        assert [p for p, _ in merges] == [1, 2]
+        ref = v0
+        for peer, alpha in zip((v1, v2), [a for _, a in merges]):
+            ref = native.merge_out(ref, peer, alpha)
+        assert _bits(merged) == _bits(ref)
+    finally:
+        for t in ts:
+            t.close()
+
+
+def test_device_mode_rejects_nan_sparse_frame():
+    """The guard judges a sparse frame's shipped support in device mode
+    (the densified vector never exists) — a NaN value block must still
+    be classified poisoned and never merged."""
+    ts = _make_pair(
+        "threaded", False, wire_codec="topk", topk_values="f32"
+    )
+    try:
+        d = 1024
+        v0 = _vec(d, 50)
+        # A well-formed code-5 frame whose value block carries NaN —
+        # the encoder would never produce one, so poke the bytes.
+        buf = qz.TopkEncoder(0.05, "f32").encode(_vec(d, 51), 0, 1.0, 1)
+        k = int(buf[8:12].view("<u4")[0])
+        buf[13 + 4 * k:].view("<f4")[0] = np.nan
+        ts[1].server.publish(
+            buf, 1.0, 0.5, code=pc.PAYLOAD_TOPK_DELTA
+        )
+        dev = jnp.asarray(v0)
+        merged, alpha, _ = ts[0].exchange_on_device(dev, 1.0, 0.5, 0)
+        assert alpha == 0.0
+        assert merged is dev  # skipped: replica untouched
+        assert ts[0].last_round["outcome"] is not None
+        assert "poison" in str(ts[0].last_round["outcome"]).lower()
+    finally:
+        for t in ts:
+            t.close()
+
+
+def test_skipped_rounds_republish_from_cached_mirror():
+    """The lazy-readback contract: one d2h readback covers every round
+    until a merge lands; skipped rounds are free."""
+    reset_device_stats()
+    ts = _make_pair("threaded", False, timeout_ms=200)
+    try:
+        dev = jnp.asarray(_vec(256, 60))
+        # Partner never publishes: both rounds skip on fetch timeout.
+        m1, a1, _ = ts[0].exchange_on_device(dev, 1.0, 0.5, 0)
+        m2, a2, _ = ts[0].exchange_on_device(m1, 2.0, 0.5, 1)
+        assert a1 == a2 == 0.0 and m2 is dev
+        snap = device_snapshot()
+        assert snap["d2h_readbacks"] == 1
+        assert snap["device_rounds"] == 2
+        assert snap["device_dispatches"] == 0
+    finally:
+        for t in ts:
+            t.close()
+        reset_device_stats()
+
+
+def test_wire_snapshot_carries_device_columns():
+    ts = _make_pair("threaded", False)
+    try:
+        dv = ts[0].wire_snapshot()["device"]
+        for key in (
+            "jit_cache_hits", "jit_cache_misses",
+            "device_dispatches_per_round", "h2d_zero_copy_frac",
+            "fold_frames",
+        ):
+            assert key in dv, key
+    finally:
+        for t in ts:
+            t.close()
+
+
+@pytest.mark.parametrize("codec", ["topk", "shard-f32"])
+def test_device_merge_leg_allocates_o_header_not_o_payload(codec):
+    """tracemalloc gate, extended from the decode leg to the MERGE leg:
+    a device-mode sparse consume+merge must not allocate payload-sized
+    host memory (the densified remote really is gone — 4 MiB of f32
+    would trip this instantly).  Scoped to the fetch→merge legs: the
+    publish leg's f64 norm stash and the guard's norm reductions are
+    O(payload) math the host path pays identically and are not merge
+    copies, so the guard is off and publish runs outside the gate."""
+    from dpwa_tpu.device import default_engine
+
+    cfg = dict(_CODECS[codec])
+    if codec == "topk":
+        cfg["topk_fraction"] = 0.01
+    ts = _make_pair(
+        "threaded", False, recovery=dict(enabled=False), **cfg
+    )
+    try:
+        d = 1 << 20  # 4 MiB dense
+        v0, v1 = _vec(d, 70), _vec(d, 71)
+        ts[1].publish(v1, 1.0, 0.5)
+        dev = jnp.asarray(v0)
+        # Warm round: compiles the kernels, pools the ring classes,
+        # stashes _local_vec for the sparse consume leg.
+        dev, alpha, _ = ts[0].exchange_on_device(dev, 1.0, 0.5, 0)
+        assert alpha != 0.0
+        eng = default_engine()
+        tracemalloc.start()
+        try:
+            ts[0]._sparse_consume = True
+            try:
+                got = ts[0].fetch(1, step=1)
+                assert got is not None
+                remote_vec, alpha = ts[0]._weigh_remote(got, 2.0, 0.4)
+            finally:
+                ts[0]._sparse_consume = False
+            if ts[0]._pending_topk is not None:
+                idx, vals = ts[0]._pending_topk
+                merged = eng.merge_topk(dev, idx, vals, alpha)
+            else:
+                lo, _hi = ts[0]._pending_shard
+                merged = eng.merge_shard(dev, lo, remote_vec, alpha)
+            _, peak = tracemalloc.get_traced_memory()
+        finally:
+            tracemalloc.stop()
+        assert merged.shape == (d,)
+        # Floor: the frame's own ring lease (detached leases transfer
+        # to the decoded views and are never pooled, so each fetch
+        # allocates one wire-frame-sized buffer — the 2 MiB size class
+        # for the 1 MiB shard slice, ~64 KiB for top-k) plus one
+        # m-sized decode transient.  The regression this gate exists to
+        # catch — densifying the remote — would add a d-sized (4 MiB)
+        # host copy on top and blow straight past either bound.
+        bound = (1 << 20) if codec == "topk" else (7 << 19)
+        assert peak < bound, (codec, peak, bound)
+    finally:
+        for t in ts:
+            t.close()
+
+
+def test_health_record_device_columns_pass_schema_check(tmp_path):
+    """After a device round, log_health flattens the device group into
+    the health record and tools/schema_check.py accepts it; before one,
+    the columns are absent (plane-off records stay byte-identical)."""
+    import json
+
+    from dpwa_tpu.metrics import MetricsLogger
+    from tools import schema_check
+
+    reset_device_stats()
+    ts = _make_pair("threaded", False)
+    try:
+        path = tmp_path / "h.jsonl"
+        with MetricsLogger(path=str(path)) as log:
+            log.log_health(0, ts[0].health_snapshot())
+        pre = json.loads(path.read_text().strip())
+        assert "jit_cache_hits" not in pre
+        assert schema_check.check_record(pre) == []
+
+        ts[1].publish(_vec(256, 90), 1.0, 0.5)
+        _, alpha, _ = ts[0].exchange_on_device(
+            jnp.asarray(_vec(256, 91)), 1.0, 0.5, 0
+        )
+        assert alpha != 0.0
+        path2 = tmp_path / "h2.jsonl"
+        with MetricsLogger(path=str(path2)) as log:
+            log.log_health(0, ts[0].health_snapshot())
+        rec = json.loads(path2.read_text().strip())
+        assert rec["device_rounds"] >= 1
+        assert rec["jit_cache_misses"] >= 1
+        assert rec["device_dispatches_per_round"] > 0
+        assert 0.0 <= rec["h2d_zero_copy_frac"] <= 1.0
+        assert schema_check.check_record(rec) == []
+    finally:
+        for t in ts:
+            t.close()
+        reset_device_stats()
+
+
+def test_replica_mirror_invalidated_by_swap():
+    rep = DeviceReplica(jnp.asarray(_vec(128, 80)))
+    m1 = rep.host()
+    assert rep.host() is m1  # cached
+    rep.swap(jnp.asarray(_vec(128, 81)))
+    m2 = rep.host()
+    assert m2 is not m1
+    st = rep.stats()
+    assert st["readbacks"] == 2 and st["mirror_hits"] == 1

@@ -194,6 +194,9 @@ from dpwa_tpu.analysis.lock_discipline import (  # noqa: E402
 from dpwa_tpu.analysis.wire_protocol import WireProtocolChecker  # noqa: E402
 from dpwa_tpu.analysis.config_keys import ConfigKeysChecker  # noqa: E402
 from dpwa_tpu.analysis.emit_kinds import EmitKindsChecker  # noqa: E402
+from dpwa_tpu.analysis.device_roundtrip import (  # noqa: E402
+    DeviceRoundtripChecker,
+)
 from dpwa_tpu.analysis.zerocopy import ZeroCopyChecker  # noqa: E402
 
 _BASELINE = os.path.join(_ROOT, "tools", "dpwalint_baseline.json")
@@ -239,6 +242,7 @@ def test_rule_ids_are_frozen():
         "config-unparsed-block",
         "emit-kind",
         "zerocopy-tobytes",
+        "device-host-roundtrip",
         "dpwalint-annotation",
     })
 
@@ -484,6 +488,81 @@ def test_zerocopy_passes_view_clean_decode():
     )
     result = _run_on_source(
         [ZeroCopyChecker()], {"dpwa_tpu/ops/shard.py": src}
+    )
+    assert result.errors == []
+
+
+# --- device-host round-trip fixtures ---
+
+_DRT_BAD = (
+    "import numpy as np\n"
+    "import jax.numpy as jnp\n"
+    "def merge(dev, frame):\n"
+    "    host = np.asarray(dev)\n"
+    "    up = jnp.asarray(frame)\n"
+    "    return host.tobytes(), up\n"
+)
+
+
+def test_device_roundtrip_flags_crossings_on_merge_path_only():
+    on_path = _run_on_source(
+        [DeviceRoundtripChecker()], {"dpwa_tpu/device/engine.py": _DRT_BAD}
+    )
+    assert [f.rule for f in on_path.errors] == [
+        "device-host-roundtrip"
+    ] * 3
+    assert sorted(f.symbol for f in on_path.errors) == [
+        "merge:.tobytes()", "merge:jnp.asarray(...)",
+        "merge:np.asarray(...)",
+    ]
+    # The host exchange path in numpy-land is NOT merge path.
+    off_path = _run_on_source(
+        [DeviceRoundtripChecker()], {"dpwa_tpu/ops/quantize.py": _DRT_BAD}
+    )
+    assert off_path.errors == []
+
+
+def test_device_roundtrip_scopes_tcp_to_device_exchange_methods():
+    src = (
+        "import numpy as np\n"
+        "class T:\n"
+        "    def exchange(self, vec):\n"
+        "        return np.asarray(vec)\n"
+        "    def exchange_on_device(self, dev):\n"
+        "        return np.asarray(dev)\n"
+    )
+    result = _run_on_source(
+        [DeviceRoundtripChecker()], {"dpwa_tpu/parallel/tcp.py": src}
+    )
+    assert [f.symbol for f in result.errors] == [
+        "exchange_on_device:np.asarray(...)"
+    ]
+
+
+def test_device_roundtrip_honors_standard_suppression_grammar():
+    # The handoff.to_host shape: a standalone ignore comment covering
+    # the next code line — the one sanctioned readback boundary.
+    src = (
+        "import numpy as np\n"
+        "def to_host(dev):\n"
+        "    # dpwalint: ignore[device-host-roundtrip] -- fixture: the boundary itself\n"
+        "    return np.asarray(dev)\n"
+    )
+    result = _run_on_source(
+        [DeviceRoundtripChecker()], {"dpwa_tpu/device/handoff.py": src}
+    )
+    assert result.errors == []
+    assert len(result.suppressed) == 1
+
+
+def test_device_roundtrip_passes_handoff_routed_merge():
+    src = (
+        "from dpwa_tpu.device import handoff\n"
+        "def merge(dev, frame, fn, t):\n"
+        "    return fn(dev, handoff.to_device(frame), t)\n"
+    )
+    result = _run_on_source(
+        [DeviceRoundtripChecker()], {"dpwa_tpu/device/engine.py": src}
     )
     assert result.errors == []
 

@@ -420,7 +420,12 @@ def test_buffer_ring_pools_released_buffers_and_forgets_detached():
     ring = ingest.BufferRing()
     lease = ring.lease(10_000)
     assert len(lease.view) == 10_000
-    assert len(lease._buf) == 16_384  # next power-of-two class
+    # Next power-of-two class + the alignment slack every buffer
+    # carries so the view can start on a LEASE_ALIGN boundary (the
+    # device-handoff dlpack contract).
+    assert len(lease._buf) == 16_384 + ingest.LEASE_ALIGN
+    arr = np.frombuffer(lease.view, dtype=np.uint8)
+    assert arr.ctypes.data % ingest.LEASE_ALIGN == 0
     assert ring.stats()["leased_bytes"] == 16_384
     assert ring.stats()["occupancy"] == 1.0
     buf_id = id(lease._buf)

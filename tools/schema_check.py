@@ -130,6 +130,16 @@ _HEALTH_GROUPS: Dict[str, Dict[str, tuple]] = {
         "shard_k": (int,),
         "shard_coverage": _NUM,
     },
+    # Device merge engine (docs/device.md; absent until a device-
+    # resident exchange has served a round).
+    "device": {
+        "device_rounds": (int,),
+        "jit_cache_hits": (int,),
+        "jit_cache_misses": (int,),
+        "device_dispatches_per_round": _NUM,
+        "h2d_zero_copy_frac": _NUM,
+        "fold_frames": (int,),
+    },
     "obs": {
         "disagreement_rms": _NUM + (type(None),),
         "disagreement_rel": _NUM + (type(None),),
