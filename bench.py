@@ -1174,6 +1174,211 @@ def bench_serve(frame_floats: int, fps_seconds: float) -> dict:
     }
 
 
+# --- Async gossip leg (docs/async.md): barrier-free vs lock-step ---
+#
+# 4 peers on localhost with ONE chaos-shaped trickling straggler (bytes
+# flow, but at a rate that makes every fetch of its replica blow the
+# round budget).  The lock-step leg pays the straggler on every round
+# that pairs an honest peer with it; the async leg keeps merging
+# whatever frames have landed and charges the straggler's lag to
+# staleness damping instead of the honest peers' wall clock.  The
+# headline is the honest peers' straggler-unthrottled speedup:
+# lock-step p99 round wall over async p99.
+ASYNC_GATE_WINDOW = 8
+ASYNC_GATE_REL_TOL = 0.5
+ASYNC_SWEEP_PEERS = 4
+ASYNC_SWEEP_FLOATS = 4096
+
+
+def async_gate(
+    history: list,
+    current_speedup,
+    window: int = ASYNC_GATE_WINDOW,
+    rel_tol: float = ASYNC_GATE_REL_TOL,
+    methodology: int = BENCH_METHODOLOGY,
+) -> dict:
+    """Regression gate for the async leg's straggler-unthrottled speedup
+    (pure; mirrors :func:`tcp_gate`, including the like-with-like
+    ``bench_methodology`` filter).  A refactor that quietly re-couples
+    the round loop to the slowest peer — a blocking join on the fetch
+    slot, a barrier hiding in the merge path — collapses the speedup
+    toward 1x and shows up here as "regressed" against recent medians.
+    The band is wide (``rel_tol`` 0.5): the lock-step numerator is a
+    timeout-dominated wall, stable, but the async denominator is a
+    scheduler-sensitive few-ms figure."""
+    samples = [
+        float(e["async_straggler_speedup"])
+        for e in history
+        if isinstance(e, dict)
+        and e.get("record") == "bench"
+        and e.get("bench_methodology") == methodology
+        and isinstance(e.get("async_straggler_speedup"), (int, float))
+        and not isinstance(e.get("async_straggler_speedup"), bool)
+    ][-int(window):]
+    median = float(np.median(samples)) if samples else None
+    gate = {
+        "samples": len(samples),
+        "window": int(window),
+        "rel_tol": float(rel_tol),
+        "methodology": int(methodology),
+        "median_speedup": round(median, 3) if median is not None else None,
+        "current_speedup": (
+            round(float(current_speedup), 3)
+            if current_speedup is not None else None
+        ),
+    }
+    if current_speedup is None or len(samples) < 2:
+        gate["verdict"] = "no_data"
+        return gate
+    cur = float(current_speedup)
+    if cur < median * (1.0 - rel_tol):
+        gate["verdict"] = "regressed"
+    elif cur > median * (1.0 + rel_tol):
+        gate["verdict"] = "improved"
+    else:
+        gate["verdict"] = "ok"
+    return gate
+
+
+def bench_async(
+    d: int = ASYNC_SWEEP_FLOATS,
+    iters: int = 24,
+    peers: int = ASYNC_SWEEP_PEERS,
+    timeout_ms: int = 400,
+    trickle_bytes_per_s: float = 2048.0,
+    compute_ms: float = 30.0,
+) -> dict:
+    """Lock-step vs barrier-free rounds under a trickling straggler.
+
+    Both legs run the SAME topology and fault schedule: ``peers`` nodes
+    on localhost, ring schedule, with the last peer trickle-shaped for
+    the whole run (bytes flow at ``trickle_bytes_per_s`` — far too slow
+    to land a ``d``-float frame inside ``timeout_ms``, the honest-but-
+    overloaded shape from docs/flowctl.md).  Each node drives its own
+    thread so the lock-step leg exhibits the real coupling: every round
+    that pairs an honest peer with the straggler stalls for the fetch
+    budget.  The async leg (``protocol.async_rounds``) publishes and
+    moves on; frames merge when they land, damped by staleness.
+
+    ``compute_ms`` is the per-round compute stand-in (the bench_wire
+    overlap-leg pattern), slept identically in BOTH legs: without it the
+    async leg would sprint through every round before any fetch could
+    land and "win" while merging nothing.  The sleep is excluded from
+    the reported walls — it models the training step the round loop is
+    supposed to hide the wire under, not round cost.
+
+    Reported walls are the per-round exchange times of the HONEST peers
+    only (the straggler's own wall is shaped by chaos, not by the round
+    loop), p50/p99 over all honest rounds.  ``straggler_speedup`` is
+    the lock-step p99 over the async p99 — how much of the straggler's
+    throttle the async loop removed from peers that were never slow."""
+    from dpwa_tpu.config import make_local_config
+    from dpwa_tpu.parallel.tcp import TcpTransport
+
+    straggler = peers - 1
+    chaos = {
+        "enabled": True,
+        "trickle_windows": ((straggler, 0, iters),),
+        "trickle_bytes_per_s": float(trickle_bytes_per_s),
+    }
+
+    def ring(**kw):
+        cfg = make_local_config(
+            peers, base_port=0, schedule="ring",
+            timeout_ms=timeout_ms, chaos=chaos, **kw
+        )
+        ts = [TcpTransport(cfg, f"node{i}") for i in range(peers)]
+        for t in ts:
+            for i, other in enumerate(ts):
+                t.set_peer_port(i, other.port)
+        return ts
+
+    rng = np.random.default_rng(0)
+    base = [rng.standard_normal(d).astype(np.float32) for _ in range(peers)]
+
+    def drive(ts):
+        walls: list = [[] for _ in range(peers)]
+        vecs = [b.copy() for b in base]
+
+        def run_node(i, t):
+            for it in range(iters):
+                t.publish(vecs[i], float(it), 0.0)
+                if compute_ms:
+                    time.sleep(compute_ms / 1e3)
+                t0 = time.perf_counter()
+                merged, alpha, _ = t.exchange(vecs[i], float(it), 0.0, it)
+                walls[i].append(time.perf_counter() - t0)
+                if alpha != 0.0:
+                    vecs[i] = np.asarray(merged, np.float32)
+
+        threads = [
+            threading.Thread(target=run_node, args=(i, t), daemon=True)
+            for i, t in enumerate(ts)
+        ]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        return walls, vecs
+
+    def leg(**kw):
+        ts = ring(**kw)
+        try:
+            t0 = time.perf_counter()
+            walls, vecs = drive(ts)
+            total_s = time.perf_counter() - t0
+            honest = [
+                w for i, ws in enumerate(walls)
+                if i != straggler for w in ws
+            ]
+            stack = np.stack(vecs)
+            mean = stack.mean(axis=0)
+            rel_rms = float(
+                np.sqrt(np.mean((stack - mean) ** 2))
+                / (np.sqrt(np.mean(mean ** 2)) + 1e-12)
+            )
+            out = {
+                "p50_ms": round(
+                    float(np.percentile(honest, 50)) * 1e3, 3
+                ),
+                "p99_ms": round(
+                    float(np.percentile(honest, 99)) * 1e3, 3
+                ),
+                "total_s": round(total_s, 3),
+                "final_rel_rms": round(rel_rms, 6),
+            }
+            eng = getattr(ts[0], "async_engine", None)
+            if eng is not None:
+                for t in ts:
+                    t.async_engine.join_inflight(timeout_s=2.0)
+                snaps = [t.async_engine.snapshot() for t in ts]
+                out["async_merges"] = sum(s["merges"] for s in snaps)
+                out["async_stale_drops"] = sum(
+                    s["stale_drops"] for s in snaps
+                )
+                out["async_shed"] = sum(s["shed"] for s in snaps)
+            return out
+        finally:
+            for t in ts:
+                t.close()
+
+    lock_leg = leg()
+    async_leg = leg(async_rounds={"enabled": True})
+    speedup = round(lock_leg["p99_ms"] / max(async_leg["p99_ms"], 1e-6), 3)
+    return {
+        "d": int(d),
+        "iters": int(iters),
+        "peers": int(peers),
+        "timeout_ms": int(timeout_ms),
+        "straggler": int(straggler),
+        "trickle_bytes_per_s": float(trickle_bytes_per_s),
+        "compute_ms": float(compute_ms),
+        "lockstep": lock_leg,
+        "async": async_leg,
+        "straggler_speedup": speedup,
+    }
+
+
 # Frame sizes for the zero-copy leg: 16 MiB (a mid-size replica) and
 # ~100 MB (the ResNet-50-scale default the headline bench ships).
 COPY_SWEEP_FRAME_FLOATS = (4 * 1024 * 1024, 24 * 1024 * 1024)
@@ -1834,6 +2039,30 @@ def main() -> None:
         "dispatch) for the merge leg's multi-peer fold cells",
     )
     ap.add_argument(
+        "--async-leg", action="store_true",
+        help="run ONLY the async gossip leg: lock-step vs barrier-free "
+        "rounds at 4 peers with one chaos-shaped trickling straggler — "
+        "honest peers' p50/p99 round walls and the straggler-"
+        "unthrottled speedup; appends its own bench_history.jsonl "
+        "record carrying an async_gate verdict",
+    )
+    ap.add_argument(
+        "--async-size", type=int, default=ASYNC_SWEEP_FLOATS,
+        help="replica size (floats) for the async leg",
+    )
+    ap.add_argument(
+        "--async-iters", type=int, default=24,
+        help="rounds per async-leg drive",
+    )
+    ap.add_argument(
+        "--async-peers", type=int, default=ASYNC_SWEEP_PEERS,
+        help="peer count for the async leg (last peer is the straggler)",
+    )
+    ap.add_argument(
+        "--async-trickle-bytes", type=float, default=2048.0,
+        help="straggler serving rate (bytes/s) for the async leg",
+    )
+    ap.add_argument(
         "--confirm-timeout", type=float, default=DEAD_CONFIRM_TIMEOUT_S,
         help="capped single-probe timeout once the backend dead-streak "
         "has tripped (the cheap re-confirmation instead of the full "
@@ -1957,6 +2186,53 @@ def main() -> None:
             os.path.dirname(os.path.abspath(__file__)),
             "artifacts", "bench_history.jsonl",
         )
+        try:
+            os.makedirs(os.path.dirname(history_path), exist_ok=True)
+            with open(history_path, "a", encoding="utf-8") as f:
+                f.write(
+                    json.dumps({"record": "bench", "t": time.time(), **out})
+                    + "\n"
+                )
+        except OSError:
+            pass
+        return
+    if args.async_leg:
+        # Standalone mode (the --shard-leg pattern): transports
+        # in-process on the CPU backend.  Appends its own record="bench"
+        # history line carrying the async_gate verdict.
+        log(
+            f"async leg: {args.async_peers} peers, d={args.async_size}, "
+            f"x{args.async_iters} rounds, straggler trickle "
+            f"{args.async_trickle_bytes:.0f} B/s ..."
+        )
+        sweep = bench_async(
+            args.async_size, args.async_iters, peers=args.async_peers,
+            trickle_bytes_per_s=args.async_trickle_bytes,
+        )
+        log(
+            f"async leg: honest p99 {sweep['lockstep']['p99_ms']} ms "
+            f"lock-step -> {sweep['async']['p99_ms']} ms async "
+            f"({sweep['straggler_speedup']}x unthrottled), async "
+            f"merges {sweep['async'].get('async_merges')}, stale drops "
+            f"{sweep['async'].get('async_stale_drops')}"
+        )
+        history_path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "artifacts", "bench_history.jsonl",
+        )
+        gate = async_gate(
+            read_bench_history(history_path), sweep["straggler_speedup"]
+        )
+        log(f"async leg: gate {gate['verdict']}")
+        out = {
+            "metric": "async_straggler_unthrottle",
+            "bench_methodology": BENCH_METHODOLOGY,
+            "async_leg": sweep,
+            "async_straggler_speedup": sweep["straggler_speedup"],
+            "async_gate": gate,
+        }
+        print("ASYNC_LEG " + json.dumps(sweep), flush=True)
+        print(json.dumps(out), flush=True)
         try:
             os.makedirs(os.path.dirname(history_path), exist_ok=True)
             with open(history_path, "a", encoding="utf-8") as f:

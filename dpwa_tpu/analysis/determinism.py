@@ -2,8 +2,8 @@
 
 The gossip algebra only converges when every node makes the SAME
 partner/merge/trust decision at the same step, so the decision modules
-(schedules, trust, membership, interpolation) must be pure functions of
-``(seed, step, structured state)``:
+(schedules, trust, membership, interpolation, the async round loop) must
+be pure functions of ``(seed, step, structured state)``:
 
 - ``det-random``: no ambient randomness — ``random.*`` and unseeded
   ``np.random.*`` are forbidden; ``np.random.default_rng(seed)`` with an
@@ -33,6 +33,7 @@ _DECISION_MARKERS = (
     "trust/",
     "membership/",
     "parallel/interpolation.py",
+    "parallel/async_loop.py",
 )
 
 # consumers for which iteration order genuinely does not matter

@@ -47,6 +47,22 @@ Schema::
                                 #   event loop, docs/transport.md; wire
                                 #   behavior identical, chaos still
                                 #   forces the threaded server)
+      async_rounds:             # barrier-free async gossip (TCP only,
+                                #   docs/async.md); absent/off keeps the
+                                #   lock-step round loop byte-identical
+        enabled: false          # decouple publish from merge: frames land
+                                #   in per-peer queues and merge when ready
+        max_staleness: 4        # largest publish-clock lag still merged;
+                                #   beyond it the frame drops as the soft
+                                #   ``stale`` outcome (degrade, never
+                                #   quarantine)
+        staleness_damping: 0.5  # per-lag alpha decay: a frame lagging L
+                                #   clocks merges at alpha * damping**L,
+                                #   composing with trust damping
+        queue_depth: 4          # bounded per-peer pending queue (newest
+                                #   frames win admission)
+        fold: true              # batch pending dense frames through one
+                                #   exchange_on_device_fold dispatch
     shard:                      # sharded gossip (TCP only, docs/wire.md)
       k: 1                      # contiguous shards per replica; each round
                                 #   ships ONE shard (k× fewer wire bytes,
@@ -224,6 +240,9 @@ Schema::
                                 #   trust_burst alert
       incident_storm_threshold: 3  # quarantine/degrade transitions inside
                                 #   the window before a state_storm alert
+      incident_stale_storm: 3   # async bounded-staleness drops inside the
+                                #   window before a staleness_storm alert
+                                #   (docs/async.md)
       incident_stall_window: 8  # rel_rms samples behind the convergence
                                 #   stall detector
       incident_stall_min_rel: 0.05  # plateau only counts above this
@@ -288,6 +307,57 @@ DEFAULT_MIN_WIRE_MB_PER_S = 10.0
 
 
 @dataclasses.dataclass(frozen=True)
+class AsyncRoundsConfig:
+    """``protocol.async_rounds`` block — barrier-free gossip rounds.
+
+    Off (the default, and the absent-block case) keeps the lock-step
+    round loop byte-identical to a pre-async build.  On, the
+    :class:`~dpwa_tpu.parallel.async_loop.AsyncExchangeEngine` decouples
+    publish from merge: frames stream on background slots, land in a
+    bounded per-peer pending queue, and merge whenever ready instead of
+    at the round barrier.  Each merge damps its interpolation weight by
+    ``staleness_damping ** lag`` (lag = local step − the frame's publish
+    clock), and a frame whose lag exceeds ``max_staleness`` is dropped
+    as the soft ``stale`` outcome (degrade, never quarantine).  See
+    docs/async.md."""
+
+    enabled: bool = False
+    # Largest publish-clock lag still merged.  Lag == max_staleness
+    # merges (maximally damped); lag > max_staleness drops as ``stale``.
+    max_staleness: int = 4
+    # Per-lag alpha decay: a frame lagging L clocks merges at
+    # alpha * staleness_damping**L, composing multiplicatively with the
+    # trust damping already in interpolation._clamped.  1.0 disables
+    # damping (bounded-staleness drops still apply).
+    staleness_damping: float = 0.5
+    # Bounded per-peer pending queue: admission keeps the newest
+    # ``queue_depth`` frames per peer (older publish clocks are shed
+    # first — they would merge at the smallest weight anyway).
+    queue_depth: int = 4
+    # Batch consecutive pending dense frames into one
+    # exchange_on_device_fold dispatch (device substrate only; the host
+    # substrate always folds sequentially, which is bit-identical).
+    fold: bool = True
+
+    def __post_init__(self) -> None:
+        if self.max_staleness < 1:
+            raise ValueError(
+                f"async_rounds.max_staleness must be >= 1, "
+                f"got {self.max_staleness}"
+            )
+        if not 0.0 < self.staleness_damping <= 1.0:
+            raise ValueError(
+                f"async_rounds.staleness_damping must be in (0, 1], "
+                f"got {self.staleness_damping}"
+            )
+        if self.queue_depth < 1:
+            raise ValueError(
+                f"async_rounds.queue_depth must be >= 1, "
+                f"got {self.queue_depth}"
+            )
+
+
+@dataclasses.dataclass(frozen=True)
 class ProtocolConfig:
     schedule: str = "ring"
     mode: str = "pairwise"  # pairwise (mutual merge) | pull (one-sided)
@@ -349,8 +419,21 @@ class ProtocolConfig:
     # chaos.enabled still forces the threaded chaos wrapper: fault
     # injection needs per-connection control of a blocking serve loop.
     rx_server: str = "threaded"
+    # Barrier-free async rounds (docs/async.md): accepts the nested
+    # AsyncRoundsConfig or the YAML-block mapping shorthand.  Disabled
+    # by default — the lock-step round loop is the bit-identity
+    # reference the async engine is tested against.
+    async_rounds: "AsyncRoundsConfig | Mapping[str, Any]" = (
+        dataclasses.field(default_factory=AsyncRoundsConfig)
+    )
 
     def __post_init__(self) -> None:
+        if isinstance(self.async_rounds, Mapping):
+            # YAML-block shorthand: coerce in place (frozen dataclass,
+            # same discipline as ChaosConfig's window normalization).
+            object.__setattr__(
+                self, "async_rounds", AsyncRoundsConfig(**self.async_rounds)
+            )
         if not 0.0 <= self.fetch_probability <= 1.0:
             raise ValueError(
                 f"fetch_probability must be in [0, 1], got {self.fetch_probability}"
@@ -1086,6 +1169,10 @@ class ObsConfig:
     incident_soft_streak: int = 2
     incident_trust_burst: int = 2
     incident_storm_threshold: int = 3
+    # staleness_storm detector (docs/async.md): stale drops within
+    # ``incident_window`` rounds before the incident fires — lag
+    # evidence is soft, so the bar sits above a lone straggler blip.
+    incident_stale_storm: int = 3
     incident_stall_window: int = 8
     incident_stall_min_rel: float = 0.05
     incident_stall_improve: float = 0.01
@@ -1129,6 +1216,7 @@ class ObsConfig:
             "incident_soft_streak",
             "incident_trust_burst",
             "incident_storm_threshold",
+            "incident_stale_storm",
             "incident_stall_window",
             "incident_slo_rounds",
             "incident_slo_warmup",

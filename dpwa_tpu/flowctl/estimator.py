@@ -29,9 +29,10 @@ from __future__ import annotations
 import math
 import threading
 from collections import deque
-from typing import Deque, Dict, Optional
+from typing import Callable, Deque, Dict, Optional
 
 from dpwa_tpu.config import FlowctlConfig
+from dpwa_tpu.flowctl.vclock import monotonic_now
 from dpwa_tpu.health.detector import Outcome
 
 
@@ -42,9 +43,20 @@ class DeadlineEstimator:
         self,
         config: Optional[FlowctlConfig] = None,
         timeout_ms: float = 500.0,
+        now: Optional[Callable[[], float]] = None,
     ):
         self.config = config if config is not None else FlowctlConfig()
         self.timeout_ms = float(timeout_ms)
+        # The flowctl stack's shared time seam (dpwa_tpu/flowctl/vclock):
+        # the estimator itself never reads it — latencies arrive as
+        # arguments, which is what keeps outcome classification
+        # deterministic — but the async round engine stamps its
+        # staleness/pending-wait spans from THIS callable, so injecting
+        # a VirtualClock here governs every wall-derived span in the
+        # async plane at once (docs/async.md determinism contract).
+        self.now: Callable[[], float] = (
+            now if now is not None else monotonic_now
+        )
         self._lock = threading.Lock()
         self._window: Dict[int, Deque[float]] = {}
         self._counts: Dict[int, Dict[str, int]] = {}

@@ -1,0 +1,60 @@
+"""Injectable time source for the flowctl + async-round stack.
+
+Everything in the flowctl plane that touches wall time does so through
+an injected zero-arg clock callable rather than reading ``time.*``
+directly: the :class:`~dpwa_tpu.flowctl.estimator.DeadlineEstimator`
+receives latencies as arguments and exposes the shared ``now`` seam,
+and the :class:`~dpwa_tpu.parallel.async_loop.AsyncExchangeEngine`
+stamps its staleness/pending-wait spans with the same callable.  In
+production that callable is :data:`monotonic_now`; in tests it is a
+:class:`VirtualClock`, which makes every wall-derived quantity in an
+async soak a pure function of the harness's ``advance`` calls — the
+determinism contract docs/async.md pins (a rerun of the same soak is
+bit-identical, telemetry included).
+
+None of the DECISION state in the gossip control plane may depend on
+this clock (dpwalint's ``det-time`` rule enforces it on the decision
+modules); the clock governs telemetry spans only.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+# The production clock: module-level alias so decision-path modules can
+# take it as a default argument without spelling ``time.monotonic`` (and
+# without importing ``time``) themselves.
+monotonic_now = time.monotonic
+
+
+class VirtualClock:
+    """A clock that advances only when told to.
+
+    Thread-safe: async fetch slots stamp arrival spans from their own
+    threads while the harness advances from the training thread.  A
+    ``VirtualClock`` instance is itself a zero-arg callable, so it drops
+    in anywhere ``time.monotonic`` would."""
+
+    def __init__(self, start: float = 0.0):
+        self._t = float(start)
+        self._lock = threading.Lock()
+
+    def now(self) -> float:
+        with self._lock:
+            return self._t
+
+    def __call__(self) -> float:
+        return self.now()
+
+    def advance(self, dt: float) -> float:
+        """Move time forward by ``dt`` seconds; returns the new time."""
+        if dt < 0:
+            raise ValueError(f"virtual clock cannot rewind (dt={dt})")
+        with self._lock:
+            self._t += float(dt)
+            return self._t
+
+    def sleep(self, dt: float) -> None:
+        """A virtual sleep: advances the clock, costs no wall time."""
+        self.advance(dt)

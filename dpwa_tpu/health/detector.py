@@ -58,16 +58,21 @@ class Outcome:
     SLOW = "slow"  # the adaptive deadline lapsed while payload bytes
     #   were STILL FLOWING — a straggling-but-alive peer, distinct from
     #   TIMEOUT (zero bytes: the peer or path is plain dead/hung)
+    STALE = "stale"  # the frame arrived intact but its publish clock
+    #   lagged the local step past ``async_rounds.max_staleness``
+    #   (dpwa_tpu.parallel.async_loop's bounded-staleness drop rule) —
+    #   lag evidence like SLOW, not byzantine content: the peer is
+    #   alive and honest, just behind
 
     FAILURES = (
         TIMEOUT, REFUSED, SHORT_READ, CORRUPT, POISONED, UNTRUSTED,
-        BUSY, SLOW,
+        BUSY, SLOW, STALE,
     )
     ALL = (SUCCESS,) + FAILURES
     # Load signals, not death signals: evidence of these soft outcomes
     # DEGRADES a peer (scheduler soft-deprioritization) but never
     # quarantines it — see dpwa_tpu.health.scoreboard.
-    SOFT = (BUSY, SLOW)
+    SOFT = (BUSY, SLOW, STALE)
 
 
 # Evidence added to the suspicion score per failure, by kind.  A refused
@@ -93,6 +98,7 @@ DEFAULT_FAILURE_WEIGHTS: Mapping[str, float] = {
     Outcome.UNTRUSTED: 1.5,
     Outcome.BUSY: 0.25,
     Outcome.SLOW: 0.25,
+    Outcome.STALE: 0.25,
 }
 
 

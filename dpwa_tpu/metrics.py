@@ -224,6 +224,13 @@ class MetricsLogger:
           ``reactor_busy_shed`` — the reactor Rx scheduler's loop and
           connection accounting (present only under
           ``protocol.rx_server: reactor``);
+        - ``async_rounds`` / ``async_merges`` / ``async_stale_drops``
+          / ``async_dup_drops`` / ``async_shed`` /
+          ``async_fold_frames`` / ``async_staleness_hist`` and the
+          per-peer ``async_peer_merges`` / ``async_peer_stale`` /
+          ``async_peer_pending`` / ``async_peer_lag`` — the barrier-
+          free async round loop's merge/drop/queue accounting (present
+          only under ``protocol.async_rounds``, docs/async.md);
 
         plus attempt/success/quarantine counters.  Obeys ``every`` like
         every other record; written immediately (health snapshots are
@@ -364,6 +371,31 @@ class MetricsLogger:
                     disagreement_rel=conv.get("rel_rms"),
                     sketch_peers=conv.get("peers_seen"),
                 )
+        async_snap = snapshot.get("async")
+        if async_snap is not None and order:
+            # Async round-loop columns (absent under lock-step rounds,
+            # keeping those records byte-identical): cumulative merge/
+            # drop/queue tallies, the staleness histogram (buckets
+            # 0..max_staleness plus overflow = drops), and the per-peer
+            # view aligned to the record's ``peer`` column.
+            apeers = async_snap.get("peers") or {}
+            acol = lambda key, d: [  # noqa: E731
+                apeers.get(p, {}).get(key, d) for p in order
+            ]
+            extra = dict(
+                extra,
+                async_rounds=async_snap.get("rounds"),
+                async_merges=async_snap.get("merges"),
+                async_stale_drops=async_snap.get("stale_drops"),
+                async_dup_drops=async_snap.get("dup_drops"),
+                async_shed=async_snap.get("shed"),
+                async_fold_frames=async_snap.get("fold_frames"),
+                async_staleness_hist=async_snap.get("staleness_hist"),
+                async_peer_merges=acol("merges", 0),
+                async_peer_stale=acol("stale", 0),
+                async_peer_pending=acol("pending", 0),
+                async_peer_lag=acol("last_lag", None),
+            )
         self.log(
             step,
             record="health",

@@ -49,6 +49,9 @@ alert kind                plane      evidence
 ``conv_stall``            obs        rel_rms above ``incident_stall_min_rel``
                                      improving < ``incident_stall_improve``
                                      across ``incident_stall_window`` samples
+``staleness_storm``       async      ``incident_stale_storm`` bounded-staleness
+                                     drops (the async round loop's ``stale``
+                                     outcome) inside ``incident_window`` rounds
 ========================  =========  ==========================================
 
 Determinism discipline: every detector that the chaos-to-incident
@@ -94,6 +97,11 @@ ALERT_KINDS: Dict[str, tuple] = {
     "state_storm": ("health", "state_storm", "critical"),
     "slo_burn": ("obs", "slo_burn", "warning"),
     "conv_stall": ("obs", "conv_stall", "warning"),
+    # Barrier-free async rounds (docs/async.md): a burst of bounded-
+    # staleness drops — peers are alive and publishing but so far
+    # behind the local clock that their frames are discarded.  Load/lag
+    # evidence like straggler, never byzantine.
+    "staleness_storm": ("async", "staleness_storm", "warning"),
 }
 
 # Root-cause priority between incident classifications (first wins):
@@ -107,7 +115,8 @@ ALERT_KINDS: Dict[str, tuple] = {
 # an evidence-keyed chaos incident.
 KIND_PRIORITY = (
     "island_partition", "partition", "byzantine", "leader_failover",
-    "peer_down", "straggler", "state_storm", "slo_burn", "conv_stall",
+    "peer_down", "straggler", "staleness_storm", "state_storm",
+    "slo_burn", "conv_stall",
 )
 
 _SEV_RANK = {"warning": 1, "critical": 2}
@@ -171,6 +180,8 @@ class IncidentPlane:
         self._flap_live = False
         self._rel: deque = deque(maxlen=max(2, cfg.incident_stall_window))
         self._stall_live = False
+        self._stale_steps: deque = deque()
+        self._stale_live = False
         self._wall: deque = deque(maxlen=_SLO_BASELINE)
         self._burn = 0
         self._slo_live = False
@@ -200,6 +211,7 @@ class IncidentPlane:
         wall_s: Optional[float] = None,
         partition_state: Optional[str] = None,
         component: Optional[Sequence[int]] = None,
+        stale_peers: Sequence[int] = (),
     ) -> dict:
         """Feed one round of evidence; returns ``{"alerts": [kinds],
         "opened": bool}`` so the transport can trigger the flight
@@ -210,7 +222,8 @@ class IncidentPlane:
         ``events`` are this round's membership + trust event dicts;
         ``rel_rms`` the sketch board's relative disagreement; ``wall_s``
         the entry-to-entry round wall; ``partition_state``/``component``
-        the membership view."""
+        the membership view; ``stale_peers`` the peers whose frames the
+        async round loop's bounded-staleness rule dropped this round."""
         cfg = self.cfg
         step = int(step)
         fired: List[dict] = []
@@ -267,6 +280,27 @@ class IncidentPlane:
                         _fire(kind, {p}, len(dq), thr, window)
                 else:
                     live.discard(p)
+
+        # 1b. Bounded-staleness drop storm (async round loop): frames
+        # arriving so far behind the local publish clock that the drop
+        # rule discards them.  Windowed like the transition storm;
+        # rising-edge alert, then active support while over threshold.
+        for p in stale_peers:
+            self._stale_steps.append((step, int(p)))
+        while self._stale_steps and (
+            self._stale_steps[0][0] <= step - window
+        ):
+            self._stale_steps.popleft()
+        n_stale = len(self._stale_steps)
+        if n_stale >= cfg.incident_stale_storm:
+            peers = {p for _, p in self._stale_steps}
+            active.setdefault("staleness_storm", set()).update(peers)
+            if not self._stale_live:
+                self._stale_live = True
+                _fire("staleness_storm", peers, n_stale,
+                      cfg.incident_stale_storm, window)
+        else:
+            self._stale_live = False
 
         # 2. Scoreboard transition storm + sticky unhealthy states.
         sticky: Set[int] = set()

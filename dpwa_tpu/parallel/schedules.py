@@ -272,6 +272,21 @@ def shard_draw(seed, step, k: int) -> int:
     return int(shard_permutation(seed, epoch, k)[pos])
 
 
+def async_drain_draw(seed, step, peer) -> float:
+    """Uniform [0,1) tie-break for the async drain order (tag 33).
+
+    When several peers hold pending frames at the SAME publish clock,
+    the :class:`~dpwa_tpu.parallel.async_loop.AsyncExchangeEngine`
+    drains them sorted by ``(lag, draw, peer)`` — this draw rotates the
+    equal-lag order across steps so no peer's frame is systematically
+    merged last (the clock-major sort already fixes cross-lag order).
+    Pure function of ``(seed, step, peer)``: a rerun of the same soak
+    drains identically regardless of arrival-thread timing."""
+    return float(
+        jax.random.uniform(_pair_key(seed, step, peer, _tags.TAG_ASYNC_DRAIN))
+    )
+
+
 _CONTROL_DRAWS_WARM = False
 
 
@@ -307,6 +322,7 @@ def warm_control_draws(seed: int = 0, me: int = 0) -> None:
     leader_draw(seed, 0, 0, 2)
     island_churn_draw(seed, 0, 0)
     shard_draw(seed, 0, 2)
+    float(async_drain_draw(seed, 0, me))
     _CONTROL_DRAWS_WARM = True
 
 

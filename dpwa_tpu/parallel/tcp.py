@@ -1899,6 +1899,25 @@ class TcpTransport:
         self._clock_lock = threading.Lock()
         self._last_clock = 0.0
         self.resync_advice: Optional[dict] = None
+        # Barrier-free async round plane (docs/async.md): when
+        # protocol.async_rounds is enabled an AsyncExchangeEngine wraps
+        # this transport — publish decoupled from merge, frames queueing
+        # per peer and merging staleness-damped whenever ready — and
+        # arms _async_guard, the per-peer highest-merged-publish-clock
+        # map that _consume_fetch uses to drop duplicate deliveries (a
+        # frame both prefetched and queued async must merge exactly
+        # once).  Both stay None when the block is off: the lock-step
+        # paths then take no async branches and produce byte-identical
+        # frames and merges.
+        # dpwalint: double_buffered(_async_guard) -- written only by the training thread inside _consume_fetch; async fetch slots read a consistent snapshot at admission time (a miss is re-screened at consume)
+        self.async_engine = None
+        self._async_guard: Optional[Dict[int, float]] = None
+        if config.protocol.async_rounds.enabled:
+            # Deferred import: async_loop imports schedules/detector and
+            # is wired onto this transport, not the other way around.
+            from dpwa_tpu.parallel.async_loop import AsyncExchangeEngine
+
+            AsyncExchangeEngine(self)
         if self._chaos_engine is not None:
             # Compile-once discipline for the control plane: the threefry
             # draws (fallback/relay/heal/...) jit on first call, and left
@@ -2246,6 +2265,20 @@ class TcpTransport:
                     self.sketchboard.note_remote(
                         frame.origin, frame.seq, frame.sketch, round=step
                     )
+        if (
+            self._async_guard is not None
+            and got is not None
+            and float(got[1])
+            <= self._async_guard.get(peer_index, float("-inf"))
+        ):
+            # Async publish-clock dedup: this peer's publish clock (or a
+            # newer one) already merged through SOME path — an async
+            # queue drain, a prefetch slot, a hedge leg.  Whichever leg
+            # re-delivered it, merging twice would double-count the
+            # frame, so it is dropped here as the soft ``stale``
+            # outcome before any decode/guard/trust work is spent.
+            got = None
+            outcome = Outcome.STALE
         codec = None
         sparse_guard = None   # (values, local_selected) for the guard
         sparse_trust = None   # (indices, values) for trust screening
@@ -2450,6 +2483,13 @@ class TcpTransport:
             est.observe(
                 peer_index, outcome, latency_s=latency_s, nbytes=nbytes
             )
+        if self._async_guard is not None and got is not None:
+            # Latch the merged publish clock AFTER every screen passed:
+            # a guarded/untrusted frame never merged, so a later clean
+            # re-delivery of the same clock must still be admissible.
+            ck = float(got[1])
+            if ck > self._async_guard.get(peer_index, float("-inf")):
+                self._async_guard[peer_index] = ck
         return got
 
     def _fetch_leg(
@@ -2872,6 +2912,11 @@ class TcpTransport:
             snap["obs"] = self.obs_snapshot()
         if self.incidents is not None:
             snap["incidents"] = self.incidents.snapshot()
+        if self.async_engine is not None:
+            # Present exactly when the barrier-free round loop drives
+            # this transport (protocol.async_rounds), so lock-step runs
+            # keep their health records byte-identical.
+            snap["async"] = self.async_engine.snapshot()
         return snap
 
     # dpwalint: thread_root(healthz)
@@ -3316,6 +3361,11 @@ class TcpTransport:
         rel = None
         if self.sketchboard is not None:
             _, rel = self.sketchboard.disagreement()
+        stale_peers: Sequence[int] = ()
+        if self.async_engine is not None:
+            # Peers whose frames the bounded-staleness rule dropped this
+            # round — the staleness_storm detector's evidence stream.
+            stale_peers = self.async_engine.pop_round_stale()
         fired: list = []
         opened = False
         if self.incidents is not None:
@@ -3329,6 +3379,7 @@ class TcpTransport:
                 wall_s=wall,
                 partition_state=partition_state,
                 component=component,
+                stale_peers=stale_peers,
             )
             fired = res["alerts"]
             opened = res["opened"]
@@ -3415,7 +3466,16 @@ class TcpTransport:
         round's fetch is launched before this round returns, so the
         caller's compute between exchanges hides the partner stream
         (:meth:`_exchange_pipelined`); the sequential path below is the
-        bit-identity reference the pipeline is tested against."""
+        bit-identity reference the pipeline is tested against.
+
+        With ``protocol.async_rounds`` the round goes barrier-free
+        through the :class:`~dpwa_tpu.parallel.async_loop
+        .AsyncExchangeEngine` instead — publish decoupled from merge,
+        pending frames draining staleness-damped — and the returned
+        alpha is the damped alpha applied to THIS round's schedule
+        partner (0.0 when its frame is still in flight)."""
+        if self.async_engine is not None:
+            return self._exchange_async(vec, clock, loss, step)
         if self._prefetch_on:
             return self._exchange_pipelined(vec, clock, loss, step)
         tr = self.tracer
@@ -3500,6 +3560,25 @@ class TcpTransport:
             self._membership_end_round(step)
             if rt:
                 self._trace_finish(tr)
+
+    def _exchange_async(
+        self, vec: np.ndarray, clock: float, loss: float, step: int
+    ) -> Tuple[np.ndarray, float, int]:
+        """Adapt the async engine's ``(vec, merges)`` round to the
+        lock-step ``(vec, alpha, partner)`` contract: the reported alpha
+        is the staleness-damped alpha of this round's resolved partner
+        when its frame merged, else the LAST merge applied (pending
+        frames from other peers fold in the same round).  Callers treat
+        ``alpha != 0.0`` as "the replica moved", so it must be non-zero
+        whenever ANY frame merged — 0.0 only for a genuinely empty
+        round, exactly what a skipped lock-step round reports."""
+        merged, merges = self.async_engine.exchange(vec, clock, loss, step)
+        partner = self.last_round.get("partner", self.me)
+        alpha = merges[-1][1] if merges else 0.0
+        for peer, damped, _lag in merges:
+            if peer == partner:
+                alpha = damped
+        return merged, alpha, partner
 
     def _trace_finish(self, tr) -> None:
         """Close the active round trace with the round's resolution
@@ -3691,7 +3770,21 @@ class TcpTransport:
         merges through one fused kernel — scatter-lerp for top-k,
         dynamic-slice lerp for shards (the slice-only invariant is
         structural, no host round-trip), in-kernel bitcast+upcast for
-        bf16 wires."""
+        bf16 wires.
+
+        With ``protocol.async_rounds`` the round goes barrier-free
+        through the async engine's device drain instead — same
+        ``(merged, alpha, partner)`` adaptation as :meth:`exchange`."""
+        if self.async_engine is not None:
+            merged, merges = self.async_engine.exchange_on_device(
+                vec_dev, clock, loss, step
+            )
+            partner = self.last_round.get("partner", self.me)
+            alpha = merges[-1][1] if merges else 0.0
+            for peer, damped, _lag in merges:
+                if peer == partner:
+                    alpha = damped
+            return merged, alpha, partner
         from dpwa_tpu.device import DeviceReplica, default_engine
 
         eng = default_engine()
@@ -3771,30 +3864,55 @@ class TcpTransport:
                 if got is None:
                     continue
                 remote_vec, alpha = self._weigh_remote(got, clock, loss)
-                if self._pending_topk is not None:
-                    frames.append(
-                        ("topk", self._pending_topk, peer, alpha)
-                    )
-                elif self._pending_shard is not None:
-                    frames.append((
-                        "shard",
-                        (self._pending_shard[0], remote_vec),
-                        peer, alpha,
-                    ))
-                elif (
-                    ml_dtypes is not None
-                    and remote_vec.dtype == _DTYPES[3]
-                ):
-                    frames.append(("bf16", remote_vec, peer, alpha))
-                else:
-                    if remote_vec.dtype != np.float32:
-                        remote_vec = remote_vec.astype(np.float32)
-                    frames.append(("dense", remote_vec, peer, alpha))
+                frames.append(
+                    self._classify_device_frame(remote_vec, peer, alpha)
+                )
         finally:
             self._sparse_consume = False
             self._membership_end_round(step)
-        merged = rep.dev
         merges = [(peer, alpha) for _, _, peer, alpha in frames]
+        merged = self._apply_device_frames(eng, rep.dev, frames)
+        eng.note_round()
+        if merged is not rep.dev:
+            rep.swap(merged)
+        return merged, merges
+
+    def _classify_device_frame(
+        self, remote_vec, peer: int, alpha: float
+    ) -> tuple:
+        """Map one sparse-mode consumed frame to its device-merge
+        descriptor ``(kind, payload, peer, alpha)``, reading the
+        double-buffered pending support ``_consume_fetch`` just set —
+        must therefore run before the next consume, like the merge
+        substrates themselves."""
+        if self._pending_topk is not None:
+            return ("topk", self._pending_topk, peer, alpha)
+        if self._pending_shard is not None:
+            # remote_vec IS the m-sized slice estimate (sparse consume
+            # never densified); the kernel lerps [lo, lo+m) in-graph.
+            return (
+                "shard", (self._pending_shard[0], remote_vec), peer, alpha,
+            )
+        if ml_dtypes is not None and remote_vec.dtype == _DTYPES[3]:
+            return ("bf16", remote_vec, peer, alpha)
+        if remote_vec.dtype != np.float32:
+            remote_vec = remote_vec.astype(np.float32)
+        return ("dense", remote_vec, peer, alpha)
+
+    def _apply_device_frames(
+        self, eng, start_dev, frames: Sequence[tuple], fold: bool = True,
+    ):
+        """Apply device-frame descriptors in order onto ``start_dev``.
+
+        Runs of consecutive dense frames batch into single ``fold``
+        dispatches — bit-identical to applying them as sequential
+        merges (the fold kernel's ``lax.scan`` contract); sparse and
+        bf16 frames break a run and dispatch their own fused kernel,
+        preserving order.  ``fold=False`` dispatches one kernel per
+        frame (``async_rounds.fold`` off).  Shared by the fan-in fold
+        round and the async engine's device drain; returns the merged
+        device array (the caller swaps the replica)."""
+        merged = start_dev
         run_r: list = []
         run_a: list = []
 
@@ -3802,8 +3920,9 @@ class TcpTransport:
             nonlocal merged
             if not run_r:
                 return
-            if len(run_r) == 1:
-                merged = eng.merge_dense(merged, run_r[0], run_a[0])
+            if len(run_r) == 1 or not fold:
+                for r, a in zip(run_r, run_a):
+                    merged = eng.merge_dense(merged, r, a)
             else:
                 merged = eng.fold(merged, list(run_r), list(run_a))
             run_r.clear()
@@ -3824,10 +3943,7 @@ class TcpTransport:
             else:
                 merged = eng.merge_bf16(merged, payload, alpha)
         _flush_dense()
-        eng.note_round()
-        if merged is not rep.dev:
-            rep.swap(merged)
-        return merged, merges
+        return merged
 
     def close(self) -> None:
         if self.flight is not None:

@@ -126,6 +126,13 @@ CONTROL_TAG_BASE_2 = 32
 # negotiation, and every shard is visited exactly once per k rounds.
 TAG_SHARD = _register("shard_draw", CONTROL_TAG_BASE_2 + 0)
 
+# Barrier-free async rounds (parallel/async_loop.py +
+# schedules.async_drain_draw): tie-break rotation for the deterministic
+# drain order when several peers have frames pending at the same publish
+# clock.  Keyed on the local step, so a rerun of the same soak drains
+# queues in the same order regardless of arrival timing.
+TAG_ASYNC_DRAIN = _register("async_drain_draw", CONTROL_TAG_BASE_2 + 1)
+
 
 def registered_tags() -> Dict[int, str]:
     """A copy of the full tag → name allocation map (chaos included)."""

@@ -1,0 +1,399 @@
+"""Barrier-free async gossip (``protocol.async_rounds`` — docs/async.md).
+
+These tests pin the engine's contracts: staleness damping composes
+multiplicatively with trust damping at exact values, the bounded-
+staleness drop rule triggers strictly past ``max_staleness`` (the
+boundary lag still merges, one past drops as the soft ``stale``
+outcome — degrade, never quarantine), shard frames drained async merge
+their slice bit-exactly equal to the synchronous shard exchange, the
+transport-level publish-clock guard makes double-merging a frame
+structurally impossible (the prefetch/async dedup seam), a scripted
+4-node soak under a VirtualClock replays bit-identically (vectors,
+merge logs, and snapshots), and a config without the block — or with
+``enabled: false`` — never constructs the engine and stays
+byte-identical to the lock-step path."""
+
+import numpy as np
+import pytest
+
+from dpwa_tpu.config import make_local_config
+from dpwa_tpu.flowctl.vclock import VirtualClock
+from dpwa_tpu.health.detector import Outcome
+from dpwa_tpu.health.scoreboard import PeerState
+from dpwa_tpu.parallel.async_loop import AsyncExchangeEngine
+from dpwa_tpu.parallel.tcp import TcpTransport
+
+
+def _ring(n, **cfg_kwargs):
+    cfg = make_local_config(n, base_port=0, **cfg_kwargs)
+    ts = [TcpTransport(cfg, f"node{i}") for i in range(n)]
+    for t in ts:
+        for i, other in enumerate(ts):
+            t.set_peer_port(i, other.port)
+    return ts
+
+
+def _close(ts):
+    for t in ts:
+        t.close()
+
+
+def _raw(peer, vec, clock, loss=0.0):
+    """A scripted wire-leg 9-tuple: what ``_wire_fetch`` returns for a
+    successful dense f32 stream, without a socket in sight."""
+    vec = np.asarray(vec, np.float32)
+    return (
+        int(peer), (vec, float(clock), float(loss)), Outcome.SUCCESS,
+        0.001, vec.nbytes, None, None, False, None,
+    )
+
+
+# ``fetch_probability: 0.0`` suppresses the engine's live fetch slots
+# (no round participates), so scripted ``offer()`` arrivals are the
+# ONLY frames in play — the deterministic-soak harness mode.
+_SCRIPTED = dict(fetch_probability=0.0)
+
+
+# ---------------------------------------------------------------------------
+# Staleness damping (exact values, trust composition)
+# ---------------------------------------------------------------------------
+
+
+def test_staleness_damping_exact_per_lag():
+    ts = _ring(2, async_rounds={"enabled": True, "max_staleness": 4,
+                                "staleness_damping": 0.5},
+               **_SCRIPTED)
+    try:
+        t = ts[0]
+        eng = t.async_engine
+        vec = np.ones(64, np.float32)
+        base = None
+        for lag in range(5):
+            clock = 10.0 * (lag + 1)
+            eng.offer(1, _raw(1, vec * 1.5, clock - lag))
+            _out, merges = eng.exchange(vec, clock, 0.0, int(clock))
+            assert len(merges) == 1, (lag, merges)
+            peer, damped, got_lag = merges[0]
+            assert (peer, got_lag) == (1, lag)
+            if base is None:
+                base = damped  # lag-0 alpha: interp factor, undamped
+            assert damped == pytest.approx(base * 0.5 ** lag, abs=0.0)
+    finally:
+        _close(ts)
+
+
+def test_staleness_damping_composes_with_trust_damping():
+    ts = _ring(2, async_rounds={"enabled": True, "staleness_damping": 0.5},
+               **_SCRIPTED)
+    try:
+        t = ts[0]
+        eng = t.async_engine
+        raw = _raw(1, np.ones(16, np.float32), 7.0)
+
+        # Stand in for the consume leg at the exact seam the real one
+        # uses: the screen passes and stashes the trust plane's damping
+        # for _weigh_remote's interpolation hook.
+        def consume_with_trust(r, step):
+            t._pending_trust_scale = 0.8
+            return r[1]
+
+        t._consume_fetch = consume_with_trust
+        res = eng._consume(raw, clock=10.0, loss=0.0, step=10, lag=3)
+        assert res is not None
+        _vec, damped = res
+        # alpha = interp(0.5) · trust(0.8), in f32 like _clamped computes
+        # it; staleness then scales by damping^lag — one multiplication,
+        # multiplicative composition, order-free.
+        alpha = float(np.float32(0.5) * np.float32(0.8))
+        assert damped == pytest.approx(alpha * 0.5 ** 3, rel=1e-12)
+    finally:
+        _close(ts)
+
+
+# ---------------------------------------------------------------------------
+# Bounded-staleness drop rule (boundary, soft outcome)
+# ---------------------------------------------------------------------------
+
+
+def test_drop_rule_boundary_at_max_staleness():
+    ts = _ring(2, async_rounds={"enabled": True, "max_staleness": 4,
+                                "staleness_damping": 0.5},
+               **_SCRIPTED)
+    try:
+        t = ts[0]
+        eng = t.async_engine
+        vec = np.ones(32, np.float32)
+
+        # lag == max_staleness: merges, maximally damped.
+        eng.offer(1, _raw(1, vec * 2.0, 6.0))
+        merged, alpha, _partner = t.exchange(vec, 10.0, 0.0, 10)
+        assert alpha != 0.0  # the public adapter reports the merge
+        assert not np.array_equal(merged, vec)
+        snap = eng.snapshot()
+        assert snap["merges"] == 1 and snap["stale_drops"] == 0
+        assert snap["staleness_hist"][4] == 1
+
+        # lag == max_staleness + 1: dropped as the soft `stale` outcome.
+        eng.offer(1, _raw(1, vec * 2.0, 7.0))
+        merged2, alpha2, _partner = t.exchange(vec, 12.0, 0.0, 12)
+        assert alpha2 == 0.0
+        assert np.array_equal(np.asarray(merged2, np.float32), vec)
+        snap = eng.snapshot()
+        assert snap["merges"] == 1 and snap["stale_drops"] == 1
+        assert snap["staleness_hist"][-1] == 1  # overflow bucket
+        assert snap["peers"][1]["stale"] == 1
+
+        # Soft evidence: degraded at worst, never quarantined.
+        assert t.scoreboard.state(1) != PeerState.QUARANTINED
+    finally:
+        _close(ts)
+
+
+def test_drop_records_stale_outcome_for_incident_plane():
+    ts = _ring(2, async_rounds={"enabled": True, "max_staleness": 1},
+               **_SCRIPTED)
+    try:
+        eng = ts[0].async_engine
+        eng.offer(1, _raw(1, np.ones(8, np.float32), 1.0))
+        eng.exchange(np.ones(8, np.float32), 9.0, 0.0, 9)
+        assert eng.pop_round_stale() == [1]
+        assert eng.pop_round_stale() == []  # drained
+    finally:
+        _close(ts)
+
+
+# ---------------------------------------------------------------------------
+# Dedup guard (the prefetch/async double-delivery seam)
+# ---------------------------------------------------------------------------
+
+
+def test_consume_fetch_guard_blocks_second_delivery():
+    ts = _ring(2, async_rounds={"enabled": True}, **_SCRIPTED)
+    try:
+        t = ts[0]
+        t.publish(np.ones(16, np.float32), 0.0, 0.0)
+        raw = _raw(1, np.ones(16, np.float32) * 1.25, 5.0)
+        got = t._consume_fetch(raw, 0)
+        assert got is not None  # first delivery consumes normally
+        assert t._async_guard[1] == 5.0
+        # The SAME frame delivered again (prefetched AND queued async):
+        # dropped as `stale` before decode — it can never merge twice.
+        assert t._consume_fetch(raw, 1) is None
+        assert t.last_fetch["outcome"] == Outcome.STALE
+        # An older clock is equally dead; a newer one passes.
+        assert t._consume_fetch(_raw(1, np.ones(16, np.float32), 4.0),
+                                2) is None
+        assert t._consume_fetch(_raw(1, np.ones(16, np.float32), 6.0),
+                                3) is not None
+        assert t._async_guard[1] == 6.0
+    finally:
+        _close(ts)
+
+
+def test_guard_only_latches_after_screens_pass():
+    ts = _ring(2, async_rounds={"enabled": True}, **_SCRIPTED)
+    try:
+        t = ts[0]
+        t.publish(np.ones(16, np.float32), 0.0, 0.0)
+        # A poisoned frame (non-finite) fails the recovery guard: its
+        # clock must NOT latch, so a clean re-delivery stays admissible.
+        bad = np.ones(16, np.float32)
+        bad[3] = np.nan
+        assert t._consume_fetch(_raw(1, bad, 5.0), 0) is None
+        assert 1 not in t._async_guard
+        assert t._consume_fetch(_raw(1, np.ones(16, np.float32), 5.0),
+                                1) is not None
+        assert t._async_guard[1] == 5.0
+    finally:
+        _close(ts)
+
+
+def test_queue_dedup_charges_duplicate_as_stale():
+    ts = _ring(2, async_rounds={"enabled": True}, **_SCRIPTED)
+    try:
+        eng = ts[0].async_engine
+        vec = np.ones(16, np.float32)
+        eng.offer(1, _raw(1, vec * 2.0, 3.0))
+        _out, merges = eng.exchange(vec, 3.0, 0.0, 3)
+        assert len(merges) == 1
+        # Same publish clock arrives again via another path: queue
+        # admission drops it before it ever reaches the consume leg.
+        eng.offer(1, _raw(1, vec * 2.0, 3.0))
+        _out, merges = eng.exchange(vec, 4.0, 0.0, 4)
+        assert merges == []
+        assert eng.snapshot()["dup_drops"] == 1
+    finally:
+        _close(ts)
+
+
+# ---------------------------------------------------------------------------
+# Shard frames: async slice merge == synchronous, bit-exact
+# ---------------------------------------------------------------------------
+
+
+def test_async_shard_merge_bit_exact_vs_synchronous():
+    rng = np.random.default_rng(7)
+    vec0 = rng.standard_normal(101).astype(np.float32)
+    vec1 = rng.standard_normal(101).astype(np.float32)
+
+    def publish_both(ts):
+        ts[1].publish(vec1, 0.0, 0.0)
+        ts[0].publish(vec0, 0.0, 0.0)
+
+    # Synchronous shard exchange: the lock-step reference.
+    sync = _ring(2, shard={"k": 2})
+    try:
+        publish_both(sync)
+        sync_merged, sync_alpha, _p = sync[0].exchange(vec0, 0.0, 0.0, 0)
+        assert sync_alpha != 0.0
+    finally:
+        _close(sync)
+
+    # Same frame drained through the async engine (lag 0).
+    asyn = _ring(2, shard={"k": 2},
+                 async_rounds={"enabled": True}, **_SCRIPTED)
+    try:
+        publish_both(asyn)
+        raw = asyn[0]._wire_fetch(1, step=0)
+        assert raw[1] is not None
+        asyn[0].async_engine.offer(1, raw)
+        async_merged, merges = asyn[0].async_engine.exchange(
+            vec0, 0.0, 0.0, 0
+        )
+        assert len(merges) == 1 and merges[0][2] == 0  # lag 0: undamped
+        assert async_merged.tobytes() == np.asarray(
+            sync_merged, np.float32
+        ).tobytes()
+        # And it really was a slice merge: some coordinates untouched.
+        assert np.array_equal(async_merged, vec0) is False
+        assert np.any(async_merged == vec0)
+    finally:
+        _close(asyn)
+
+
+# ---------------------------------------------------------------------------
+# Virtual-clock soak: bit-identical reruns
+# ---------------------------------------------------------------------------
+
+
+def _scripted_soak(rounds=12, nodes=4, d=64):
+    """One full scripted async soak under a VirtualClock: every arrival,
+    clock tick, and merge is a pure function of the script — the return
+    value is everything observable (replica bytes, merge logs,
+    snapshots)."""
+    ts = _ring(nodes, async_rounds={"enabled": True, "max_staleness": 4,
+                                    "staleness_damping": 0.5,
+                                    "queue_depth": 3},
+               **_SCRIPTED)
+    try:
+        vc = VirtualClock()
+        engines = []
+        for t in ts:
+            eng = AsyncExchangeEngine(t, now=vc)
+            engines.append(eng)
+        rng = np.random.default_rng(3)
+        vecs = [rng.standard_normal(d).astype(np.float32)
+                for _ in range(nodes)]
+        history = [[v.copy()] for v in vecs]  # per-node vec per round
+        merge_log = []
+        for r in range(rounds):
+            for i in range(nodes):
+                for j in range(nodes):
+                    if j == i:
+                        continue
+                    if j == (i + 1) % nodes:
+                        # Scripted straggler source: its frames always
+                        # lag past max_staleness, so they drop stale
+                        # every round (never merged ⇒ never guarded).
+                        back = 5
+                    elif (r + i + j) % 3 == 0:
+                        continue
+                    else:
+                        # Frame from j as of an earlier round: lags
+                        # 0..3 merge damped; revisited old clocks fall
+                        # below the dedup watermark and drop duplicate.
+                        back = (i + j + r) % 4
+                    pub = max(r - back, 0)
+                    vc.advance(0.001)
+                    engines[i].offer(
+                        j, _raw(j, history[j][pub], float(pub))
+                    )
+                vc.advance(0.005)
+                out, merges = engines[i].exchange(
+                    vecs[i], float(r), 0.0, r
+                )
+                vecs[i] = np.asarray(out, np.float32)
+                merge_log.append((r, i, merges))
+            for i in range(nodes):
+                history[i].append(vecs[i].copy())
+        return (
+            [v.tobytes() for v in vecs],
+            merge_log,
+            [e.snapshot() for e in engines],
+        )
+    finally:
+        _close(ts)
+
+
+def test_virtual_clock_soak_bit_identical_across_reruns():
+    run1 = _scripted_soak()
+    run2 = _scripted_soak()
+    assert run1[0] == run2[0]  # replicas, byte for byte
+    assert run1[1] == run2[1]  # merge logs: order, alpha, lag
+    assert run1[2] == run2[2]  # snapshots, spans included
+    # The soak exercised the whole plane, not a degenerate corner.
+    totals = run1[2]
+    assert sum(s["merges"] for s in totals) > 0
+    assert sum(s["stale_drops"] for s in totals) > 0
+    assert sum(s["dup_drops"] for s in totals) > 0
+
+
+def test_scripted_soak_converges_despite_staleness():
+    final_bytes, _log, _snaps = _scripted_soak()
+    rng = np.random.default_rng(3)  # the soak's initial replicas
+    init = [rng.standard_normal(64).astype(np.float32) for _ in range(4)]
+    final = [np.frombuffer(b, np.float32) for b in final_bytes]
+
+    def spread(vs):
+        s = np.stack(vs)
+        return float(np.sqrt(np.mean((s - s.mean(axis=0)) ** 2)))
+
+    # Damped stale merges still average the ring: the cross-node spread
+    # must shrink substantially even with a permanently-stale source
+    # dropping every round.
+    assert spread(final) < 0.5 * spread(init)
+
+
+# ---------------------------------------------------------------------------
+# Off ⇒ the lock-step path, byte-identical
+# ---------------------------------------------------------------------------
+
+
+def test_async_disabled_is_byte_identical_lock_step():
+    rng = np.random.default_rng(11)
+    base = [rng.standard_normal(48).astype(np.float32) for _ in range(2)]
+
+    def drive(**kw):
+        ts = _ring(2, **kw)
+        try:
+            assert all(t.async_engine is None for t in ts)
+            assert all(t._async_guard is None for t in ts)
+            vecs = [b.copy() for b in base]
+            outs = []
+            for it in range(4):
+                for i, t in enumerate(ts):
+                    t.publish(vecs[i], float(it), 0.0)
+                for i, t in enumerate(ts):
+                    merged, alpha, _p = t.exchange(
+                        vecs[i], float(it), 0.0, it
+                    )
+                    if alpha != 0.0:
+                        vecs[i] = np.asarray(merged, np.float32)
+                outs.append([v.tobytes() for v in vecs])
+            return outs
+        finally:
+            _close(ts)
+
+    absent = drive()
+    explicit_off = drive(async_rounds={"enabled": False})
+    assert absent == explicit_off

@@ -215,6 +215,24 @@ def test_state_storm_counts_board_transitions():
     assert inc["peers"] == [1, 2]
 
 
+def test_staleness_storm_fires_on_clustered_drops():
+    p = _plane(incident_stale_storm=3, incident_window=8)
+    out = p.observe_round(0, stale_peers=[2])
+    assert out["alerts"] == []  # one drop
+    out = p.observe_round(1, stale_peers=[2, 3])
+    assert "staleness_storm" in out["alerts"]  # three inside the window
+    inc = p.snapshot()["open"][0]
+    assert inc["kind"] == "staleness_storm"
+    assert inc["peers"] == [2, 3]
+    # Persisting storm is silent support; re-arms only after it clears.
+    out = p.observe_round(2, stale_peers=[2])
+    assert out["alerts"] == []
+    for step in range(3, 12):  # drops age out of the window
+        p.observe_round(step)
+    out = p.observe_round(12, stale_peers=[1, 2, 3])
+    assert "staleness_storm" in out["alerts"]
+
+
 def test_slo_burn_needs_warmup_and_consecutive_rounds():
     p = _plane(incident_slo_warmup=4, incident_slo_rounds=2,
                incident_slo_factor=4.0)

@@ -743,12 +743,14 @@ def test_threefry_tags_are_pinned():
         27: "chaos:stall",
         28: "chaos:stall_len",
         32: "shard_draw",
+        33: "async_drain_draw",
     }
     assert tags.CHAOS_TAG_BASE == 16
     # Second control-plane block: 0..15 is full, 16..31 belongs to the
     # chaos fault-kind streams, so new control draws allocate from 32 up.
     assert tags.CONTROL_TAG_BASE_2 == 32
     assert tags.TAG_SHARD == 32
+    assert tags.TAG_ASYNC_DRAIN == 33
 
 
 def test_tag_collision_raises():

@@ -49,6 +49,14 @@ the deepest ready batch, the open-connection high-water mark, and the
 timer-wheel eviction / busy-shed totals — present only for runs under
 ``protocol.rx_server: reactor``.
 
+``--async`` prints the barrier-free async round digest (docs/async.md):
+the staleness histogram (merged frames by publish-clock lag, plus the
+overflow bucket = bounded-staleness drops), cumulative drop/dedup/shed
+totals, fold batching, and a per-peer un-throttled verdict — whether
+each peer's frames kept merging (``merging``), were mostly discarded as
+stale (``mostly-stale``), or never arrived (``idle``) — present only
+for runs under ``protocol.async_rounds``.
+
 Usage::
 
     python tools/health_report.py metrics.jsonl [more.jsonl ...]
@@ -58,6 +66,7 @@ Usage::
     python tools/health_report.py --flowctl metrics.jsonl
     python tools/health_report.py --wire metrics.jsonl
     python tools/health_report.py --reactor metrics.jsonl
+    python tools/health_report.py --async metrics.jsonl
 """
 
 from __future__ import annotations
@@ -185,6 +194,31 @@ def summarize(
         "evicted_final": None,
         "busy_shed_final": None,
     }
+
+    async_: Dict[str, Any] = {
+        "seen": False,  # any async_* column in the records
+        "rounds_final": None,
+        "merges_final": None,
+        "stale_drops_final": None,
+        "dup_drops_final": None,
+        "shed_final": None,
+        "fold_frames_final": None,
+        "staleness_hist_final": None,
+        "peers": {},  # p -> merges/stale/pending/lag finals + verdict
+    }
+
+    def async_slot(p: int) -> Dict[str, Any]:
+        return async_["peers"].setdefault(
+            int(p),
+            {
+                "merges_final": None,
+                "stale_final": None,
+                "pending_final": None,
+                "lag_final": None,
+                "lag_max": None,
+                "verdict": None,
+            },
+        )
 
     membership: Dict[str, Any] = {
         "partitions_entered": 0,
@@ -417,6 +451,33 @@ def summarize(
                     reactor["open_max"] = opened
                 reactor["evicted_final"] = rec.get("reactor_evicted")
                 reactor["busy_shed_final"] = rec.get("reactor_busy_shed")
+            if rec.get("async_rounds") is not None:
+                async_["seen"] = True
+                async_["rounds_final"] = rec["async_rounds"]
+                async_["merges_final"] = rec.get("async_merges")
+                async_["stale_drops_final"] = rec.get("async_stale_drops")
+                async_["dup_drops_final"] = rec.get("async_dup_drops")
+                async_["shed_final"] = rec.get("async_shed")
+                async_["fold_frames_final"] = rec.get("async_fold_frames")
+                async_["staleness_hist_final"] = rec.get(
+                    "async_staleness_hist"
+                )
+                for i, p in enumerate(rec.get("peer", [])):
+                    asl = async_slot(p)
+                    for key, col in (
+                        ("merges_final", "async_peer_merges"),
+                        ("stale_final", "async_peer_stale"),
+                        ("pending_final", "async_peer_pending"),
+                        ("lag_final", "async_peer_lag"),
+                    ):
+                        vals = rec.get(col)
+                        if vals is not None:
+                            asl[key] = vals[i]
+                    lag = asl["lag_final"]
+                    if lag is not None and (
+                        asl["lag_max"] is None or lag > asl["lag_max"]
+                    ):
+                        asl["lag_max"] = lag
             continue
         if "outcome" not in rec and "sched_partner" not in rec:
             continue  # not an exchange record (loss-only, etc.)
@@ -463,6 +524,19 @@ def summarize(
     for p, h in last_health.items():
         slot(p)["health"] = h
     events["poisoned_fetches"] = poisoned
+    for asl in async_["peers"].values():
+        # Un-throttled verdict: did this peer's frames keep merging
+        # (the straggler-proofness claim — a slow peer degrades to
+        # damped/stale, it never throttles the loop), or were they
+        # mostly discarded as stale, or did it never land a frame?
+        merges = asl["merges_final"] or 0
+        stale = asl["stale_final"] or 0
+        if merges == 0 and stale == 0:
+            asl["verdict"] = "idle"
+        elif stale > merges:
+            asl["verdict"] = "mostly-stale"
+        else:
+            asl["verdict"] = "merging"
     for ts in trust["peers"].values():
         # Quarantine latency: first untrusted payload -> first health
         # record showing the peer quarantined.  An upper bound (health
@@ -489,6 +563,7 @@ def summarize(
         "flowctl": flowctl,
         "wire": wire,
         "reactor": reactor,
+        "async": async_,
     }
 
 
@@ -624,6 +699,49 @@ def _print_reactor(summary: Dict[str, Any]) -> None:
         f"{r.get('evicted_final')}; busy frames shed "
         f"{r.get('busy_shed_final')}"
     )
+
+
+def _print_async(summary: Dict[str, Any]) -> None:
+    a = summary.get("async", {})
+    print()
+    print("# async")
+    if not a.get("seen"):
+        print(
+            "  no async records in input (lock-step rounds, or "
+            "protocol.async_rounds disabled?)"
+        )
+        return
+    print(
+        f"  rounds driven: {a.get('rounds_final')}; merges: "
+        f"{a.get('merges_final')}; stale drops: "
+        f"{a.get('stale_drops_final')}, dup drops: "
+        f"{a.get('dup_drops_final')}, queue sheds: {a.get('shed_final')}"
+    )
+    hist = a.get("staleness_hist_final")
+    if hist:
+        buckets = ", ".join(
+            (
+                f"lag {i}: {n}"
+                if i < len(hist) - 1
+                else f"dropped (> max): {n}"
+            )
+            for i, n in enumerate(hist)
+        )
+        print(f"  staleness histogram (merged frames): {buckets}")
+    if a.get("fold_frames_final"):
+        print(
+            f"  dense frames batched through fold dispatches: "
+            f"{a['fold_frames_final']}"
+        )
+    for p, asl in sorted(a.get("peers", {}).items()):
+        print(
+            f"  peer {p}: {asl.get('verdict')}; "
+            f"merges={asl.get('merges_final')}, "
+            f"stale={asl.get('stale_final')}, "
+            f"pending={asl.get('pending_final')}, "
+            f"last lag={asl.get('lag_final')} "
+            f"(max seen {asl.get('lag_max')})"
+        )
 
 
 def _print_table(summary: Dict[str, Any]) -> None:
@@ -784,6 +902,14 @@ def main(argv=None) -> int:
         "ready-batch depth, connection highs, evictions, busy sheds; "
         "docs/transport.md)",
     )
+    ap.add_argument(
+        "--async",
+        dest="async_digest",
+        action="store_true",
+        help="print the barrier-free async round digest (staleness "
+        "histogram, bounded-staleness drops, fold batching, per-peer "
+        "un-throttled verdict; docs/async.md)",
+    )
     args = ap.parse_args(argv)
     summary = summarize(args.paths, split_step=args.split_step)
     if args.json:
@@ -799,6 +925,8 @@ def main(argv=None) -> int:
             _print_wire(summary)
         if args.reactor:
             _print_reactor(summary)
+        if args.async_digest:
+            _print_async(summary)
     return 0
 
 

@@ -42,7 +42,8 @@ from typing import Any, Dict, Iterable, List, Optional
 # stdlib-only and usable on a box without the package installed.
 KIND_PRIORITY = (
     "island_partition", "partition", "byzantine", "leader_failover",
-    "peer_down", "straggler", "state_storm", "slo_burn", "conv_stall",
+    "peer_down", "straggler", "staleness_storm", "state_storm",
+    "slo_burn", "conv_stall",
 )
 
 # Rounds of slack when overlapping per-node incident windows: nodes

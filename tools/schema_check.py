@@ -152,6 +152,24 @@ _HEALTH_GROUPS: Dict[str, Dict[str, tuple]] = {
         "reactor_evicted": (int,),
         "reactor_busy_shed": (int,),
     },
+    # Barrier-free async round loop (docs/async.md; present exactly
+    # when protocol.async_rounds drives the transport).
+    # ``async_staleness_hist`` is a lag histogram (buckets 0..
+    # max_staleness + overflow), not a per-peer column — exempted from
+    # the parallel-array check below, like ``component``.
+    "async": {
+        "async_rounds": (int,),
+        "async_merges": (int,),
+        "async_stale_drops": (int,),
+        "async_dup_drops": (int,),
+        "async_shed": (int,),
+        "async_fold_frames": (int,),
+        "async_staleness_hist": (list,),
+        "async_peer_merges": (list,),
+        "async_peer_stale": (list,),
+        "async_peer_pending": (list,),
+        "async_peer_lag": (list,),
+    },
 }
 
 _TRACE_ROUND_REQUIRED: Dict[str, tuple] = {
@@ -452,12 +470,13 @@ def check_record(rec: dict) -> List[str]:
             if field not in known:
                 errs.append(f"unknown field {field!r}")
         # Parallel-array discipline: every list column matches peer.
-        # (``component`` is the membership member list, not a per-peer
-        # column; ``peer`` is the key column itself.)
+        # (``component`` is the membership member list and
+        # ``async_staleness_hist`` a lag histogram, not per-peer
+        # columns; ``peer`` is the key column itself.)
         peers = rec.get("peer")
         if isinstance(peers, list):
             for f, v in rec.items():
-                if f in ("peer", "component"):
+                if f in ("peer", "component", "async_staleness_hist"):
                     continue
                 if isinstance(v, list) and len(v) != len(peers):
                     errs.append(
