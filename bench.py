@@ -1240,6 +1240,145 @@ def async_gate(
     return gate
 
 
+FLEET_GATE_WINDOW = 8
+FLEET_GATE_REL_TOL = 0.5
+# The leg's fixed view block: the O(sample) claim is about THESE bounds
+# holding flat while N grows 16x, so the bench pins them rather than
+# exposing knobs that would make history entries incomparable.
+FLEET_LEG_VIEW = dict(
+    enabled=True, active_size=8, passive_size=32, digest_sample=16,
+    state_cap=64, shuffle_every=8,
+)
+
+
+def fleet_gate(
+    history: list,
+    current_bytes,
+    window: int = FLEET_GATE_WINDOW,
+    rel_tol: float = FLEET_GATE_REL_TOL,
+    methodology: int = BENCH_METHODOLOGY,
+) -> dict:
+    """Regression gate for the fleet leg's per-node resident state
+    (pure; mirrors :func:`tcp_gate`'s median-window + like-with-like
+    ``bench_methodology`` filter, with the band inverted: resident
+    BYTES are a cost, so drifting up is the regression).  A refactor
+    that sneaks an O(N) map back into a control plane — a snapshot that
+    iterates ``range(n_peers)``, a per-peer dict that stops pruning on
+    eviction — inflates the largest-N residency figure and shows up
+    here as "regressed" against recent medians."""
+    samples = [
+        float(e["fleet_resident_bytes"])
+        for e in history
+        if isinstance(e, dict)
+        and e.get("record") == "bench"
+        and e.get("bench_methodology") == methodology
+        and isinstance(e.get("fleet_resident_bytes"), (int, float))
+        and not isinstance(e.get("fleet_resident_bytes"), bool)
+    ][-int(window):]
+    median = float(np.median(samples)) if samples else None
+    gate = {
+        "samples": len(samples),
+        "window": int(window),
+        "rel_tol": float(rel_tol),
+        "methodology": int(methodology),
+        "median_bytes": round(median, 1) if median is not None else None,
+        "current_bytes": (
+            round(float(current_bytes), 1)
+            if current_bytes is not None else None
+        ),
+    }
+    if current_bytes is None or len(samples) < 2:
+        gate["verdict"] = "no_data"
+        return gate
+    cur = float(current_bytes)
+    if cur > median * (1.0 + rel_tol):
+        gate["verdict"] = "regressed"
+    elif cur < median * (1.0 - rel_tol):
+        gate["verdict"] = "improved"
+    else:
+        gate["verdict"] = "ok"
+    return gate
+
+
+def bench_fleet(
+    peer_counts,
+    rounds: int = 24,
+    seed: int = 0,
+) -> dict:
+    """Orchestrator soak across ``peer_counts`` under a fixed partial
+    view (docs/membership.md): per-node resident control-plane bytes
+    and digest bytes/frame, measured while the fleet churns.
+
+    The acceptance shape is O(sample)/O(state_cap): the residency and
+    frame figures at N=4096 must sit in the same band as at N=256
+    (``resident_scaling`` ~1x while ``peer_scaling`` is 16x), because
+    every per-peer map is capped and every frame is sampled.  Residency
+    comes from :meth:`FleetOrchestrator.residency_snapshot` — measured
+    ``sys.getsizeof`` sums over the live containers, never layout
+    arithmetic (the wire-sweep discipline)."""
+    from dpwa_tpu.config import HealthConfig, MembershipConfig, ViewConfig
+    from dpwa_tpu.fleet.orchestrator import FleetOrchestrator
+    from dpwa_tpu.fleet.schedule import ChurnSpec
+
+    view = ViewConfig(**FLEET_LEG_VIEW)
+    legs: dict = {}
+    for n in sorted(int(n) for n in peer_counts):
+        spec = ChurnSpec(
+            seed=seed,
+            leave_probability=0.002,
+            join_probability=0.2,
+            cohort_every=8,
+            cohort_max=max(2, n // 512),
+            restart_every=10,
+            min_live=max(2, (7 * n) // 8),
+        )
+        orch = FleetOrchestrator(
+            n, spec, dim=8,
+            health=HealthConfig(jitter_rounds=0),
+            membership=MembershipConfig(
+                dead_after_quarantines=2,
+                dead_gossip_rounds=4,
+                view=view,
+            ),
+        )
+        t0 = time.perf_counter()
+        res = orch.run(int(rounds))
+        wall = time.perf_counter() - t0
+        ep = res.episode
+        live = [p for p in range(n) if orch.nodes[p].alive]
+        stride = max(1, len(live) // 64)
+        snaps = [orch.residency_snapshot(p) for p in live[::stride]]
+        resident = sorted(s["resident_bytes"] for s in snaps)
+        legs[f"n{n}"] = {
+            "n_peers": int(n),
+            "rounds": int(rounds),
+            "resident_bytes_median": int(np.median(resident)),
+            "resident_bytes_max": int(ep["view_max_resident_bytes"]),
+            "tracked_max": int(ep["view_max_tracked"]),
+            "digest_entries_max": int(ep["view_max_digest_entries"]),
+            "digest_bytes_max": int(ep["max_digest_bytes"]),
+            "round_wall_ms": round(wall / max(1, rounds) * 1e3, 3),
+            "final_live": int(ep["final_live"]),
+        }
+    ns = sorted(int(n) for n in peer_counts)
+    lo, hi = legs[f"n{ns[0]}"], legs[f"n{ns[-1]}"]
+    return {
+        "view": dict(FLEET_LEG_VIEW),
+        "legs": legs,
+        # 16x more peers should cost ~1x more per-node state: the
+        # headline pair the gate and the README table quote.
+        "peer_scaling": round(ns[-1] / max(1, ns[0]), 4),
+        "resident_scaling": round(
+            hi["resident_bytes_max"] / max(1, lo["resident_bytes_max"]), 4
+        ),
+        "digest_scaling": round(
+            hi["digest_bytes_max"] / max(1, lo["digest_bytes_max"]), 4
+        ),
+        "fleet_resident_bytes": hi["resident_bytes_max"],
+        "fleet_digest_bytes": hi["digest_bytes_max"],
+    }
+
+
 def bench_async(
     d: int = ASYNC_SWEEP_FLOATS,
     iters: int = 24,
@@ -2063,6 +2202,23 @@ def main() -> None:
         help="straggler serving rate (bytes/s) for the async leg",
     )
     ap.add_argument(
+        "--fleet-leg", action="store_true",
+        help="run ONLY the fleet partial-view leg: orchestrator soaks "
+        "at --fleet-peers under a fixed membership.view block, "
+        "recording per-node resident control-plane bytes and digest "
+        "bytes/frame (the O(sample)/O(state_cap) acceptance); appends "
+        "its own bench_history.jsonl record carrying a fleet_gate "
+        "verdict",
+    )
+    ap.add_argument(
+        "--fleet-peers", type=str, default="256,1024,4096",
+        help="comma-separated fleet sizes for the fleet leg",
+    )
+    ap.add_argument(
+        "--fleet-rounds", type=int, default=24,
+        help="churn rounds per fleet-leg soak",
+    )
+    ap.add_argument(
         "--confirm-timeout", type=float, default=DEAD_CONFIRM_TIMEOUT_S,
         help="capped single-probe timeout once the backend dead-streak "
         "has tripped (the cheap re-confirmation instead of the full "
@@ -2232,6 +2388,59 @@ def main() -> None:
             "async_gate": gate,
         }
         print("ASYNC_LEG " + json.dumps(sweep), flush=True)
+        print(json.dumps(out), flush=True)
+        try:
+            os.makedirs(os.path.dirname(history_path), exist_ok=True)
+            with open(history_path, "a", encoding="utf-8") as f:
+                f.write(
+                    json.dumps({"record": "bench", "t": time.time(), **out})
+                    + "\n"
+                )
+        except OSError:
+            pass
+        return
+    if args.fleet_leg:
+        # Standalone mode (the --async-leg pattern): the plane-level
+        # orchestrator in-process on the CPU backend.  Appends its own
+        # record="bench" history line carrying the fleet_gate verdict.
+        ns = [int(s) for s in args.fleet_peers.split(",") if s.strip()]
+        log(
+            f"fleet leg: peers {ns}, {args.fleet_rounds} churn rounds, "
+            f"view {FLEET_LEG_VIEW['digest_sample']}-sample / "
+            f"{FLEET_LEG_VIEW['state_cap']}-cap ..."
+        )
+        sweep = bench_fleet(ns, rounds=args.fleet_rounds)
+        for name in sorted(sweep["legs"]):
+            leg = sweep["legs"][name]
+            log(
+                f"fleet leg: {name} -> resident "
+                f"{leg['resident_bytes_max']} B/node (max), digest "
+                f"{leg['digest_bytes_max']} B/frame, tracked "
+                f"{leg['tracked_max']}, {leg['round_wall_ms']} ms/round"
+            )
+        log(
+            f"fleet leg: {sweep['peer_scaling']}x peers -> "
+            f"{sweep['resident_scaling']}x resident bytes, "
+            f"{sweep['digest_scaling']}x digest bytes"
+        )
+        history_path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "artifacts", "bench_history.jsonl",
+        )
+        gate = fleet_gate(
+            read_bench_history(history_path),
+            sweep["fleet_resident_bytes"],
+        )
+        log(f"fleet leg: gate {gate['verdict']}")
+        out = {
+            "metric": "fleet_bounded_view_residency",
+            "bench_methodology": BENCH_METHODOLOGY,
+            "fleet_leg": sweep,
+            "fleet_resident_bytes": sweep["fleet_resident_bytes"],
+            "fleet_digest_bytes": sweep["fleet_digest_bytes"],
+            "fleet_gate": gate,
+        }
+        print("FLEET_LEG " + json.dumps(sweep), flush=True)
         print(json.dumps(out), flush=True)
         try:
             os.makedirs(os.path.dirname(history_path), exist_ok=True)

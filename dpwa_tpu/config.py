@@ -805,6 +805,72 @@ class RecoveryConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class ViewConfig:
+    """``membership.view:`` block — bounded partial views (docs/membership.md).
+
+    Shrinks every control plane's horizon from the full ``nodes:``
+    universe to a HyParView-style partial view: the **active** view is
+    the peers this node gossips with and probes; the **passive** view is
+    a churn-refreshed reservoir that supplies replacements when an
+    active peer is evicted.  Digests are truncated to a threefry-drawn
+    sample of ``digest_sample`` tracked peers per frame (wire format
+    unchanged — receivers already merge arbitrary subsets), and the
+    per-peer maps in trust / flowctl / scoreboard / membership are
+    LRU-capped at ``state_cap``.
+
+    Identity guarantee: with ``digest_sample >= N``, ``state_cap >= N``
+    and ``active_size >= N - 1``, every frame and every plane decision
+    is byte-identical to the global-view (``enabled: false``) behavior —
+    sampling only ever truncates, never reorders or rewrites."""
+
+    enabled: bool = False
+    # Active view size: partner / relay / hedge draws range over (the
+    # healthy subset of) these peers instead of all of ``nodes:``.
+    active_size: int = 8
+    # Passive reservoir size (candidates for promotion on failure).
+    passive_size: int = 32
+    # Tracked peers sampled into each published digest frame.
+    digest_sample: int = 16
+    # LRU cap on per-peer map residency across the scoreboard, trust,
+    # deadline-estimator, and membership planes.  Evictions flow through
+    # the PR 11 evict-listener path (tombstone + prune); QUARANTINED
+    # peers with an unexpired streak and collapsed-trust peers are never
+    # cap-evicted.
+    state_cap: int = 64
+    # Shuffle cadence: every this-many rounds one passive slot is
+    # refreshed from the recently-seen universe (0 disables shuffling).
+    shuffle_every: int = 8
+
+    def __post_init__(self) -> None:
+        if self.active_size < 1:
+            raise ValueError(
+                f"view.active_size must be >= 1, got {self.active_size}"
+            )
+        if self.passive_size < 0:
+            raise ValueError(
+                f"view.passive_size must be >= 0, got {self.passive_size}"
+            )
+        if self.digest_sample < 1:
+            raise ValueError(
+                f"view.digest_sample must be >= 1, got {self.digest_sample}"
+            )
+        if self.state_cap < 1:
+            raise ValueError(
+                f"view.state_cap must be >= 1, got {self.state_cap}"
+            )
+        if self.state_cap < self.active_size:
+            raise ValueError(
+                f"view.state_cap ({self.state_cap}) must be >= "
+                f"view.active_size ({self.active_size}): the active view "
+                f"is always tracked"
+            )
+        if self.shuffle_every < 0:
+            raise ValueError(
+                f"view.shuffle_every must be >= 0, got {self.shuffle_every}"
+            )
+
+
+@dataclasses.dataclass(frozen=True)
 class MembershipConfig:
     """``membership:`` block — epidemic membership & partition tolerance.
 
@@ -855,8 +921,14 @@ class MembershipConfig:
     # Clamp on the returning side's merge weight, so even a majority
     # returning component cannot fully overwrite the local replica.
     max_heal_weight: float = 0.75
+    # Bounded partial views (nested ``view:`` block; accepts a plain
+    # dict from YAML).  Off by default: the global-view behavior of
+    # every pre-view release.
+    view: ViewConfig = dataclasses.field(default_factory=ViewConfig)
 
     def __post_init__(self) -> None:
+        if isinstance(self.view, Mapping):
+            object.__setattr__(self, "view", ViewConfig(**self.view))
         if self.indirect_probes < 0:
             raise ValueError(
                 f"indirect_probes must be >= 0, got {self.indirect_probes}"

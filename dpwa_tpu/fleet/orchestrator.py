@@ -32,6 +32,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import sys
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -393,15 +394,26 @@ class FleetOrchestrator:
             obs_outcome: Optional[str] = None
             obs_partner: Optional[int] = None
             round_outcomes: Dict[str, int] = {}
+            use_view = self.membership_cfg.view.enabled
             for f in sorted(live):
                 node = self.nodes[f]
                 partner = self._sched.partner(r, f)
                 if partner != f and node.board.is_quarantined(
                     partner, r
                 ):
-                    partner = self._sched.remap_partner(
-                        r, f, partner, node.board.healthy_mask(r)
-                    )
+                    if use_view:
+                        # Bounded remap (membership.view): the fallback
+                        # draw ranges over the node's active view and an
+                        # O(active) healthy map — never an O(N) mask.
+                        cands = node.membership.partner_candidates()
+                        partner = self._sched.remap_partner(
+                            r, f, partner,
+                            node.board.healthy_map(cands, r), cands,
+                        )
+                    else:
+                        partner = self._sched.remap_partner(
+                            r, f, partner, node.board.healthy_mask(r)
+                        )
                 if partner == f:
                     continue
                 outcome = self._fetch_outcome(
@@ -434,8 +446,12 @@ class FleetOrchestrator:
             # -- probes (readmission + evicted-ghost reprobe) ---------
             for f in sorted(live):
                 node = self.nodes[f]
-                for q in range(self.n_peers):
-                    if q == f or not node.board.probe_due(q, r):
+                # O(quarantined + tombstones) walk: probe_candidates()
+                # returns exactly the peers probe_due() would flag, so
+                # this stays byte-identical to the full range(N) scan
+                # while making 4096-peer rounds affordable.
+                for q in node.board.probe_candidates(r):
+                    if q == f:
                         continue
                     ok = self.nodes[q].alive and not self._blocked(
                         f, q, group
@@ -556,6 +572,51 @@ class FleetOrchestrator:
         den = float(np.sqrt(np.mean(mean**2))) + 1e-12
         return num / den
 
+    def residency_snapshot(self, peer: int) -> dict:
+        """Resident per-peer control-plane state for one live node.
+
+        Returns entry counts and an approximate resident byte figure
+        (``sys.getsizeof`` sums over the per-peer containers) for the
+        scoreboard and membership planes — the quantity the fleet bench
+        leg records per node to prove the ``membership.view``
+        ``state_cap`` bound holds at 4096 (docs/membership.md).  The
+        byte figure is an approximation, but a consistent one across N,
+        which is all an O(sample)-vs-O(N) verdict needs.
+        """
+        node = self.nodes[peer]
+        board, member = node.board, node.membership
+        if board is None or member is None:
+            return {"peer": peer, "alive": False}
+        board_maps = [
+            board._state, board._quarantine_streak, board._quarantines,
+            board._degrades, board._probe_attempts, board._last_contact,
+            board._evicted, board.detector._peers,
+        ]
+        member_maps: list = [member._view, member._evicted, member._capped]
+        part = member.partial
+        if part is not None:
+            member_maps.extend([part.active, part.passive, part._last_touch])
+        nbytes = 0
+        for m in board_maps + member_maps:
+            nbytes += sys.getsizeof(m)
+            if isinstance(m, dict):
+                for v in m.values():
+                    nbytes += sys.getsizeof(v)
+        snap = {
+            "peer": peer,
+            "alive": True,
+            "board_tracked": len(board.tracked_peers()),
+            "board_tombstones": len(board._evicted),
+            "member_tracked": len(member._view),
+            "member_capped": len(member._capped),
+            "digest_entries": member._digest_entries_last,
+            "resident_bytes": nbytes,
+        }
+        if part is not None:
+            snap["view_active"] = len(part.active)
+            snap["view_passive"] = len(part.passive)
+        return snap
+
     def _settle_convergence(self, r: int) -> None:
         """Resolve pending leave/join events against the OBSERVER's
         view: a leave converges when the observer evicts the ghost, a
@@ -604,6 +665,21 @@ class FleetOrchestrator:
             "alerts": dict(sorted(alerts_total.items())),
             "incidents_opened": incidents_opened,
         }
+        if self.membership_cfg.view.enabled:
+            # View-only optional fields (legacy episodes byte-identical):
+            # worst-case residency across live nodes — the O(state_cap)
+            # figures the fleet bench gate rides on (docs/membership.md).
+            res = [self.residency_snapshot(p) for p in live]
+            episode["view_max_resident_bytes"] = max(
+                (s["resident_bytes"] for s in res), default=0
+            )
+            episode["view_max_tracked"] = max(
+                (max(s["board_tracked"], s["member_tracked"]) for s in res),
+                default=0,
+            )
+            episode["view_max_digest_entries"] = max(
+                (s["digest_entries"] for s in res), default=0
+            )
         if self.topology is not None:
             # Hier-only optional fields (flat episodes byte-identical).
             episode["islands"] = self.topology.n_islands

@@ -113,6 +113,13 @@ class DeadlineEstimator:
             self._window.pop(peer, None)
             self._counts.pop(peer, None)
 
+    def tracked_peers(self) -> list:
+        """Every peer with a resident latency window or counters — the
+        residency set the partial-view ``state_cap`` bounds
+        (docs/membership.md)."""
+        with self._lock:
+            return sorted(set(self._window) | set(self._counts))
+
     def note_hedge_win(self, peer: int) -> None:
         """The hedge against ``peer`` won the race (fallback's payload
         merged; ``peer``'s fetch was cancelled and classified slow)."""

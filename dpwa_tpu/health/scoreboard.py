@@ -87,6 +87,10 @@ class Scoreboard:
         self._degraded_at: Dict[int, int] = {}
         self._probe_attempts: Dict[int, int] = {}
         self._probe_successes: Dict[int, int] = {}
+        # Round of last direct contact (fetch outcome or probe) per
+        # peer — the recency signal the partial-view LRU cap orders
+        # victims by (docs/membership.md).  Pruned on eviction.
+        self._last_contact: Dict[int, int] = {}
         # Membership-evicted peers (peer -> round evicted).  Every other
         # per-peer dict is pruned at eviction, and `_state.get(peer,
         # HEALTHY)` defaults healthy, so this set is what keeps a
@@ -120,6 +124,7 @@ class Scoreboard:
                 # completion, a relayed probe) must not regrow its state
                 # — re-admission goes through record_probe/readmit only.
                 return PeerState.QUARANTINED
+            self._last_contact[peer] = r
             suspicion = self.detector.observe(peer, outcome, latency_s, nbytes)
             if self._state.get(peer) != PeerState.QUARANTINED:
                 self._apply_suspicion(peer, outcome, suspicion, r)
@@ -187,6 +192,7 @@ class Scoreboard:
                 self._quarantine_streak[peer] = 0
                 self._probe_attempts[peer] = 1
                 self._probe_successes[peer] = 1
+                self._last_contact[peer] = r
                 rec = self.detector.record(peer)
                 rec.suspicion = 0.0
                 rec.failure_streak = 0
@@ -197,6 +203,7 @@ class Scoreboard:
                     membership.on_peer_returned(peer, r)
                 return
             self._probe_attempts[peer] = self._probe_attempts.get(peer, 0) + 1
+            self._last_contact[peer] = r
             if self._state.get(peer) != PeerState.QUARANTINED:
                 # Symmetric path: probes are evidence, same as fetches.
                 if success:
@@ -310,11 +317,35 @@ class Scoreboard:
                 self._degraded_at,
                 self._probe_attempts,
                 self._probe_successes,
+                self._last_contact,
             ):
                 d.pop(peer, None)
             self.detector.evict(peer)
             self._evicted[peer] = r
             return True
+
+    def tracked_peers(self) -> List[int]:
+        """Every peer with resident per-peer state in ANY scoreboard or
+        detector map (tombstones excluded) — the residency set the
+        partial-view ``state_cap`` bounds (docs/membership.md)."""
+        with self._lock:
+            keys = (
+                set(self._state)
+                | set(self._quarantine_streak)
+                | set(self._quarantines)
+                | set(self._degrades)
+                | set(self._probe_attempts)
+                | set(self._last_contact)
+                | set(self.detector._peers)
+            )
+            keys -= set(self._evicted)
+            keys.discard(self.me)
+            return sorted(keys)
+
+    def last_contact_map(self) -> Dict[int, int]:
+        """Copy of the per-peer last-direct-contact rounds (LRU input)."""
+        with self._lock:
+            return dict(self._last_contact)
 
     def is_evicted(self, peer: int) -> bool:
         with self._lock:
@@ -373,6 +404,43 @@ class Scoreboard:
                 self._state.get(peer) == PeerState.QUARANTINED
                 and r >= self._release_round.get(peer, 0)
             )
+
+    def probe_candidates(self, round: Optional[int] = None) -> List[int]:
+        """Every peer whose probe is due at ``round``, ascending.
+
+        Equivalent to ``[p for p in range(n) if probe_due(p, round)]``
+        but O(quarantined + tombstones) instead of O(N) — it walks only
+        the resident quarantine map and the eviction tombstones, which
+        is what lets a 4096-peer orchestrator round stay O(tracked)."""
+        with self._lock:
+            r = self._clock(round)
+            due = set()
+            interval = max(1, self.config.quarantine_max_rounds)
+            for p, evicted_at in self._evicted.items():
+                if r > evicted_at and (r - evicted_at) % interval == 0:
+                    due.add(p)
+            for p, state in self._state.items():
+                if (
+                    state == PeerState.QUARANTINED
+                    and r >= self._release_round.get(p, 0)
+                ):
+                    due.add(p)
+            return sorted(due)
+
+    def healthy_map(
+        self, peers: List[int], round: Optional[int] = None
+    ) -> Dict[int, bool]:
+        """Fallback-target eligibility for just ``peers`` — the partial
+        view's O(active) stand-in for :meth:`healthy_mask` (indexable by
+        peer id, which is all ``Schedule.remap_partner`` needs)."""
+        with self._lock:
+            self._clock(round)
+            return {
+                p: self._state.get(p)
+                not in (PeerState.QUARANTINED, PeerState.DEGRADED)
+                and p not in self._evicted
+                for p in peers
+            }
 
     def healthy_mask(self, round: Optional[int] = None) -> List[bool]:
         """Per-peer eligibility as a fallback fetch target.

@@ -7,7 +7,11 @@ SWIM-style membership for the TCP gossip ring (docs/membership.md):
   plus the incarnation-based merge rules;
 - :mod:`~dpwa_tpu.membership.manager` — the merged view, refutation,
   connected-component / quorum / degraded-mode bookkeeping, and the
-  heal-reconciliation advice the adapter acts on.
+  heal-reconciliation advice the adapter acts on;
+- :mod:`~dpwa_tpu.membership.partial_view` — bounded partial views
+  (``membership.view:``): the active/passive peer horizon, digest
+  sampling, and the LRU state-cap victim rule that keep every control
+  plane O(sample) at 4096 peers.
 
 The transport wiring (digest trailer, relay-probe verb, indirect
 probing) lives in :mod:`dpwa_tpu.parallel.tcp`; the state merge itself
@@ -27,8 +31,10 @@ from dpwa_tpu.membership.digest import (
     merge_entry,
 )
 from dpwa_tpu.membership.manager import MembershipManager
+from dpwa_tpu.membership.partial_view import PartialView
 
 __all__ = [
+    "PartialView",
     "ALIVE",
     "SUSPECT",
     "QUARANTINED",

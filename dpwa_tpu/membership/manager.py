@@ -115,12 +115,62 @@ class MembershipManager:
         # the transport registers trust/flowctl pruning here.
         self._evict_listeners: List[Callable[[int], None]] = []
         self._round = 0
+        # Bounded partial views (config.view, docs/membership.md):
+        # when enabled, digests sample `digest_sample` tracked peers,
+        # partner/relay draws range over the active view, and per-peer
+        # state across the planes is LRU-capped at `state_cap`.
+        # Cap-evicted peers land in `_capped` — tombstoned and pruned
+        # like dead evictions, but NOT subtracted from the quorum
+        # universe (they are untracked, not dead).
+        self.partial = None
+        if self.config.view.enabled:
+            from dpwa_tpu.membership.partial_view import PartialView
+
+            self.partial = PartialView(
+                n_peers,
+                me,
+                self.config.view,
+                seed=seed,
+                topology=topology,
+                leader_board=leader_board,
+            )
+        self._capped: Set[int] = set()
+        self._evictions_by_cause = {"dead": 0, "cap": 0}
+        self._digest_entries_last = 0
+        # High-water marks updated every end_round under the view: the
+        # leak regressions assert these against state_cap + tombstones,
+        # because a cap enforced only at round end could hide a
+        # mid-stream spike from a final-size check.
+        self._peak_resident = 0
+        self._peak_sb_tracked = 0
+        # Predicates consulted before cap-evicting a peer (outside the
+        # lock): the transport registers trust's collapsed check here so
+        # a QUARANTINED-collapse verdict is never silently dropped.
+        self._cap_protectors: List[Callable[[int], bool]] = []
         scoreboard.attach_membership(self)
 
     def add_evict_listener(self, fn: Callable[[int], None]) -> None:
         """Register a callback fired once per peer eviction."""
         with self._lock:
             self._evict_listeners.append(fn)
+
+    def add_cap_protector(self, fn: Callable[[int], bool]) -> None:
+        """Register a predicate that shields peers from CAP eviction
+        (e.g. trust's collapsed-peer check).  Dead evictions are not
+        consulted — a dead peer's verdict history is already settled."""
+        with self._lock:
+            self._cap_protectors.append(fn)
+
+    def partner_candidates(self) -> Optional[List[int]]:
+        """The sorted active view when partial views are on, else None
+        (None = draws range over all of ``nodes:``, the legacy path).
+        The transport feeds this to ``Schedule.remap_partner`` and the
+        relay/hedge candidate builds."""
+        part = self.partial
+        if part is None:
+            return None
+        with self._lock:
+            return sorted(part.active)
 
     # ------------------------------------------------------------------
     # Local evidence -> digest states
@@ -159,23 +209,58 @@ class MembershipManager:
     # Digest I/O (called from the transport's publish / fetch paths)
     # ------------------------------------------------------------------
 
+    def _tracked_candidates(self) -> List[int]:
+        """Sorted tracked universe under partial views (lock held):
+        every peer the gossip view or the active view names, minus
+        tombstones.  O(state_cap + active), never O(N)."""
+        tracked = set(self._view) | self.partial.active
+        tracked -= self._evicted
+        tracked -= self._capped
+        tracked.discard(self.me)
+        return sorted(tracked)
+
     def encode(self, round: int) -> bytes:
         """The digest to piggyback on this round's published frame.
 
         Evicted peers are OMITTED: a dead claim disseminates for
         ``dead_gossip_rounds`` and then leaves the wire, so the digest
-        is O(live + recently-dead) instead of O(everyone ever seen)."""
+        is O(live + recently-dead) instead of O(everyone ever seen).
+
+        Under partial views the candidate universe shrinks further to
+        the tracked set, and the digest carries a ``view_sample_draw``
+        sample of ``digest_sample`` of them (damning entries first) —
+        with ``digest_sample >= |candidates|`` the sample IS the full
+        candidate list, which is what makes the sample≥N frame
+        byte-identical to the global path (the raw-frame test pins
+        it)."""
         with self._lock:
             evicted = set(self._evicted)
+            part = self.partial
+            candidates = (
+                self._tracked_candidates() if part is not None else None
+            )
         # Scoreboard reads happen before taking our lock (lock ordering).
-        combined = {
-            p: self._combined(p)
-            for p in range(self.n_peers)
-            if p != self.me and p not in evicted
-        }
+        if candidates is None:
+            combined = {
+                p: self._combined(p)
+                for p in range(self.n_peers)
+                if p != self.me and p not in evicted
+            }
+        else:
+            combined = {p: self._combined(p) for p in candidates}
         with self._lock:
             self._round = max(self._round, int(round))
-            entries = dict(combined)
+            if part is not None:
+                damning = {
+                    p for p, e in combined.items() if e.state >= QUARANTINED
+                }
+                chosen = part.sample_digest(
+                    sorted(combined), damning, int(round)
+                )
+                entries = {p: combined[p] for p in chosen}
+            else:
+                entries = dict(combined)
+            self._digest_entries_last = len(entries) + 1
             entries[self.me] = MemberEntry(
                 state=ALIVE, incarnation=self.incarnation, suspicion=0.0
             )
@@ -216,12 +301,28 @@ class MembershipManager:
         r = int(round) if round is not None else self._round
         readmits: List[int] = []
         adopts: List[int] = []
+        uncapped: List[int] = []
         events: List[dict] = []
         with self._lock:
             self._round = max(self._round, r)
+            part = self.partial
+            if part is not None and digest.origin != self.me:
+                part.touch(digest.origin, r)
             for peer, claim in sorted(digest.entries.items()):
                 if peer >= self.n_peers:
                     continue
+                if part is not None and peer != self.me:
+                    # Recency for the LRU cap, and discovery: unknown
+                    # peers named by a digest enter the passive view.
+                    part.touch(peer, r)
+                    if peer in self._capped:
+                        # A mention re-tracks a cap-evicted peer (it
+                        # was untracked, not dead); an alive-ish claim
+                        # also clears its scoreboard tombstone below,
+                        # outside our lock.
+                        self._capped.discard(peer)
+                        if claim.state <= SUSPECT:
+                            uncapped.append(peer)
                 if peer == self.me:
                     # Refutation: someone thinks we are sick at an
                     # incarnation as fresh as ours — outbid them.  We are
@@ -279,6 +380,11 @@ class MembershipManager:
                         )
                     )
             self._events.extend(events)
+        for peer in uncapped:
+            # Clears the cap tombstone (readmit's evicted branch):
+            # the peer rematerializes with a clean record, rebuilt
+            # from the gossip claims just folded.
+            self.scoreboard.readmit(peer, round=r)
         for peer in adopts:
             self.scoreboard.adopt_quarantine(peer, round=r)
         refuted: List[dict] = []
@@ -296,6 +402,7 @@ class MembershipManager:
                 for rec in refuted:
                     peer = rec["peer"]
                     self._dead_since.pop(peer, None)
+                    self._capped.discard(peer)
                     if peer in self._evicted:
                         # A rejoiner outbid its own dead claim: it is a
                         # member again, rebuilt from scratch by the
@@ -319,20 +426,58 @@ class MembershipManager:
 
     def end_round(self, step: int) -> None:
         """Recompute the component after this round's exchange, and age
-        dead claims toward eviction (``config.dead_gossip_rounds``)."""
+        dead claims toward eviction (``config.dead_gossip_rounds``).
+
+        Under partial views this is also where the LRU ``state_cap`` is
+        enforced: residency across the scoreboard/membership maps is
+        measured, and the least-recently-touched unprotected peers are
+        cap-evicted through the same tombstone + evict-listener path as
+        dead evictions (cause-tagged separately: capped peers are
+        untracked, not dead, so they never count against quorum)."""
         with self._lock:
             evicted = set(self._evicted)
-        combined = {
-            p: self._combined(p)
-            for p in range(self.n_peers)
-            if p != self.me and p not in evicted
-        }
+            part = self.partial
+            tracked = (
+                self._tracked_candidates() if part is not None else None
+            )
+            view_keys = set(self._view) if part is not None else set()
+            touch_keys = (
+                set(part._last_touch) if part is not None else set()
+            )
+            protectors = (
+                list(self._cap_protectors) if part is not None else []
+            )
+        # Scoreboard reads happen before taking our lock (lock ordering).
+        if tracked is None:
+            combined = {
+                p: self._combined(p)
+                for p in range(self.n_peers)
+                if p != self.me and p not in evicted
+            }
+        else:
+            combined = {p: self._combined(p) for p in tracked}
+        # Cap-enforcement inputs, gathered outside our lock too: the
+        # planes' resident sets, each peer's quarantine verdict (a
+        # QUARANTINED peer with an unexpired streak is never silently
+        # cap-dropped), and the registered protector predicates.
+        protected: Set[int] = set()
+        sb_tracked: List[int] = []
+        if part is not None:
+            sb_tracked = self.scoreboard.tracked_peers()
+            for p in sorted(view_keys | set(sb_tracked) | touch_keys):
+                if p == self.me or p in evicted:
+                    continue
+                if self.scoreboard.state(p) == PeerState.QUARANTINED:
+                    protected.add(p)
+                elif any(fn(p) for fn in protectors):
+                    protected.add(p)
         component = {self.me} | {
             p for p, e in combined.items() if e.state <= SUSPECT
         }
         dead_now = {p for p, e in combined.items() if e.state >= DEAD}
         events: List[dict] = []
         evictions: List[int] = []
+        cap_evictions: List[int] = []
         with self._lock:
             self._round = max(self._round, int(step))
             if self.config.dead_gossip_rounds > 0:
@@ -356,8 +501,16 @@ class MembershipManager:
                     )
             # Quorum/heal fractions run over the ring that still EXISTS:
             # counting permanently departed peers against quorum would
-            # pin a half-churned ring degraded forever.
-            alive_universe = max(1, self.n_peers - len(self._evicted))
+            # pin a half-churned ring degraded forever.  Under partial
+            # views the universe is this node's tracked horizon (me +
+            # tracked peers minus this round's dead evictions) — never
+            # ``n_peers``, which a capped node cannot see; with a full
+            # view the two formulas are equal (the identity test pins
+            # it).
+            if part is None:
+                alive_universe = max(1, self.n_peers - len(self._evicted))
+            else:
+                alive_universe = max(1, 1 + len(tracked) - len(evictions))
             prev = self._component
             if component != prev:
                 events.append(
@@ -419,12 +572,58 @@ class MembershipManager:
                 )
             self._component = component
             self._degraded = degraded
+            if part is not None:
+                self._evictions_by_cause["dead"] += len(evictions)
+                for p in evictions:
+                    # A dead-evicted active peer triggers the HyParView
+                    # replacement step: forget() promotes a passive
+                    # candidate into the vacated active slot.
+                    part.forget(p)
+                part.maybe_shuffle(int(step))
+                # LRU cap: residency across the membership + scoreboard
+                # maps, minus tombstones; victims are the least recently
+                # touched peers outside the active view and outside the
+                # protected set assembled above.
+                cap = self.config.view.state_cap
+                resident = (
+                    set(self._view) | set(sb_tracked) | set(
+                        part._last_touch
+                    )
+                ) - self._evicted - self._capped - {self.me}
+                resident -= set(evictions)
+                victims = part.cap_victims(
+                    resident, protected, len(resident) - cap
+                )
+                for p in victims:
+                    self._view.pop(p, None)
+                    part.forget(p)
+                    self._capped.add(p)
+                cap_evictions = victims
+                self._peak_resident = max(
+                    self._peak_resident, len(resident) - len(victims)
+                )
+                self._peak_sb_tracked = max(
+                    self._peak_sb_tracked, len(sb_tracked)
+                )
+                if victims:
+                    self._evictions_by_cause["cap"] += len(victims)
+                    events.append(
+                        {
+                            "event": "peers_capped",
+                            "peers": victims,
+                            "state_cap": cap,
+                        }
+                    )
             self._events.extend(events)
             listeners = list(self._evict_listeners)
         # Prune the other planes OUTSIDE our lock: the scoreboard (and
         # the registered trust/flowctl listeners) take their own locks,
         # and the sanctioned order is theirs-before-ours.
         for p in evictions:
+            self.scoreboard.evict_peer(p, round=int(step))
+            for fn in listeners:
+                fn(p)
+        for p in cap_evictions:
             self.scoreboard.evict_peer(p, round=int(step))
             for fn in listeners:
                 fn(p)
@@ -440,10 +639,26 @@ class MembershipManager:
         and the peer's own refutation bumps the incarnation if laggards
         still disseminate the dead claim."""
         with self._lock:
+            if peer in self._capped:
+                # A cap tombstone, not a dead one: the probe proves the
+                # peer is worth tracking again — no rejoin event, it
+                # never left the ring.
+                self._capped.discard(peer)
+                if self.partial is not None:
+                    self.partial.touch(
+                        peer,
+                        int(round) if round is not None else self._round,
+                    )
+                return
             if peer not in self._evicted:
                 return
             self._evicted.discard(peer)
             self._dead_since.pop(peer, None)
+            if self.partial is not None:
+                self.partial.touch(
+                        peer,
+                        int(round) if round is not None else self._round,
+                    )
             entry = self._view.get(peer)
             if entry is not None and entry.state > ALIVE:
                 self._view[peer] = MemberEntry(
@@ -513,6 +728,21 @@ class MembershipManager:
             }
             if self._evicted:
                 snap["evicted"] = sorted(self._evicted)
+            if self.partial is not None:
+                # Schema-frozen view_* group (tools/schema_check.py):
+                # present exactly when membership.view.enabled.
+                part_snap = self.partial.snapshot()
+                snap["view"] = {
+                    "view_active": part_snap["active_size"],
+                    "view_passive": part_snap["passive_size"],
+                    "view_tracked": len(self._tracked_candidates()),
+                    "view_capped": len(self._capped),
+                    "view_digest_entries": self._digest_entries_last,
+                    "view_evicted_dead": self._evictions_by_cause["dead"],
+                    "view_evicted_cap": self._evictions_by_cause["cap"],
+                    "view_promotions": part_snap["promotions"],
+                    "view_shuffles": part_snap["shuffles"],
+                }
             return snap
 
 

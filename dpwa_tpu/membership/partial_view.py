@@ -1,0 +1,308 @@
+"""Bounded partial views: a node's-eye horizon over a 4096-peer ring.
+
+HyParView-style split view (docs/membership.md):
+
+- the **active view** is the small set of peers this node actually
+  gossips with and probes — partner remaps, hedge fallbacks, and
+  indirect-probe relays draw from it instead of all of ``nodes:``;
+- the **passive view** is a churn-refreshed reservoir of known-alive
+  candidates: when an active peer is evicted, a replacement is promoted
+  from it by a deterministic draw, and a slow shuffle keeps it stocked
+  with recently-heard-of peers.
+
+On top of the views, two bounds make every control plane O(sample)
+instead of O(N):
+
+- **digest sampling**: each published frame carries a threefry-drawn
+  sample of ``digest_sample`` tracked peers (tag
+  ``view_sample_draw``, keyed on the publish clock) rather than the
+  whole universe.  Damning entries (QUARANTINED-or-worse) are always
+  prioritized into the sample so SWIM dissemination of failures never
+  loses to truncation.  The wire format is unchanged — receivers have
+  always merged arbitrary subsets.
+- **state caps**: the per-peer maps in the scoreboard / trust /
+  flowctl / membership planes are LRU-capped at ``state_cap``; victims
+  flow through the PR 11 evict-listener path (tombstone + prune).  The
+  :class:`~dpwa_tpu.membership.manager.MembershipManager` owns victim
+  selection; this module supplies the recency ordering and the
+  protection rule (active-view members are never cap-evicted).
+
+Identity guarantee (the raw-frame test pins it): with ``digest_sample
+>= N``, ``state_cap >= N`` and ``active_size >= N - 1`` the candidate
+lists, draws, frames, and plane decisions are all byte-identical to the
+global-view (``view.enabled: false``) code path — sampling only ever
+truncates canonical orderings, never reorders them.
+
+Everything here is keyed on gossip rounds and threefry draws — no wall
+clock, no ``random`` module — so seeded reruns of a 4096-peer soak
+replay bit-identical view evolution (dpwalint's determinism rules cover
+this module as a decision module).
+
+Thread safety: instances are owned by a ``MembershipManager`` and every
+mutating call happens under the manager's lock; there is deliberately
+no lock here (two locks on the digest hot path would double the
+ordering surface for zero benefit).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Set
+
+from dpwa_tpu.config import ViewConfig
+from dpwa_tpu.parallel.schedules import (
+    passive_shuffle_draw,
+    view_sample_draw,
+)
+
+
+class PartialView:
+    """Active + passive partial views and the digest-sample rule."""
+
+    def __init__(
+        self,
+        n_peers: int,
+        me: int,
+        config: Optional[ViewConfig] = None,
+        seed: int = 0,
+        topology: Optional[Any] = None,
+        leader_board: Optional[Any] = None,
+    ):
+        self.config = config if config is not None else ViewConfig()
+        self.n_peers = int(n_peers)
+        self.me = int(me)
+        self.seed = seed
+        self.topology = topology
+        self.leader_board = leader_board
+        # Sorted-set semantics; kept as sets with sorted() at read time
+        # (views are tiny — active_size / passive_size entries).
+        self.active: Set[int] = set()
+        self.passive: Set[int] = set()
+        # peer -> last round it was heard of (digest entry, digest
+        # origin, or direct contact relayed by the manager).  Pruned on
+        # forget(), so it is bounded by the tracked universe, not N.
+        self._last_touch: Dict[int, int] = {}
+        # Lifetime counters for the obs plane.
+        self.promotions = 0
+        self.shuffles = 0
+        self._seed_views()
+
+    # ------------------------------------------------------------------
+    # Bootstrap
+    # ------------------------------------------------------------------
+
+    def _initial_candidates(self) -> List[int]:
+        """The deterministic bootstrap ordering of the universe.
+
+        Hier topology: own island's members first (the intra-island
+        gossip fabric), then the leader set (the inter-island routes) —
+        a node only ever needs to name its island plus the leaders.
+        Flat ring: successors ``me+1, me+2, …`` (mod N), the same
+        neighborhood the ring schedule pairs first."""
+        if self.topology is not None:
+            ordered: List[int] = []
+            g = self.topology.island_of(self.me)
+            ordered.extend(
+                p for p in self.topology.members_of(g) if p != self.me
+            )
+            if self.leader_board is not None:
+                for island in range(self.topology.n_islands):
+                    leader = self.leader_board.leader_of(island)
+                    if (
+                        leader is not None
+                        and leader != self.me
+                        and leader not in ordered
+                    ):
+                        ordered.append(leader)
+            # Top up from ring successors so a tiny island still fills
+            # its active view (deterministic, duplicates skipped).
+            seen = set(ordered)
+            for i in range(1, self.n_peers):
+                p = (self.me + i) % self.n_peers
+                if p != self.me and p not in seen:
+                    ordered.append(p)
+                    seen.add(p)
+            return ordered
+        return [
+            (self.me + i) % self.n_peers for i in range(1, self.n_peers)
+        ]
+
+    def _seed_views(self) -> None:
+        ordered = self._initial_candidates()
+        self.active = set(ordered[: self.config.active_size])
+        self.passive = set(
+            ordered[
+                self.config.active_size: self.config.active_size
+                + self.config.passive_size
+            ]
+        )
+
+    # ------------------------------------------------------------------
+    # Recency (feeds LRU victim selection)
+    # ------------------------------------------------------------------
+
+    def touch(self, peer: int, round_: int) -> None:
+        """Note that ``peer`` was heard of at ``round_`` (digest entry /
+        origin or direct contact).  An unknown peer refills an
+        UNDERSIZED active view directly (the HyParView refill rule — a
+        rejoiner after eviction takes the slot its death vacated, which
+        is also what keeps the ``active_size >= N-1`` identity case
+        byte-identical to the global path across churn), else it enters
+        the passive reservoir while there is room — this is how a node
+        discovers the universe beyond its bootstrap neighborhood."""
+        if peer == self.me or not 0 <= peer < self.n_peers:
+            return
+        prev = self._last_touch.get(peer)
+        if prev is None or round_ > prev:
+            self._last_touch[peer] = int(round_)
+        if peer in self.active or peer in self.passive:
+            return
+        if len(self.active) < self.config.active_size:
+            self.active.add(peer)
+        elif len(self.passive) < self.config.passive_size:
+            self.passive.add(peer)
+
+    def last_touch(self, peer: int) -> int:
+        return self._last_touch.get(peer, -1)
+
+    def forget(self, peer: int) -> None:
+        """Drop every trace of ``peer`` (dead- or cap-eviction): it
+        leaves both views and the recency map; the active slot it may
+        have held is refilled from the passive reservoir."""
+        self._last_touch.pop(peer, None)
+        self.passive.discard(peer)
+        if peer in self.active:
+            self.active.discard(peer)
+            self._promote(peer)
+
+    # ------------------------------------------------------------------
+    # View maintenance
+    # ------------------------------------------------------------------
+
+    def _promote(self, failed_peer: int) -> None:
+        """Refill the active view from the passive reservoir after
+        ``failed_peer`` left it — the HyParView replacement step.  The
+        pick is a ``passive_shuffle_draw`` over the sorted reservoir,
+        keyed on the failed peer so two same-round failures draw
+        independent replacements."""
+        candidates = sorted(self.passive)
+        if not candidates:
+            return
+        idx = int(
+            passive_shuffle_draw(
+                self.seed, failed_peer, self.me, len(candidates)
+            )
+        )
+        pick = candidates[idx]
+        self.passive.discard(pick)
+        self.active.add(pick)
+        self.promotions += 1
+
+    def maybe_shuffle(self, round_: int) -> None:
+        """Every ``shuffle_every`` rounds, refresh one passive slot with
+        the most recently heard-of untracked peer (deterministic: the
+        displaced resident is a draw over the sorted reservoir).  Keeps
+        the reservoir stocked with live peers under churn instead of
+        fossilizing its bootstrap contents."""
+        every = self.config.shuffle_every
+        if every <= 0 or round_ <= 0 or round_ % every != 0:
+            return
+        # Freshest known peer outside both views, ties broken by id.
+        fresh: Optional[int] = None
+        fresh_round = -1
+        for p, r in sorted(self._last_touch.items()):
+            if p in self.active or p in self.passive:
+                continue
+            if r > fresh_round or (r == fresh_round and (
+                fresh is None or p < fresh
+            )):
+                fresh, fresh_round = p, r
+        if fresh is None:
+            return
+        if len(self.passive) >= max(1, self.config.passive_size):
+            residents = sorted(self.passive)
+            idx = int(
+                passive_shuffle_draw(
+                    self.seed, round_, self.me, len(residents)
+                )
+            )
+            self.passive.discard(residents[idx])
+        self.passive.add(fresh)
+        self.shuffles += 1
+
+    # ------------------------------------------------------------------
+    # Digest sampling
+    # ------------------------------------------------------------------
+
+    def sample_digest(
+        self,
+        candidates: Sequence[int],
+        damning: Iterable[int],
+        clock: int,
+    ) -> List[int]:
+        """The subset of ``candidates`` (sorted tracked peers) that this
+        frame's digest carries.
+
+        ``sample >= len(candidates)`` returns the full list — the
+        identity case.  Otherwise damning peers (QUARANTINED-or-worse in
+        the combined view) fill first, in id order, so failure
+        dissemination survives truncation; the remainder comes from the
+        ``view_sample_draw`` permutation of the candidate list, keyed on
+        the publish clock — deterministic, and rotating across clocks so
+        every tracked peer appears in some frame."""
+        k = self.config.digest_sample
+        ordered = sorted(candidates)
+        if len(ordered) <= k:
+            return ordered
+        chosen: List[int] = [p for p in ordered if p in set(damning)][:k]
+        if len(chosen) < k:
+            picked = set(chosen)
+            perm = view_sample_draw(
+                self.seed, clock, self.me, len(ordered)
+            )
+            for idx in perm:
+                p = ordered[int(idx)]
+                if p not in picked:
+                    chosen.append(p)
+                    picked.add(p)
+                    if len(chosen) >= k:
+                        break
+        return sorted(chosen)
+
+    # ------------------------------------------------------------------
+    # Victim selection (manager-driven LRU cap)
+    # ------------------------------------------------------------------
+
+    def cap_victims(
+        self,
+        resident: Iterable[int],
+        protected: Iterable[int],
+        excess: int,
+    ) -> List[int]:
+        """The ``excess`` least-recently-touched resident peers that are
+        safe to cap-evict.  Active-view members and ``protected`` peers
+        (QUARANTINED with an unexpired streak, collapsed trust — the
+        manager assembles the set) are never victims; ties break on
+        peer id so reruns pick identical victims."""
+        if excess <= 0:
+            return []
+        protected = set(protected) | self.active | {self.me}
+        eligible = sorted(
+            (p for p in resident if p not in protected),
+            key=lambda p: (self._last_touch.get(p, -1), p),
+        )
+        return eligible[:excess]
+
+    # ------------------------------------------------------------------
+    # Observability
+    # ------------------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """JSON-ready view state (folded into ``view_snapshot`` and the
+        health records' ``view_*`` columns)."""
+        return {
+            "active_size": len(self.active),
+            "passive_size": len(self.passive),
+            "active": sorted(self.active),
+            "promotions": self.promotions,
+            "shuffles": self.shuffles,
+        }

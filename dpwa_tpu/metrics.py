@@ -216,6 +216,13 @@ class MetricsLogger:
           ``fold_frames`` — the device merge engine's jit-cache and
           dispatch accounting (present only once a device-resident
           exchange has served a round, docs/device.md);
+        - ``view_active`` / ``view_passive`` / ``view_tracked`` /
+          ``view_capped`` / ``view_digest_entries`` /
+          ``view_digest_bytes`` / ``view_evicted_dead`` /
+          ``view_evicted_cap`` / ``view_promotions`` /
+          ``view_shuffles`` — the bounded partial-view plane's sizes,
+          residency, per-frame digest footprint, and evictions by cause
+          (present only under ``membership.view``, docs/membership.md);
         - ``disagreement_rms`` / ``disagreement_rel`` / ``sketch_peers``
           — the obs plane's sketch-based ring-disagreement estimate
           (present only when ``obs.sketch`` is on);
@@ -333,6 +340,26 @@ class MetricsLogger:
                     ),
                     h2d_zero_copy_frac=device.get("h2d_zero_copy_frac"),
                     fold_frames=device.get("fold_frames"),
+                )
+            view = wire.get("view")
+            if view is not None:
+                # Partial-view columns (docs/membership.md; absent
+                # without membership.view, keeping global-view records
+                # byte-identical): view sizes, tracked residency vs the
+                # state cap, digest entries/bytes per frame, and the
+                # eviction tally split by cause (dead vs LRU cap).
+                extra = dict(
+                    extra,
+                    view_active=view.get("view_active"),
+                    view_passive=view.get("view_passive"),
+                    view_tracked=view.get("view_tracked"),
+                    view_capped=view.get("view_capped"),
+                    view_digest_entries=view.get("view_digest_entries"),
+                    view_digest_bytes=view.get("view_digest_bytes"),
+                    view_evicted_dead=view.get("view_evicted_dead"),
+                    view_evicted_cap=view.get("view_evicted_cap"),
+                    view_promotions=view.get("view_promotions"),
+                    view_shuffles=view.get("view_shuffles"),
                 )
             shard = wire.get("shard")
             if shard is not None:

@@ -23,6 +23,7 @@ round-trip in the hot loop.
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Optional
 
 import jax
@@ -159,6 +160,14 @@ def heal_draw(seed, step, me, n_candidates: int):
     )
 
 
+@jax.jit
+def _uniform_draw(seed, step, pair_id, tag):
+    # Jitted once for the whole uniform-draw family (tag is traced): the
+    # fleet orchestrator pays one leave + one join draw per node per
+    # round, where the eager fold_in dispatch chain dominates the round.
+    return jax.random.uniform(_pair_key(seed, step, pair_id, tag))
+
+
 def churn_leave_draw(seed, round_, peer):
     """Uniform [0, 1) deciding whether ``peer`` LEAVES the fleet at
     ``round_`` (tag 10 — the fleet orchestrator's continuous-departure
@@ -167,18 +176,14 @@ def churn_leave_draw(seed, round_, peer):
     Keyed on ``(seed, round, peer)`` like :func:`chaos_draw`, so a churn
     episode replays bit-identically under a fixed seed — the property the
     8-peer mini-churn acceptance test asserts across reruns."""
-    return float(
-        jax.random.uniform(_pair_key(seed, round_, peer, _tags.TAG_CHURN_LEAVE))
-    )
+    return float(_uniform_draw(seed, round_, peer, _tags.TAG_CHURN_LEAVE))
 
 
 def churn_join_draw(seed, round_, peer):
     """Uniform [0, 1) deciding whether a departed ``peer`` REJOINS at
     ``round_`` (tag 11 — independent of the leave stream, so arrival and
     departure rates tune without correlation)."""
-    return float(
-        jax.random.uniform(_pair_key(seed, round_, peer, _tags.TAG_CHURN_JOIN))
-    )
+    return float(_uniform_draw(seed, round_, peer, _tags.TAG_CHURN_JOIN))
 
 
 def churn_cohort_draw(seed, round_, n_max: int):
@@ -287,6 +292,44 @@ def async_drain_draw(seed, step, peer) -> float:
     )
 
 
+@functools.partial(jax.jit, static_argnums=(3,))
+def _view_perm(seed, clock, me, n_candidates: int):
+    # Jitted: this is the one control draw on the per-frame publish path
+    # (every other draw fires on failures or round boundaries), so the
+    # eager fold_in dispatch cost would be paid once per published frame.
+    return jax.random.permutation(
+        _pair_key(seed, clock, me, _tags.TAG_VIEW_SAMPLE), n_candidates
+    )
+
+
+def view_sample_draw(seed, clock, me, n_candidates: int) -> np.ndarray:
+    """Permutation of the tracked-peer candidate list for one digest
+    frame (tag 34 — the partial-view sample stream).
+
+    Keyed on ``(seed, publish clock, me)``: a node's frame at a given
+    clock always samples the same peers, so seeded reruns publish
+    byte-identical digests and any two receivers of the frame saw the
+    same subset.  Callers index the first ``digest_sample`` entries of
+    this permutation into the canonically-sorted candidate list —
+    truncation happens in the caller, so ``sample >= n_candidates``
+    degenerates to the full list and the identity guarantee holds."""
+    return np.asarray(_view_perm(seed, clock, me, n_candidates))
+
+
+def passive_shuffle_draw(seed, round_, me, n_candidates: int):
+    """Index of the passive-view candidate promoted (or displaced) on a
+    shuffle or failure-replacement event (tag 35 — independent of the
+    digest-sample stream, so truncation cannot skew replacement).
+
+    Keyed on ``(seed, round, me)``: replicas replaying a seed promote
+    identical replacements, which keeps the 4096-peer soak bit-identical
+    across reruns."""
+    return jax.random.randint(
+        _pair_key(seed, round_, me, _tags.TAG_PASSIVE_SHUFFLE),
+        (), 0, n_candidates,
+    )
+
+
 _CONTROL_DRAWS_WARM = False
 
 
@@ -323,6 +366,8 @@ def warm_control_draws(seed: int = 0, me: int = 0) -> None:
     island_churn_draw(seed, 0, 0)
     shard_draw(seed, 0, 2)
     float(async_drain_draw(seed, 0, me))
+    view_sample_draw(seed, 0, me, 2)
+    int(passive_shuffle_draw(seed, 0, me, 2))
     _CONTROL_DRAWS_WARM = True
 
 
@@ -657,7 +702,8 @@ class Schedule:
         return int(self.pairing(step)[i])
 
     def remap_partner(
-        self, step: int, i: int, partner: int, healthy_mask
+        self, step: int, i: int, partner: int, healthy_mask,
+        candidates=None,
     ) -> int:
         """Health-aware fallback: the peer ``i`` fetches at ``step`` when
         its scheduled ``partner`` is quarantined.
@@ -672,10 +718,19 @@ class Schedule:
         tolerates the same way the reference's random pulls do.
 
         No healthy candidate ⇒ returns ``i`` (self-pair, i.e. the round
-        is skipped — the all-peers-dead posture is solo training)."""
+        is skipped — the all-peers-dead posture is solo training).
+
+        ``candidates`` (optional, sorted peer ids) restricts the draw to
+        a partial view's active peers instead of all of ``range(n)`` —
+        with ``candidates=None`` (or a view spanning the whole ring) the
+        candidate list, and therefore the draw, is identical to the
+        legacy global path."""
+        universe = (
+            range(self.n_peers) if candidates is None else candidates
+        )
         candidates = [
             p
-            for p in range(self.n_peers)
+            for p in universe
             if p != i and p != partner and healthy_mask[p]
         ]
         if not candidates:

@@ -33,7 +33,7 @@ import socket
 import threading
 import time
 import zlib
-from typing import Dict, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -1860,6 +1860,18 @@ class TcpTransport:
                 self.membership.add_evict_listener(
                     self._estimator.evict_peer
                 )
+            if self.membership.partial is not None:
+                # Bounded partial views (membership.view): the LRU
+                # state cap must never silently drop a collapsed-trust
+                # verdict, and trust snapshots switch to tracked-map
+                # iteration (len(peers) == N no longer holds).
+                if self.trust is not None:
+                    self.membership.add_cap_protector(
+                        self.trust.is_collapsed
+                    )
+                    self.trust.enable_capped_snapshots()
+        # dpwalint: double_buffered(_last_digest_nbytes) -- a single int rebound whole by the publish path; the healthz snapshot reads the old or new value, never a torn write (stale-but-consistent telemetry)
+        self._last_digest_nbytes = 0
         if self.trust is not None and self.scoreboard is not None:
             # Collapsed trust feeds the scoreboard as ``untrusted``
             # probes — the quarantine path for a persistently-suspect
@@ -2028,6 +2040,10 @@ class TcpTransport:
             if self.membership is not None
             else None
         )
+        if digest is not None:
+            # Partial-view observability: the actual digest bytes this
+            # frame carries (O(digest_sample) under membership.view).
+            self._last_digest_nbytes = len(digest)
         # Observability piggyback: trace id + replica sketch ride AFTER
         # the digest (ordering is the back-compat contract — see _frame).
         # When trust/topk/guard already stashed a contiguous-f32 copy of
@@ -2511,17 +2527,35 @@ class TcpTransport:
             )
         )
 
+    def _view_candidates(self) -> Optional[List[int]]:
+        """The active partial view when ``membership.view`` is on, else
+        None (draws range over all of ``nodes:`` — the legacy path)."""
+        if self.membership is None:
+            return None
+        return self.membership.partner_candidates()
+
+    def _remap_mask(self, candidates: Optional[List[int]], step: int):
+        """Fallback-eligibility mask for ``remap_partner``: the full
+        O(N) healthy mask on the legacy path, or an O(active) map over
+        the view candidates."""
+        if candidates is not None:
+            return self.scoreboard.healthy_map(candidates, step)
+        return self.scoreboard.healthy_mask(step)
+
     def _hedge_fallback(self, peer: int, step: int) -> Optional[int]:
         """The deterministic hedge target: the schedule's fallback draw
         over currently-healthy peers (the SAME draw a quarantine remap
         would make this round), or None when no distinct healthy
         candidate exists."""
         n = len(self.config.nodes)
+        candidates = self._view_candidates()
         if self.scoreboard is not None:
-            mask = self.scoreboard.healthy_mask(step)
+            mask = self._remap_mask(candidates, step)
         else:
             mask = [True] * n
-        fallback = self.schedule.remap_partner(step, self.me, peer, mask)
+        fallback = self.schedule.remap_partner(
+            step, self.me, peer, mask, candidates
+        )
         if (
             fallback == self.me
             or fallback == peer
@@ -2725,9 +2759,13 @@ class TcpTransport:
         from dpwa_tpu.parallel.schedules import relay_draw
 
         sb = self.scoreboard
+        view = self._view_candidates()
+        universe = (
+            view if view is not None else range(len(self.config.nodes))
+        )
         candidates = [
             p
-            for p in range(len(self.config.nodes))
+            for p in universe
             if p != self.me
             and p != suspect
             and sb.state(p) == PeerState.HEALTHY
@@ -2806,8 +2844,10 @@ class TcpTransport:
                         "step": int(step),
                     }
             if sb.is_quarantined(sched, step):
+                view = self._view_candidates()
                 partner = self.schedule.remap_partner(
-                    step, self.me, sched, sb.healthy_mask(step)
+                    step, self.me, sched, self._remap_mask(view, step),
+                    view,
                 )
                 remapped = True
             elif (
@@ -2827,8 +2867,10 @@ class TcpTransport:
                     degrade_shed_draw(self.schedule.seed, step, self.me)
                     < self.config.flowctl.degrade_shed_fraction
                 ):
+                    view = self._view_candidates()
                     partner = self.schedule.remap_partner(
-                        step, self.me, sched, sb.healthy_mask(step)
+                        step, self.me, sched,
+                        self._remap_mask(view, step), view,
                     )
                     remapped = True
         return sched, partner, remapped
@@ -2903,6 +2945,10 @@ class TcpTransport:
         if (
             self._wire_topk or self._prefetch_on or self._shard_on
             or _device_snapshot()["device_rounds"] > 0
+            or (
+                self.membership is not None
+                and self.membership.partial is not None
+            )
         ):
             # Gated on the new planes being ON (or the device merge
             # engine having served a round): a dense sequential host
@@ -3003,6 +3049,16 @@ class TcpTransport:
                 ],
                 "coverage": round(len(shard_tally) / k, 4),
             }
+        if self.membership is not None and self.membership.partial is not None:
+            # Partial-view accounting (membership.view): view sizes,
+            # residency, evictions by cause, and the actual digest bytes
+            # the last published frame carried — the O(sample) numbers
+            # the fleet bench gate watches.  Schema-frozen as the
+            # ``view_*`` group (tools/schema_check.py); present exactly
+            # when the view plane is on.
+            vs = dict(self.membership.view_snapshot().get("view") or {})
+            vs["view_digest_bytes"] = self._last_digest_nbytes
+            out["view"] = vs
         if self._prefetch_on:
             with self._stats_lock:
                 o = dict(self._overlap)

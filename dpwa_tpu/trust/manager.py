@@ -102,6 +102,11 @@ class TrustManager:
         self._codec_baselines: Dict[str, Dict[str, RobustBaseline]] = {
             "dense": self._baselines
         }
+        # Partial-view mode (satellite of docs/membership.md): snapshots
+        # iterate the tracked maps instead of range(n_peers) — under a
+        # state cap the maps no longer span the universe, and a 4096-peer
+        # snapshot must not be O(N) anyway.
+        self._capped_snapshots = False
         self._trust: Dict[int, float] = {}
         self._collapsed: Dict[int, bool] = {}
         self._last_clock: Dict[int, float] = {}
@@ -446,6 +451,37 @@ class TrustManager:
         with self._lock:
             return self._trust.get(peer, 1.0)
 
+    def enable_capped_snapshots(self) -> None:
+        """Switch :meth:`snapshot` to tracked-map iteration (called by
+        the transport when ``membership.view.enabled``)."""
+        with self._lock:
+            self._capped_snapshots = True
+
+    def is_collapsed(self, peer: int) -> bool:
+        """True while ``peer``'s trust has collapsed to quarantine (the
+        partial-view cap protector: a collapsed verdict must expire
+        through the normal streak machinery, never vanish because the
+        peer went LRU-cold — docs/membership.md)."""
+        with self._lock:
+            return bool(self._collapsed.get(peer, False))
+
+    def tracked_peers(self) -> List[int]:
+        """Every peer with resident trust state in any per-peer map —
+        the residency set the partial-view ``state_cap`` bounds."""
+        with self._lock:
+            keys = (
+                set(self._trust)
+                | set(self._collapsed)
+                | set(self._last_clock)
+                | set(self._replay_streak)
+                | set(self._counts)
+                | set(self._last_verdict)
+                | set(self._last_seen)
+                | set(self._amnesty_until)
+            )
+            keys.discard(self.me)
+            return sorted(keys)
+
     def alpha_scale(self, peer: int) -> float:
         """Merge damping for ``peer``: ``trust ** damping``, snapped to
         exactly 1.0 near full trust so honest rings merge bit-identically
@@ -468,7 +504,18 @@ class TrustManager:
         with self._lock:
             fill = min(len(b) for b in self._baselines.values())
             peers = {}
-            for p in range(self.n_peers):
+            if self._capped_snapshots:
+                # Capped view: only tracked peers have state worth
+                # reporting, and `len(peers) == n_peers` no longer
+                # holds anywhere downstream (satellite-6 audit).
+                universe = sorted(
+                    set(self._trust)
+                    | set(self._counts)
+                    | set(self._last_verdict)
+                )
+            else:
+                universe = range(self.n_peers)
+            for p in universe:
                 if p == self.me:
                     continue
                 c = self._counts.get(p, {})

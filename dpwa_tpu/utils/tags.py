@@ -133,6 +133,20 @@ TAG_SHARD = _register("shard_draw", CONTROL_TAG_BASE_2 + 0)
 # queues in the same order regardless of arrival timing.
 TAG_ASYNC_DRAIN = _register("async_drain_draw", CONTROL_TAG_BASE_2 + 1)
 
+# Bounded partial views (membership/partial_view.py +
+# schedules.view_sample_draw): which tracked peers land in this frame's
+# truncated digest.  Keyed on the publish clock, so a seeded rerun
+# publishes byte-identical digests and two observers of the same node
+# see the same sample.
+TAG_VIEW_SAMPLE = _register("view_sample_draw", CONTROL_TAG_BASE_2 + 2)
+
+# Passive-view shuffle (schedules.passive_shuffle_draw): which passive
+# candidate is promoted into the active view when an active peer fails,
+# and which resident it displaces when the reservoir is full.  A stream
+# separate from the sample draw so digest truncation cannot skew
+# replacement choices.
+TAG_PASSIVE_SHUFFLE = _register("passive_shuffle_draw", CONTROL_TAG_BASE_2 + 3)
+
 
 def registered_tags() -> Dict[int, str]:
     """A copy of the full tag → name allocation map (chaos included)."""

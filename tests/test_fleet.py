@@ -37,6 +37,7 @@ from dpwa_tpu.config import (
     HealthConfig,
     MembershipConfig,
     ObsConfig,
+    ViewConfig,
 )
 from dpwa_tpu.flowctl.estimator import DeadlineEstimator
 from dpwa_tpu.fleet import (
@@ -313,7 +314,7 @@ _BOARD_MAPS = (
     "_state", "_release_round", "_quarantine_streak", "_quarantines",
     "_quarantined_rounds", "_quarantined_at", "_degrades",
     "_degraded_rounds", "_degraded_at", "_probe_attempts",
-    "_probe_successes",
+    "_probe_successes", "_last_contact",
 )
 
 
@@ -343,6 +344,88 @@ def test_thousand_round_churn_grind_keeps_per_peer_state_bounded():
     assert len(digest) <= ep["max_digest_bytes"]
     view = obs.membership.view_snapshot()
     assert set(view.get("evicted", ())) == evicted
+
+
+def test_thousand_round_churn_grind_bounds_capped_view_state():
+    """ISSUE 18 extension of the grind: under ``membership.view`` the
+    per-node PEAK map sizes must stay O(state_cap), not O(N), across
+    the scoreboard / membership / trust / flowctl planes — a cap that
+    only holds at the final round would hide mid-stream leaks."""
+    # Sized so the bounds BITE: cap + slack (= digest_sample + 2) must
+    # stay below N-1, else a full-universe map would pass the cap check.
+    view = ViewConfig(
+        enabled=True, active_size=3, passive_size=5, digest_sample=3,
+        state_cap=5, shuffle_every=8,
+    )
+    spec = ChurnSpec(
+        seed=42, leave_probability=0.06, join_probability=0.1,
+        cohort_every=50, cohort_max=3, restart_every=40, min_live=4,
+    )
+    orch = _fast_orch(
+        12, spec, dim=4,
+        membership=MembershipConfig(view=view, **FAST_MEMBER),
+    )
+    # Trust/flowctl ride the observer's evict-listener + cap-protector
+    # path exactly as the transport wires them; the spy screens every
+    # newly tracked peer on merge (what tcp does on receive), so their
+    # maps grow with the tracked horizon and must shrink with the cap.
+    obs = orch.nodes[0]
+    trust = TrustManager(12, 0)
+    est = DeadlineEstimator(timeout_ms=100.0)
+    trust.enable_capped_snapshots()
+    obs.membership.add_evict_listener(trust.evict_peer)
+    obs.membership.add_evict_listener(est.evict_peer)
+    obs.membership.add_cap_protector(trust.is_collapsed)
+    local = np.zeros(8, np.float32)
+    peaks = {"trust": 0, "est": 0}
+    real_merge = obs.membership.merge
+
+    def merge_spy(blob, round=None):
+        real_merge(blob, round)
+        for p in obs.membership._tracked_candidates():
+            if p not in trust._trust:
+                trust.screen(
+                    p, np.ones(8, np.float32), 1.0, local,
+                    round=int(round or 0),
+                )
+                est.observe(p, Outcome.SUCCESS, latency_s=0.01)
+        peaks["trust"] = max(peaks["trust"], len(trust.tracked_peers()))
+        peaks["est"] = max(peaks["est"], len(est.tracked_peers()))
+
+    obs.membership.merge = merge_spy
+    res = orch.run(1000)
+    assert res.episode["leave_convergence_rounds"]
+    cap = view.state_cap
+    # Between end_rounds a merge can admit at most one frame's worth of
+    # new peers before the cap re-runs — that is the only lawful
+    # overshoot.
+    slack = view.digest_sample + 2
+    assert obs.membership._evictions_by_cause["cap"] > 0
+    assert peaks["trust"] <= cap + slack, peaks
+    assert peaks["est"] <= cap + slack, peaks
+    # Trust/flowctl hold no peer the observer no longer tracks.
+    tracked_now = set(obs.membership._tracked_candidates())
+    assert set(trust.tracked_peers()) <= tracked_now
+    assert set(est.tracked_peers()) <= tracked_now
+    for f in range(12):
+        node = orch.nodes[f]
+        if node.board is None:
+            continue
+        tomb = len(node.board._evicted)
+        # The cap yields to the QUARANTINED carve-out (a verdict is
+        # never silently dropped), so residency may lawfully overshoot
+        # by the protected count — deterministic at 2 under this seed.
+        assert node.membership._peak_resident <= cap + 2
+        assert node.membership._peak_sb_tracked <= cap + slack
+        for name in _BOARD_MAPS:
+            assert len(getattr(node.board, name)) <= cap + slack + tomb, (
+                f, name
+            )
+        assert len(node.membership._view) <= cap + slack
+        part = node.membership.partial
+        assert len(part._last_touch) <= cap + slack
+        assert len(part.active) <= view.active_size
+        assert len(part.passive) <= view.passive_size
 
 
 def test_trust_and_flowctl_evict_drop_per_peer_maps():
@@ -674,6 +757,72 @@ def test_hier_gate_compares_like_with_like_only():
     assert bench.hier_gate(legacy, 2.0)["verdict"] == "no_data"
 
 
+def _fleet_hist(values, methodology=bench.BENCH_METHODOLOGY):
+    return [
+        {
+            "record": "bench",
+            "bench_methodology": methodology,
+            "fleet_resident_bytes": v,
+        }
+        for v in values
+    ]
+
+
+def test_fleet_gate_band_is_inverted_bytes_are_a_cost():
+    hist = _fleet_hist([8000, 8200, 7900, 8100])
+    assert bench.fleet_gate(hist, 8050)["verdict"] == "ok"
+    # MORE resident bytes is the regression (an O(N) map sneaking back
+    # in); fewer is the improvement.
+    assert bench.fleet_gate(hist, 20000)["verdict"] == "regressed"
+    assert bench.fleet_gate(hist, 2000)["verdict"] == "improved"
+
+
+def test_fleet_gate_needs_history_and_a_measurement():
+    assert bench.fleet_gate([], 8000)["verdict"] == "no_data"
+    assert bench.fleet_gate(_fleet_hist([8000]), 8000)["verdict"] == (
+        "no_data"
+    )
+    assert bench.fleet_gate(_fleet_hist([8000, 8100]), None)[
+        "verdict"
+    ] == "no_data"
+
+
+def test_fleet_gate_compares_like_with_like_only():
+    legacy = [{"record": "bench", "fleet_resident_bytes": 99999}] * 6
+    gate = bench.fleet_gate(legacy + _fleet_hist([8000, 8100]), 8050)
+    assert gate["samples"] == 2
+    assert gate["verdict"] == "ok"
+    old = _fleet_hist([99999] * 4, methodology=bench.BENCH_METHODOLOGY - 1)
+    assert bench.fleet_gate(old, 8050)["verdict"] == "no_data"
+    junk = _fleet_hist([8000, 8100]) + [
+        {"record": "bench", "fleet_resident_bytes": None},
+        {"record": "bench", "fleet_resident_bytes": True},
+        "garbage",
+    ]
+    assert bench.fleet_gate(junk, 8050)["samples"] == 2
+
+
+def test_bench_fleet_leg_measures_bounded_residency():
+    """A tiny two-point sweep proves the leg's plumbing: residency and
+    digest figures per N, the scaling headline, and the gate metric all
+    come out of a real orchestrator soak under the pinned view block."""
+    sweep = bench.bench_fleet([8, 24], rounds=8)
+    assert set(sweep["legs"]) == {"n8", "n24"}
+    cap = bench.FLEET_LEG_VIEW["state_cap"]
+    sample = bench.FLEET_LEG_VIEW["digest_sample"]
+    for leg in sweep["legs"].values():
+        assert leg["tracked_max"] <= cap
+        assert leg["digest_entries_max"] <= sample + 1
+        assert leg["resident_bytes_max"] > 0
+    assert sweep["peer_scaling"] == 3.0
+    assert sweep["fleet_resident_bytes"] == (
+        sweep["legs"]["n24"]["resident_bytes_max"]
+    )
+    assert bench.fleet_gate([], sweep["fleet_resident_bytes"])[
+        "verdict"
+    ] == "no_data"
+
+
 def test_read_bench_history_survives_junk(tmp_path):
     p = tmp_path / "hist.jsonl"
     p.write_text('{"record": "bench", "tcp_baseline_gbps": 0.2}\n'
@@ -775,3 +924,130 @@ def test_256_peer_soak_schema_clean(tmp_path):
         for ln in f:
             bad += bool(schema_check.check_record(json.loads(ln)))
     assert bad == 0
+
+
+# ---------------------------------------------------------------------------
+# Bounded partial views at fleet scale (ISSUE 18, docs/membership.md)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_256_peer_full_horizon_view_is_byte_identical_to_global():
+    """ISSUE 18 acceptance: with ``digest_sample >= N``, ``state_cap >=
+    N`` and ``active_size >= N-1`` the ENTIRE deterministic record
+    stream — every churn record and every decision field of every round
+    record — is byte-identical to the global-view path at 256 peers
+    under real churn."""
+    n = 256
+    spec = ChurnSpec(
+        seed=5, leave_probability=0.02, join_probability=0.2,
+        cohort_every=16, cohort_max=4, restart_every=12, min_live=128,
+        chaos_windows=(
+            ChaosWindow(20, 34, ("partition",),
+                        group=tuple(range(0, 240, 2))),
+        ),
+    )
+
+    def run(view):
+        orch = _fast_orch(
+            n, spec, dim=8,
+            membership=MembershipConfig(view=view, **FAST_MEMBER),
+        )
+        res = orch.run(80)
+        churn = [r for r in res.records if r.get("kind") == "churn"]
+        rounds = [
+            {k: v for k, v in r.items() if k not in ("wall_s", "rel_rms")}
+            for r in res.records if r.get("kind") == "round"
+        ]
+        ep = {
+            k: v for k, v in res.episode.items()
+            if not k.startswith("view_")
+            and k not in ("max_wall_s", "final_rel_rms")
+        }
+        return churn, rounds, ep
+
+    full = ViewConfig(
+        enabled=True, active_size=n - 1, passive_size=0,
+        digest_sample=n, state_cap=n, shuffle_every=0,
+    )
+    churn_g, rounds_g, ep_g = run(ViewConfig())
+    churn_v, rounds_v, ep_v = run(full)
+    assert churn_v == churn_g, "churn stream diverged under full horizon"
+    assert rounds_v == rounds_g, "round decisions diverged"
+    assert ep_v == ep_g, "episode summary diverged"
+
+
+@pytest.mark.slow
+def test_4096_peer_partial_view_soak_converges_with_bounded_state():
+    """The tentpole soak: 4096 peers, joins + leaves + cohort arrivals
+    + a partition window, every node seeing the ring through an
+    O(sample) partial view.  Membership must still converge (SWIM
+    incarnation/refutation through sampled digests), per-node state
+    must stay O(state_cap), frames O(digest_sample), and the whole
+    episode must replay bit-identically for a seed."""
+    n = 4096
+    view = ViewConfig(
+        enabled=True, active_size=8, passive_size=32, digest_sample=16,
+        state_cap=64, shuffle_every=8,
+    )
+    spec = ChurnSpec(
+        seed=9, leave_probability=0.001, join_probability=0.2,
+        cohort_every=12, cohort_max=8, restart_every=16, min_live=3584,
+        chaos_windows=(
+            ChaosWindow(14, 24, ("partition",),
+                        group=tuple(range(0, 2048))),
+        ),
+    )
+
+    def run():
+        orch = _fast_orch(
+            n, spec, dim=8,
+            membership=MembershipConfig(view=view, **FAST_MEMBER),
+        )
+        res = orch.run(44)
+        churn = [r for r in res.records if r.get("kind") == "churn"]
+        return orch, res, churn
+
+    orch, res, churn = run()
+    ep = res.episode
+
+    # Membership converges through churn: arrivals are admitted in a
+    # handful of rounds — nowhere near O(4096) — and the only
+    # unresolved joins are the freshest arrivals still inside the
+    # admission horizon at cutoff.
+    joins = ep["join_convergence_rounds"]
+    assert joins and float(np.median(joins)) <= 8
+    assert max(joins) < 64
+    assert len(ep["unresolved_joins"]) <= len(joins)
+
+    # O(sample) frames and O(state_cap) residency, fleet-wide peaks.
+    from dpwa_tpu.membership import digest as _digest
+    assert ep["view_max_digest_entries"] <= view.digest_sample + 1
+    assert ep["max_digest_bytes"] <= (
+        _digest._DIGEST_HDR.size
+        + _digest.entries_size(view.digest_sample + 1)
+    )
+    assert ep["view_max_tracked"] <= view.state_cap
+    live = [p for p in range(n) if orch.nodes[p].alive]
+    for p in live[:: max(1, len(live) // 64)]:
+        node = orch.nodes[p]
+        assert node.membership._peak_resident <= view.state_cap
+        snap = orch.residency_snapshot(p)
+        assert snap["board_tracked"] <= view.state_cap + view.digest_sample
+        assert snap["view_active"] <= view.active_size
+        assert snap["view_passive"] <= view.passive_size
+
+    # The partition window was actually felt (the observer sits in the
+    # majority; the minority's absence shows up as suspicion traffic),
+    # and the fleet kept exchanging throughout.
+    rounds = [r for r in res.records if r.get("kind") == "round"]
+    assert all(r["exchanges"] > 0 for r in rounds)
+
+    # Bit-identical replay: the deterministic churn stream is
+    # byte-for-byte stable across reruns of the seed.
+    _, res2, churn2 = run()
+    assert churn2 == churn
+    assert res2.episode["view_max_tracked"] == ep["view_max_tracked"]
+    assert res2.episode["view_max_digest_entries"] == (
+        ep["view_max_digest_entries"]
+    )

@@ -1,0 +1,410 @@
+"""Bounded partial views (ISSUE 18, docs/membership.md).
+
+Covers the tentpole's contracts from the unit level up:
+
+- view bootstrap, HyParView refill/promotion, and the passive shuffle
+  are deterministic threefry functions of (seed, round, peer);
+- digest sampling truncates to ``digest_sample`` entries, always keeps
+  damning (QUARANTINED-or-worse) claims, and rotates coverage across
+  publish clocks; ``sample >= N`` returns the full canonical list;
+- the LRU ``state_cap`` never evicts active-view members, protected
+  (QUARANTINED / collapsed-trust) peers, or the local node, and cap
+  victims flow through the evict-listener path as tombstone + prune;
+- cap-evicted peers are untracked-NOT-dead: quorum runs over the
+  tracked horizon (a capped node never counts invisible peers against
+  itself), and a digest mention re-tracks a capped peer;
+- the identity guarantee: with ``digest_sample >= N``, ``state_cap >=
+  N`` and ``active_size >= N-1``, every frame a manager publishes is
+  byte-identical to the global-view path, round by round, across
+  evictions and rejoins (the raw-frame comparison test).
+"""
+
+import importlib.util
+import io
+import json
+import os
+import sys
+
+import pytest
+
+from dpwa_tpu.config import HealthConfig, MembershipConfig, ViewConfig
+from dpwa_tpu.flowctl.estimator import DeadlineEstimator
+from dpwa_tpu.health.scoreboard import PeerState, Scoreboard
+from dpwa_tpu.membership.manager import MembershipManager
+from dpwa_tpu.membership.partial_view import PartialView
+from dpwa_tpu.trust.manager import TrustManager
+
+FAST_MEMBER = dict(dead_after_quarantines=2, dead_gossip_rounds=3)
+
+
+def _view(**kw):
+    kw.setdefault("enabled", True)
+    return ViewConfig(**kw)
+
+
+def _stack(n, me, view=None, seed=0, member_kw=None):
+    board = Scoreboard(n, me, HealthConfig(jitter_rounds=0), seed=seed)
+    kw = dict(FAST_MEMBER if member_kw is None else member_kw)
+    if view is not None:
+        kw["view"] = view
+    mgr = MembershipManager(
+        n, me, board, MembershipConfig(**kw), seed=seed
+    )
+    return board, mgr
+
+
+def _gossip_round(managers, r, pairs):
+    """One plane-level gossip round: each (a, b) pair swaps frames."""
+    frames = {m.me: m.encode(r) for m in managers.values()}
+    for a, b in pairs:
+        if a in managers and b in managers:
+            managers[a].merge(frames[b], r)
+            managers[b].merge(frames[a], r)
+    for m in managers.values():
+        m.end_round(r)
+
+
+# ---------------------------------------------------------------------------
+# PartialView unit behavior
+# ---------------------------------------------------------------------------
+
+
+def test_flat_bootstrap_seeds_ring_successors():
+    pv = PartialView(16, 3, _view(active_size=4, passive_size=6))
+    assert sorted(pv.active) == [4, 5, 6, 7]
+    assert sorted(pv.passive) == [8, 9, 10, 11, 12, 13]
+
+
+def test_touch_refills_undersized_active_then_passive():
+    pv = PartialView(16, 0, _view(active_size=3, passive_size=2))
+    pv.forget(1)  # active loses 1, passive promotes a replacement
+    assert len(pv.active) == 3 and 1 not in pv.active
+    assert pv.promotions == 1
+    pv.forget(next(iter(pv.active)))
+    pv.forget(next(iter(pv.active)))
+    pv.forget(next(iter(pv.active)))
+    # Reservoir drained: active is now undersized; a fresh contact
+    # refills active directly (HyParView refill), the next goes passive.
+    assert len(pv.active) < 3
+    pv.touch(9, 5)
+    assert 9 in pv.active
+    while len(pv.active) < 3:
+        pv.touch(10 + len(pv.active), 5)
+    pv.touch(14, 6)
+    assert 14 in pv.passive
+
+
+def test_forget_prunes_recency_and_both_views():
+    pv = PartialView(8, 0, _view(active_size=2, passive_size=2))
+    pv.touch(1, 4)
+    pv.forget(1)
+    assert pv.last_touch(1) == -1
+    assert 1 not in pv.active and 1 not in pv.passive
+
+
+def test_promotion_draw_is_deterministic():
+    picks = []
+    for _ in range(2):
+        pv = PartialView(32, 0, _view(active_size=4, passive_size=8),
+                         seed=7)
+        pv.forget(1)
+        picks.append(sorted(pv.active))
+    assert picks[0] == picks[1]
+
+
+def test_shuffle_rotates_reservoir_with_fresh_peers():
+    pv = PartialView(32, 0, _view(active_size=2, passive_size=3,
+                                  shuffle_every=4))
+    # Hear of a peer far outside the bootstrap neighborhood.
+    pv.touch(20, 3)
+    before = set(pv.passive)
+    pv.maybe_shuffle(3)  # not on the cadence: no-op
+    assert set(pv.passive) == before
+    pv.maybe_shuffle(4)
+    assert 20 in pv.passive and pv.shuffles == 1
+    assert len(pv.passive) == 3  # displaced one resident
+
+
+def test_sample_digest_identity_when_sample_covers_candidates():
+    pv = PartialView(8, 0, _view(digest_sample=8))
+    cands = [1, 2, 3, 4, 5]
+    assert pv.sample_digest(cands, (), 9) == cands
+
+
+def test_sample_digest_prioritizes_damning_and_rotates():
+    pv = PartialView(64, 0, _view(digest_sample=4))
+    cands = list(range(1, 33))
+    out = pv.sample_digest(cands, {17, 23}, 5)
+    assert len(out) == 4 and out == sorted(out)
+    assert {17, 23} <= set(out)
+    assert out == pv.sample_digest(cands, {17, 23}, 5)
+    # Across clocks the sample rotates: every candidate eventually ships.
+    seen = set()
+    for clock in range(100):
+        seen.update(pv.sample_digest(cands, (), clock))
+    assert seen == set(cands)
+
+
+def test_cap_victims_lru_order_spares_active_and_protected():
+    pv = PartialView(32, 0, _view(active_size=2, passive_size=4))
+    for p, r in ((5, 1), (6, 2), (7, 3), (8, 4)):
+        pv.touch(p, r)
+    resident = [1, 5, 6, 7, 8]  # 1 is in the bootstrap active view
+    victims = pv.cap_victims(resident, protected={5}, excess=2)
+    # LRU order, never the active member (1) or the protected peer (5).
+    assert victims == [6, 7]
+    assert pv.cap_victims(resident, (), 0) == []
+
+
+def test_view_config_validation():
+    with pytest.raises(ValueError):
+        ViewConfig(active_size=0)
+    with pytest.raises(ValueError):
+        ViewConfig(digest_sample=0)
+    with pytest.raises(ValueError):
+        ViewConfig(state_cap=2, active_size=4)
+    cfg = MembershipConfig(view={"enabled": True, "digest_sample": 5})
+    assert isinstance(cfg.view, ViewConfig) and cfg.view.digest_sample == 5
+
+
+# ---------------------------------------------------------------------------
+# Manager integration: sampling, caps, quorum horizon
+# ---------------------------------------------------------------------------
+
+
+def test_digest_sampling_bounds_frame_entries():
+    n = 24
+    view = _view(active_size=8, passive_size=8, digest_sample=4,
+                 state_cap=20)
+    mgrs = {
+        p: _stack(n, p, view)[1] for p in range(n)
+    }
+    pairs = [(p, (p + 1) % n) for p in range(0, n, 2)]
+    for r in range(8):
+        _gossip_round(mgrs, r, pairs)
+    for m in mgrs.values():
+        # self entry + at most digest_sample tracked entries.
+        assert m._digest_entries_last <= view.digest_sample + 1
+
+
+def test_state_cap_evicts_through_listener_path_and_retracks():
+    n = 32
+    view = _view(active_size=4, passive_size=8, digest_sample=16,
+                 state_cap=8)
+    board, mgr = _stack(n, 0, view)
+    dropped = []
+    mgr.add_evict_listener(dropped.append)
+    # A full-universe digest from peer 1 makes node 0 hear of everyone.
+    gboard, gmgr = _stack(n, 1, None)
+    frame = gmgr.encode(0)
+    mgr.merge(frame, 0)
+    mgr.end_round(0)
+    assert mgr._peak_resident <= view.state_cap
+    assert len(mgr._tracked_candidates()) <= view.state_cap
+    assert dropped, "cap enforcement never fired the evict listeners"
+    assert set(dropped) == set(mgr._capped)
+    assert mgr._evictions_by_cause["cap"] == len(dropped)
+    # Capped peers carry a scoreboard tombstone (pruned maps)...
+    victim = dropped[0]
+    assert victim in board.evicted_peers()
+    # ...but are untracked-NOT-dead: a fresh digest mention re-tracks
+    # the peer and clears the tombstone (alive claim outranks the cap).
+    mgr.merge(gmgr.encode(1), 1)
+    assert victim not in mgr._capped
+    assert victim not in board.evicted_peers()
+
+
+def test_quarantined_peer_is_never_cap_evicted():
+    n = 16
+    view = _view(active_size=2, passive_size=4, digest_sample=16,
+                 state_cap=4)
+    board, mgr = _stack(n, 0, view)
+    # Peer 9 is outside the bootstrap active view {1, 2}; quarantine it.
+    board.record(9, "timeout", round=1)
+    for r in range(2, 6):
+        board.record(9, "timeout", round=r)
+    assert board.state(9) == PeerState.QUARANTINED
+    _gboard, gmgr = _stack(n, 1, None)
+    mgr.merge(gmgr.encode(6), 6)
+    mgr.end_round(6)
+    assert 9 not in mgr._capped, "QUARANTINED verdict silently dropped"
+
+
+def test_collapsed_trust_protects_peer_from_cap():
+    n = 16
+    view = _view(active_size=2, passive_size=4, digest_sample=16,
+                 state_cap=4)
+    board, mgr = _stack(n, 0, view)
+    trust = TrustManager(n, 0)
+    trust._collapsed[9] = True
+    mgr.add_cap_protector(trust.is_collapsed)
+    _gboard, gmgr = _stack(n, 1, None)
+    mgr.merge(gmgr.encode(0), 0)
+    mgr.end_round(0)
+    assert 9 not in mgr._capped
+
+
+def test_quorum_runs_over_tracked_horizon_not_n_peers():
+    """Satellite 6 regression: a capped node sees ~state_cap peers out
+    of N.  If quorum still divided by N (the old ``len(peers) == N``
+    assumption), every capped node would sit permanently degraded and
+    flap partition incidents.  The universe must be the tracked
+    horizon."""
+    n = 64
+    view = _view(active_size=8, passive_size=8, digest_sample=8,
+                 state_cap=8)
+    mgrs = {p: _stack(n, p, view)[1] for p in range(0, n, 4)}
+    pairs = [(a, b) for a in mgrs for b in mgrs if a < b][:16]
+    for r in range(12):
+        _gossip_round(mgrs, r, pairs)
+    for m in mgrs.values():
+        assert not m._degraded, (
+            "healthy capped node flagged degraded: quorum divided by a "
+            "universe it cannot see"
+        )
+        events = [e for e in m.pop_events()
+                  if e.get("event") == "partition_entered"]
+        assert not events
+
+
+def test_trust_and_estimator_capped_snapshots_iterate_tracked_only():
+    trust = TrustManager(256, 0)
+    trust.enable_capped_snapshots()
+    est = DeadlineEstimator(timeout_ms=100.0)
+    import numpy as np
+    local = np.zeros(8, np.float32)
+    for peer in (3, 200):
+        trust.screen(peer, np.ones(8, np.float32), 1.0, local, round=1)
+    snap = trust.snapshot()
+    assert sorted(snap["peers"]) == [3, 200]
+    assert sorted(trust.tracked_peers()) == [3, 200]
+    assert est.tracked_peers() == []
+
+
+# ---------------------------------------------------------------------------
+# Obs pipeline: view columns through log_health / schema / report
+# ---------------------------------------------------------------------------
+
+
+def _load_health_report():
+    spec = importlib.util.spec_from_file_location(
+        "health_report",
+        os.path.join(
+            os.path.dirname(__file__), os.pardir, "tools",
+            "health_report.py",
+        ),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_view_columns_flow_through_obs_pipeline(tmp_path, capsys):
+    """wire_snapshot's ``view`` group -> log_health columns ->
+    schema_check clean -> ``health_report --membership`` digest."""
+    from dpwa_tpu.metrics import MetricsLogger
+
+    sys.path.insert(
+        0, os.path.join(os.path.dirname(__file__), os.pardir)
+    )
+    from tools import schema_check
+
+    view_group = {
+        "view_active": 8, "view_passive": 32, "view_tracked": 41,
+        "view_capped": 3, "view_digest_entries": 17,
+        "view_digest_bytes": 204, "view_evicted_dead": 2,
+        "view_evicted_cap": 5, "view_promotions": 4,
+        "view_shuffles": 6,
+    }
+    # log_health reads the view group nested under wire["view"] (the
+    # shape tcp's wire_snapshot produces alongside the codec fields).
+    snap = {
+        "me": 0,
+        "round": 9,
+        "peers": {1: {"state": "healthy", "suspicion": 0.0}},
+        "wire": {
+            "codec": "raw",
+            "wire_bytes": 4096,
+            "compression_ratio": 1.0,
+            "view": dict(view_group),
+        },
+    }
+    path = tmp_path / "health.jsonl"
+    with open(path, "w") as f:
+        log = MetricsLogger(stream=f)
+        log.log_health(9, snap)
+    rec = json.loads(path.read_text().splitlines()[-1])
+    for key, val in view_group.items():
+        assert rec[key] == val
+    assert not schema_check.check_record(rec)
+    # A truncated view group is all-or-nothing for the schema.
+    broken = dict(rec)
+    del broken["view_shuffles"]
+    assert schema_check.check_record(broken)
+
+    hr = _load_health_report()
+    summary = hr.summarize([str(path)])
+    vw = summary["membership"]["view"]
+    assert vw["seen"] and vw["tracked_final"] == 41
+    assert vw["digest_entries_max"] == 17
+    assert vw["evicted_cap"] == 5
+    hr._print_membership(summary)
+    out = capsys.readouterr().out
+    assert "partial view" in out and "lru-cap 5" in out
+
+    # Global-view records: no view_* keys, and the digest says so.
+    snap2 = {"me": 0, "round": 1, "peers": {1: {"state": "healthy"}}}
+    sio = io.StringIO()
+    log2 = MetricsLogger(stream=sio)
+    log2.log_health(1, snap2)
+    rec2 = json.loads(sio.getvalue().splitlines()[-1])
+    assert not any(k.startswith("view_") for k in rec2)
+
+
+# ---------------------------------------------------------------------------
+# The identity guarantee (raw-frame comparison)
+# ---------------------------------------------------------------------------
+
+
+def test_full_horizon_view_frames_byte_identical_to_global():
+    """``digest_sample >= N``, ``state_cap >= N``, ``active_size >=
+    N-1``: every frame and every membership event must be byte-identical
+    to the global-view path, across a dead eviction and a rejoin."""
+    n = 16
+    full = _view(active_size=n - 1, passive_size=0, digest_sample=n,
+                 state_cap=n, shuffle_every=0)
+
+    def drive(view):
+        boards, mgrs = {}, {}
+        for p in range(n):
+            boards[p], mgrs[p] = _stack(n, p, view)
+        pairs = [(p, (p + 1) % n) for p in range(0, n, 2)]
+        frames_log, events_log = [], []
+        dead = 5
+        for r in range(20):
+            frames = {}
+            for p, m in mgrs.items():
+                if r >= 3 and p == dead and r < 14:
+                    continue  # peer 5 is down for rounds 3..13
+                frames[p] = m.encode(r)
+            frames_log.append(dict(sorted(frames.items())))
+            for a, b in pairs:
+                for x, y in ((a, b), (b, a)):
+                    if x in frames and y in frames:
+                        mgrs[x].merge(frames[y], r)
+            for p, m in mgrs.items():
+                if p in frames:
+                    if dead in frames:
+                        boards[p].record(dead, "success", round=r)
+                    elif p != dead:
+                        boards[p].record(dead, "timeout", round=r)
+                    m.end_round(r)
+            events_log.append(
+                {p: mgrs[p].pop_events() for p in sorted(mgrs)}
+            )
+        return frames_log, events_log
+
+    frames_g, events_g = drive(None)
+    frames_v, events_v = drive(full)
+    assert frames_g == frames_v, "raw frames diverged under full horizon"
+    assert events_g == events_v, "plane decisions diverged"

@@ -387,6 +387,17 @@ def test_determinism_ignores_non_decision_modules():
     assert result.errors == []
 
 
+def test_determinism_covers_partial_view_as_decision_module():
+    # The partial-view sampler draws peers and shuffles reservoirs; if it
+    # ever regressed to ambient randomness, digests would diverge across
+    # seeded reruns. Pin that dpwalint treats it as a decision path.
+    src = "import random\nx = random.random()\n"
+    result = _run_on_source(
+        [DeterminismChecker()], {"dpwa_tpu/membership/partial_view.py": src}
+    )
+    assert [f.rule for f in result.errors] == ["det-random"]
+
+
 def test_tag_literal_flagged_everywhere():
     src = '''
 from dpwa_tpu.parallel.schedules import _pair_key
@@ -744,6 +755,8 @@ def test_threefry_tags_are_pinned():
         28: "chaos:stall_len",
         32: "shard_draw",
         33: "async_drain_draw",
+        34: "view_sample_draw",
+        35: "passive_shuffle_draw",
     }
     assert tags.CHAOS_TAG_BASE == 16
     # Second control-plane block: 0..15 is full, 16..31 belongs to the
@@ -751,6 +764,8 @@ def test_threefry_tags_are_pinned():
     assert tags.CONTROL_TAG_BASE_2 == 32
     assert tags.TAG_SHARD == 32
     assert tags.TAG_ASYNC_DRAIN == 33
+    assert tags.TAG_VIEW_SAMPLE == 34
+    assert tags.TAG_PASSIVE_SHUFFLE == 35
 
 
 def test_tag_collision_raises():

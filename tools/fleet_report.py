@@ -54,6 +54,7 @@ ALERT_CLASS: Dict[str, str] = {
     "peer_failure": "peer_down",
     "leader_failover": "leader_failover",
     "straggler": "straggler",
+    "staleness_storm": "staleness_storm",
     "state_storm": "state_storm",
     "slo_burn": "slo_burn",
     "conv_stall": "conv_stall",

@@ -231,6 +231,22 @@ def summarize(
         "reconcile_rejected": 0,
         "reconcile_donors": {},
         "last_partition_state": None,
+        # Partial-view columns (membership.view, docs/membership.md):
+        # finals + run maxima of the bounded-horizon gauges, absent
+        # ("seen": False) on global-view runs.
+        "view": {
+            "seen": False,
+            "active_final": None, "active_max": None,
+            "passive_final": None, "passive_max": None,
+            "tracked_final": None, "tracked_max": None,
+            "capped_final": None, "capped_max": None,
+            "digest_entries_final": None, "digest_entries_max": None,
+            "digest_bytes_final": None, "digest_bytes_max": None,
+            "evicted_dead": None,
+            "evicted_cap": None,
+            "promotions": None,
+            "shuffles": None,
+        },
     }
 
     def slot(p: int) -> Dict[str, Any]:
@@ -331,6 +347,28 @@ def summarize(
             n_health += 1
             if rec.get("partition_state") is not None:
                 membership["last_partition_state"] = rec["partition_state"]
+            if rec.get("view_tracked") is not None:
+                vw = membership["view"]
+                vw["seen"] = True
+                for key in (
+                    "active", "passive", "tracked", "capped",
+                    "digest_entries", "digest_bytes",
+                ):
+                    val = rec.get(f"view_{key}")
+                    if val is None:
+                        continue
+                    vw[f"{key}_final"] = val
+                    prev = vw[f"{key}_max"]
+                    vw[f"{key}_max"] = (
+                        val if prev is None else max(prev, val)
+                    )
+                for key in (
+                    "evicted_dead", "evicted_cap", "promotions",
+                    "shuffles",
+                ):
+                    val = rec.get(f"view_{key}")
+                    if val is not None:
+                        vw[key] = val
             for i, p in enumerate(rec.get("peer", [])):
                 last_health[int(p)] = {
                     "state": rec["peer_state"][i],
@@ -565,6 +603,46 @@ def summarize(
         "reactor": reactor,
         "async": async_,
     }
+
+
+def _print_membership(summary: Dict[str, Any]) -> None:
+    """The ``--membership`` digest: the bounded partial-view columns
+    (docs/membership.md) — view sizes, per-frame digest entries, and
+    evictions split by cause (dead vs LRU cap)."""
+    vw = summary.get("membership", {}).get("view", {})
+    print()
+    print("# membership: partial view")
+    if not vw.get("seen"):
+        print(
+            "  no view_* columns in input (membership.view disabled: "
+            "global horizon)"
+        )
+        return
+    print(
+        f"  views: active {vw['active_final']} "
+        f"(max {vw['active_max']}), "
+        f"passive {vw['passive_final']} (max {vw['passive_max']})"
+    )
+    print(
+        f"  tracked horizon: {vw['tracked_final']} peers "
+        f"(max {vw['tracked_max']}); "
+        f"cap-tombstoned now: {vw['capped_final']} "
+        f"(max {vw['capped_max']})"
+    )
+    print(
+        f"  digest: {vw['digest_entries_final']} entries/frame "
+        f"(max {vw['digest_entries_max']}), "
+        f"{vw['digest_bytes_final']} B/frame "
+        f"(max {vw['digest_bytes_max']})"
+    )
+    print(
+        f"  evictions by cause: dead {vw['evicted_dead']}, "
+        f"lru-cap {vw['evicted_cap']}"
+    )
+    print(
+        f"  view churn: promotions {vw['promotions']}, "
+        f"passive shuffles {vw['shuffles']}"
+    )
 
 
 def _print_trust(summary: Dict[str, Any]) -> None:
@@ -876,6 +954,13 @@ def main(argv=None) -> int:
         "partition_windows start); enables per-episode time-to-detect",
     )
     ap.add_argument(
+        "--membership",
+        action="store_true",
+        help="print the membership partial-view digest (active/passive "
+        "view sizes, tracked horizon, digest entries and bytes per "
+        "frame, evictions by cause; docs/membership.md)",
+    )
+    ap.add_argument(
         "--trust",
         action="store_true",
         help="print the content-trust digest (per-peer trust trajectory, "
@@ -917,6 +1002,8 @@ def main(argv=None) -> int:
         print()
     else:
         _print_table(summary)
+        if args.membership:
+            _print_membership(summary)
         if args.trust:
             _print_trust(summary)
         if args.flowctl:

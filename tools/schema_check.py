@@ -130,6 +130,21 @@ _HEALTH_GROUPS: Dict[str, Dict[str, tuple]] = {
         "shard_k": (int,),
         "shard_coverage": _NUM,
     },
+    # Bounded partial views (docs/membership.md; present exactly when
+    # membership.view is on): view sizes, tracked residency vs the
+    # state cap, per-frame digest footprint, evictions by cause.
+    "view": {
+        "view_active": (int,),
+        "view_passive": (int,),
+        "view_tracked": (int,),
+        "view_capped": (int,),
+        "view_digest_entries": (int,),
+        "view_digest_bytes": (int,),
+        "view_evicted_dead": (int,),
+        "view_evicted_cap": (int,),
+        "view_promotions": (int,),
+        "view_shuffles": (int,),
+    },
     # Device merge engine (docs/device.md; absent until a device-
     # resident exchange has served a round).
     "device": {
@@ -353,6 +368,11 @@ _FLEET_EPISODE_REQUIRED: Dict[str, tuple] = {
 _FLEET_EPISODE_OPTIONAL: Dict[str, tuple] = {
     "islands": (int,),
     "leader_terms": (dict,),
+    # membership.view-only (docs/membership.md): worst-case per-node
+    # residency, present iff the partial-view plane is enabled.
+    "view_max_resident_bytes": (int,),
+    "view_max_tracked": (int,),
+    "view_max_digest_entries": (int,),
 }
 
 # Per-island convergence records (docs/hierarchy.md): one per island
@@ -406,6 +426,9 @@ EVENT_KINDS = frozenset(
         "peer_dead", "peer_rejoined",
         # hierarchical gossip leadership (PR 12, docs/hierarchy.md)
         "leader_elected", "leader_failover",
+        # bounded partial views (PR 18, docs/membership.md): LRU cap
+        # eviction is untracked-not-dead, so it gets its own kind.
+        "peers_capped",
     }
 )
 
