@@ -1300,6 +1300,71 @@ def fleet_gate(
     return gate
 
 
+TRAIN_GATE_WINDOW = 8
+TRAIN_GATE_REL_TOL = 0.5
+
+
+def train_gate(
+    history: list,
+    current_steps,
+    leg_ok: bool,
+    window: int = TRAIN_GATE_WINDOW,
+    rel_tol: float = TRAIN_GATE_REL_TOL,
+    methodology: int = BENCH_METHODOLOGY,
+) -> dict:
+    """Regression gate for the end-to-end training leg, keyed on the
+    clean leg's ``train_steps_to_target`` (pure; the ``fleet_gate``
+    inverted-band pattern — steps to target loss are a cost, so
+    drifting UP is the regression).  Two layers:
+
+    - ``leg_ok`` is the leg's own chaos-certification verdict (every
+      acceptance bool in ``LegResult.verdict``); a failed leg is
+      ``"failed"`` outright — no history median can excuse a run that
+      did not converge or whose incident plane misbehaved;
+    - the metric band then judges time-to-quality drift against the
+      last ``window`` same-methodology history samples, so a merge
+      regression that slows convergence without breaking acceptance
+      still surfaces here."""
+    samples = [
+        float(e["train_steps_to_target"])
+        for e in history
+        if isinstance(e, dict)
+        and e.get("record") == "bench"
+        and e.get("bench_methodology") == methodology
+        and isinstance(e.get("train_steps_to_target"), (int, float))
+        and not isinstance(e.get("train_steps_to_target"), bool)
+    ][-int(window):]
+    median = float(np.median(samples)) if samples else None
+    gate = {
+        "samples": len(samples),
+        "window": int(window),
+        "rel_tol": float(rel_tol),
+        "methodology": int(methodology),
+        "leg_ok": bool(leg_ok),
+        "median_steps": (
+            round(median, 1) if median is not None else None
+        ),
+        "current_steps": (
+            round(float(current_steps), 1)
+            if current_steps is not None else None
+        ),
+    }
+    if not leg_ok:
+        gate["verdict"] = "failed"
+        return gate
+    if current_steps is None or len(samples) < 2:
+        gate["verdict"] = "no_data"
+        return gate
+    cur = float(current_steps)
+    if cur > median * (1.0 + rel_tol):
+        gate["verdict"] = "regressed"
+    elif cur < median * (1.0 - rel_tol):
+        gate["verdict"] = "improved"
+    else:
+        gate["verdict"] = "ok"
+    return gate
+
+
 def bench_fleet(
     peer_counts,
     rounds: int = 24,
@@ -1518,9 +1583,22 @@ def bench_async(
     }
 
 
-# Frame sizes for the zero-copy leg: 16 MiB (a mid-size replica) and
-# ~100 MB (the ResNet-50-scale default the headline bench ships).
-COPY_SWEEP_FRAME_FLOATS = (4 * 1024 * 1024, 24 * 1024 * 1024)
+# Frame sizes for the zero-copy leg: 4 KiB and ~392 KiB (the LoRA
+# adapter-only exchange regime — dpwa_tpu/run/task.py's lora task ships
+# d≈100K), then 16 MiB (a mid-size replica) and ~100 MB (the
+# ResNet-50-scale default the headline bench ships).
+COPY_SWEEP_FRAME_FLOATS = (
+    1024, 100_352, 4 * 1024 * 1024, 24 * 1024 * 1024
+)
+
+
+def frame_label(nbytes: int) -> str:
+    """Human frame-size label, KiB-resolved below 1 MiB — the integer
+    ``>> 20`` label would collapse every small-frame cell onto "0MiB"
+    and the sweep dict would silently keep only the last one."""
+    if nbytes >= 1 << 20:
+        return f"{nbytes >> 20}MiB"
+    return f"{nbytes >> 10}KiB"
 
 
 def _legacy_fetch_blob(host: str, port: int, timeout_ms: int = 20000):
@@ -1556,6 +1634,12 @@ def _legacy_fetch_blob(host: str, port: int, timeout_ms: int = 20000):
         )
         assert magic == _MAGIC and version == 1 and code == 0
         return np.frombuffer(recv_n(nbytes), np.float32), clock, loss
+
+
+# Decode-allocation bound for the copy leg's sub-MiB cells: generous
+# O(header + probe) slack (Python-object churn included), thousands of
+# times below the replica-scale frames and still frame-size-independent.
+COPY_ALLOC_CAP_BYTES = 64 * 1024
 
 
 def bench_copy(
@@ -1643,7 +1727,7 @@ def bench_copy(
                 }
             finally:
                 srv.close()
-        frames[f"{vec.nbytes >> 20}MiB"] = {
+        frames[frame_label(vec.nbytes)] = {
             "frame_bytes": int(vec.nbytes),
             "servers": servers,
         }
@@ -1652,11 +1736,25 @@ def bench_copy(
         for fr in frames.values()
         for leg in fr["servers"].values()
     )
+    # The O(header) acceptance for the small-frame (LoRA) regime: a
+    # warmed zerocopy fetch's decode allocation must stay bounded by
+    # header + probe bookkeeping — independent of frame size — or the
+    # ring is quietly allocating per frame (the small-class waste the
+    # KiB cells exist to expose).
+    alloc_cap = COPY_ALLOC_CAP_BYTES
+    small_ok = all(
+        leg["decode_alloc_per_frame_bytes"] <= alloc_cap
+        for fr in frames.values()
+        if fr["frame_bytes"] < (1 << 20)
+        for leg in fr["servers"].values()
+    )
     return {
         "iters": int(iters),
         "sizes_floats": [int(s) for s in sizes],
         "frames": frames,
         "best_speedup": best,
+        "alloc_cap_bytes": int(alloc_cap),
+        "small_frame_alloc_ok": bool(small_ok),
     }
 
 
@@ -1844,7 +1942,7 @@ def bench_merge(
                 med = float(np.median(fused_durs))
                 q1, q3 = np.percentile(fused_durs, [25, 75])
                 spread = float((q3 - q1) / med) if med > 0 else None
-        frames[f"{nbytes >> 20}MiB"] = {
+        frames[frame_label(nbytes)] = {
             "frame_bytes": int(nbytes),
             "codecs": cells,
         }
@@ -2219,6 +2317,36 @@ def main() -> None:
         help="churn rounds per fleet-leg soak",
     )
     ap.add_argument(
+        "--train-leg", action="store_true",
+        help="run ONLY the end-to-end training leg: the clean chaos-"
+        "certification leg (dpwa_tpu/run/) — gossip SGD at --train-"
+        "peers vs a single-process control arm at equal total steps — "
+        "recorded with a train_gate verdict on steps-to-target-loss; "
+        "appends its own bench_history.jsonl record",
+    )
+    ap.add_argument(
+        "--train-leg-run", action="store_true",
+        help="internal: the train leg's backend-pinned subprocess "
+        "entry (use --train-leg)",
+    )
+    ap.add_argument(
+        "--train-task", type=str, default="blobs",
+        help="training task for the train leg (dpwa_tpu/run/task.py "
+        "registry: blobs, digits, lora)",
+    )
+    ap.add_argument(
+        "--train-peers", type=int, default=8,
+        help="peer count for the train leg",
+    )
+    ap.add_argument(
+        "--train-base-port", type=int, default=47400,
+        help="base TCP port for the train leg's gossip cohort",
+    )
+    ap.add_argument(
+        "--train-timeout", type=float, default=600.0,
+        help="watchdog timeout (s) for the train leg subprocess",
+    )
+    ap.add_argument(
         "--confirm-timeout", type=float, default=DEAD_CONFIRM_TIMEOUT_S,
         help="capped single-probe timeout once the backend dead-streak "
         "has tripped (the cheap re-confirmation instead of the full "
@@ -2260,6 +2388,26 @@ def main() -> None:
     if args.serve_leg:
         res = bench_serve(args.serve_frame_floats, args.serve_seconds)
         print("SERVE_LEG " + json.dumps(res), flush=True)
+        return
+    if args.train_leg_run:
+        # In-process arm of --train-leg (imports jax; the parent pins
+        # the backend and scrubs the env before spawning this).
+        import tempfile
+
+        from dpwa_tpu.run.legs import clean_leg
+
+        workdir = tempfile.mkdtemp(prefix="dpwa-train-leg-")
+        res = clean_leg(
+            workdir,
+            n_peers=args.train_peers,
+            task=args.train_task,
+            base_port=args.train_base_port,
+        )
+        payload = res.to_record()
+        print("TRAIN_LEG " + json.dumps(payload), flush=True)
+        stt = payload["verdict"].get("gossip_steps_to_target")
+        if stt is not None:
+            print(f"TRAIN_STEPS {float(stt):.6f}", flush=True)
         return
     if args.hier_leg:
         # Standalone mode (like the other legs, but user-facing): the
@@ -2460,7 +2608,7 @@ def main() -> None:
             int(s) for s in args.copy_frame_floats.split(",") if s.strip()
         ]
         log(
-            f"copy leg: frames {[s * 4 // (1 << 20) for s in sizes]} MiB, "
+            f"copy leg: frames {[frame_label(s * 4) for s in sizes]}, "
             f"x{args.copy_iters} fetches per cell ..."
         )
         sweep = bench_copy(sizes, args.copy_iters)
@@ -2474,6 +2622,11 @@ def main() -> None:
                     f"{leg['decode_alloc_per_frame_bytes']} B/frame"
                 )
         log(f"copy leg: best speedup {sweep['best_speedup']}x")
+        log(
+            "copy leg: small-frame decode alloc "
+            f"{'OK' if sweep['small_frame_alloc_ok'] else 'EXCEEDED'} "
+            f"(cap {sweep['alloc_cap_bytes']} B)"
+        )
         out = {
             "metric": "zero_copy_frame_path",
             "bench_methodology": BENCH_METHODOLOGY,
@@ -2558,6 +2711,69 @@ def main() -> None:
             "merge_gate": gate,
         }
         print("MERGE_LEG " + json.dumps(sweep), flush=True)
+        print(json.dumps(out), flush=True)
+        try:
+            os.makedirs(os.path.dirname(history_path), exist_ok=True)
+            with open(history_path, "a", encoding="utf-8") as f:
+                f.write(
+                    json.dumps({"record": "bench", "t": time.time(), **out})
+                    + "\n"
+                )
+        except OSError:
+            pass
+        return
+    if args.train_leg:
+        # The leg imports jax (real optimizer steps through the real
+        # gossip stack), so it runs as a backend-pinned watchdog'd
+        # subprocess (the merge-leg pattern) and the parent judges the
+        # result: the clean leg's own chaos-certification verdict plus
+        # a time-to-quality band against recent history.
+        log(
+            f"train leg: {args.train_peers} peers, task "
+            f"{args.train_task}, vs single-process control arm ..."
+        )
+        cpu_env = os.environ.copy()
+        cpu_env["JAX_PLATFORMS"] = "cpu"
+        cpu_env["PYTHONPATH"] = os.pathsep.join(
+            p for p in cpu_env.get("PYTHONPATH", "").split(os.pathsep)
+            if p and "axon" not in p
+        )
+        stt, leg = run_leg(
+            "--train-leg-run",
+            [
+                "--train-task", args.train_task,
+                "--train-peers", str(args.train_peers),
+                "--train-base-port", str(args.train_base_port),
+            ],
+            "TRAIN_STEPS", args.train_timeout, cpu_env,
+            json_tag="TRAIN_LEG",
+        )
+        verdict = (leg or {}).get("verdict", {})
+        if leg:
+            log(
+                f"train leg: gossip steps-to-target "
+                f"{verdict.get('gossip_steps_to_target')} vs single "
+                f"{verdict.get('single_steps_to_target')} "
+                f"(tol {verdict.get('steps_tol')}x), leg "
+                f"{'ok' if leg.get('ok') else 'FAILED'}"
+            )
+        history_path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "artifacts", "bench_history.jsonl",
+        )
+        gate = train_gate(
+            read_bench_history(history_path), stt,
+            bool(leg and leg.get("ok")),
+        )
+        log(f"train leg: gate {gate['verdict']}")
+        out = {
+            "metric": "train_time_to_quality",
+            "bench_methodology": BENCH_METHODOLOGY,
+            "train": leg,
+            "train_steps_to_target": stt,
+            "train_gate": gate,
+        }
+        print("TRAIN_LEG " + json.dumps(leg), flush=True)
         print(json.dumps(out), flush=True)
         try:
             os.makedirs(os.path.dirname(history_path), exist_ok=True)

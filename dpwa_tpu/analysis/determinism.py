@@ -34,6 +34,7 @@ _DECISION_MARKERS = (
     "membership/",
     "parallel/interpolation.py",
     "parallel/async_loop.py",
+    "run/",
 )
 
 # consumers for which iteration order genuinely does not matter

@@ -125,6 +125,12 @@ Schema::
       enabled: true             # peer bootstrap serving + payload guard
       max_param_norm: 1.0e12    # reject/roll back when ||vec||_2 exceeds
       max_loss: 1.0e9           # reject/roll back when |loss| exceeds
+      rescue_loss: null         # finite local loss beyond THIS bound gets
+                                #   the interpolation alpha=1 rescue
+                                #   (null = 16 * max_loss; must be >=
+                                #   max_loss so a normal training spike
+                                #   near the guard bound never triggers
+                                #   wholesale replica adoption)
       min_param_norm_ratio: 1.0e-4  # reject a remote whose norm is below
                                 #   this fraction of the local norm
                                 #   (zero-energy payload; 0 = off)
@@ -280,6 +286,17 @@ Schema::
       intra_rounds: 1           # intra-island averaging sweeps folded in
                                 #   per wide-area round (hypercube phases;
                                 #   1 sweep = exact island mean)
+    run:                        # training-harness loop (docs/training.md)
+      steps: 100                # optimizer steps per node
+      batch_size: 32            # per-node minibatch size
+      lr: 0.1                   # SGD learning rate
+      momentum: 0.0             # SGD momentum (0 = plain SGD)
+      loss_every: 1             # emit a loss record every k steps
+      checkpoint_every: 0       # save a checkpoint every k steps (0 = off)
+      checkpoint_dir: null      # checkpoint directory ("{me}" substituted)
+      checkpoint_keep: 3        # newest checkpoints kept per node
+      target_loss: 0.0          # time-to-loss threshold the acceptance
+                                #   legs measure against (0 = off)
 """
 
 from __future__ import annotations
@@ -740,8 +757,18 @@ class RecoveryConfig:
     * the **local rollback ring** restores the newest last-good snapshot
       when the local replica itself trips the same bounds;
     * the **interpolation rescue** (`interpolation._clamped`) treats a
-      finite-but-huge local loss beyond ``max_loss`` as sick metadata,
-      granting the full alpha=1 rescue.
+      finite-but-huge local loss beyond the RESCUE bound as sick
+      metadata, granting the full alpha=1 rescue.
+
+    The rescue bound is deliberately NOT ``max_loss`` itself: the guard
+    bound gets tuned down to the real loss scale of a workload (so a
+    diverged peer's advertised loss is caught early), and a normal
+    early-training loss spike can brush right up against it.  Crossing
+    the guard bound costs one rejected frame or one ring rollback —
+    recoverable either way — but the alpha=1 rescue REPLACES the local
+    replica wholesale, which must be reserved for actually-diverged
+    state.  ``rescue_loss`` (default ``16 * max_loss``) is that second,
+    strictly-larger threshold; see :meth:`rescue_bound`.
 
     ``enabled`` also turns on STATE serving in the Rx server so a
     restarted peer can bootstrap over the blob wire (this forces the
@@ -751,6 +778,11 @@ class RecoveryConfig:
     enabled: bool = True
     max_param_norm: float = 1e12
     max_loss: float = 1e9
+    # Interpolation-rescue threshold: a finite LOCAL loss beyond this
+    # bound counts as sick metadata deserving the alpha=1 rescue.  None
+    # derives 16 * max_loss (see the class docstring for why the rescue
+    # must sit well above the guard bound).
+    rescue_loss: "float | None" = None
     # Zero-energy floor: reject a remote whose L2 norm falls below this
     # fraction of the LOCAL norm (a half-bootstrapped or byzantine peer
     # serving zeros would otherwise drag honest weights toward zero at
@@ -772,6 +804,13 @@ class RecoveryConfig:
             )
         if self.max_loss <= 0:
             raise ValueError(f"max_loss must be > 0, got {self.max_loss}")
+        if self.rescue_loss is not None and self.rescue_loss < self.max_loss:
+            raise ValueError(
+                f"rescue_loss must be >= max_loss ({self.max_loss}) — a "
+                f"rescue below the guard bound would adopt a peer replica "
+                f"wholesale on losses the guard still tolerates; got "
+                f"{self.rescue_loss}"
+            )
         if self.snapshot_every < 1:
             raise ValueError(
                 f"snapshot_every must be >= 1, got {self.snapshot_every}"
@@ -802,6 +841,17 @@ class RecoveryConfig:
                 f"min_param_norm_ratio must be in [0, 1), "
                 f"got {self.min_param_norm_ratio}"
             )
+
+    def rescue_bound(self) -> float:
+        """The |loss| threshold for the interpolation alpha=1 rescue.
+
+        ``rescue_loss`` when configured, else ``16 * max_loss`` — always
+        at or above the guard's reject bound, so a loss the guard would
+        merely reject/roll back never triggers wholesale adoption of a
+        peer replica."""
+        if self.rescue_loss is not None:
+            return float(self.rescue_loss)
+        return 16.0 * float(self.max_loss)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -1419,6 +1469,61 @@ class TopologyConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class RunConfig:
+    """``run:`` block — the training-harness loop (docs/training.md).
+
+    Knobs for :mod:`dpwa_tpu.run`: how many optimizer steps each node
+    takes, the SGD hyperparameters, the loss-record cadence, and the
+    periodic-checkpoint policy the crash leg restarts from.  The data
+    order is NOT configured here: each node's per-epoch shuffle is a
+    threefry draw keyed on ``(protocol.seed, epoch, node)``
+    (``schedules.data_shuffle_draw``), so a seeded rerun replays the
+    exact batch sequence with no stream state to save."""
+
+    steps: int = 100
+    batch_size: int = 32
+    lr: float = 0.1
+    momentum: float = 0.0
+    loss_every: int = 1
+    checkpoint_every: int = 0
+    checkpoint_dir: "str | None" = None
+    checkpoint_keep: int = 3
+    target_loss: float = 0.0
+
+    def __post_init__(self) -> None:
+        if self.steps < 1:
+            raise ValueError(f"run.steps must be >= 1, got {self.steps}")
+        if self.batch_size < 1:
+            raise ValueError(
+                f"run.batch_size must be >= 1, got {self.batch_size}"
+            )
+        if self.lr <= 0:
+            raise ValueError(f"run.lr must be > 0, got {self.lr}")
+        if not 0.0 <= self.momentum < 1.0:
+            raise ValueError(
+                f"run.momentum must be in [0, 1), got {self.momentum}"
+            )
+        if self.loss_every < 1:
+            raise ValueError(
+                f"run.loss_every must be >= 1, got {self.loss_every}"
+            )
+        if self.checkpoint_every < 0:
+            raise ValueError(
+                f"run.checkpoint_every must be >= 0, "
+                f"got {self.checkpoint_every}"
+            )
+        if self.checkpoint_keep < 1:
+            raise ValueError(
+                f"run.checkpoint_keep must be >= 1, "
+                f"got {self.checkpoint_keep}"
+            )
+        if self.target_loss < 0:
+            raise ValueError(
+                f"run.target_loss must be >= 0, got {self.target_loss}"
+            )
+
+
+@dataclasses.dataclass(frozen=True)
 class DpwaConfig:
     nodes: tuple[NodeSpec, ...]
     protocol: ProtocolConfig = ProtocolConfig()
@@ -1432,6 +1537,7 @@ class DpwaConfig:
     flowctl: FlowctlConfig = FlowctlConfig()
     obs: ObsConfig = ObsConfig()
     topology: TopologyConfig = TopologyConfig()
+    run: RunConfig = RunConfig()
 
     def __post_init__(self) -> None:
         # Errors here name the offending island/node (satellite fix):
@@ -1522,6 +1628,7 @@ def config_from_dict(raw: Mapping[str, Any]) -> DpwaConfig:
     flowctl = dict(raw.get("flowctl") or {})
     obs = dict(raw.get("obs") or {})
     topology = dict(raw.get("topology") or {})
+    run = dict(raw.get("run") or {})
     if topology.get("islands") is not None:
         topology["islands"] = _build_islands(topology["islands"])
     for key in (
@@ -1543,6 +1650,7 @@ def config_from_dict(raw: Mapping[str, Any]) -> DpwaConfig:
         flowctl=FlowctlConfig(**flowctl),
         obs=ObsConfig(**obs),
         topology=TopologyConfig(**topology),
+        run=RunConfig(**run),
     )
 
 
@@ -1573,6 +1681,7 @@ def make_local_config(
     obs: "ObsConfig | Mapping[str, Any] | None" = None,
     topology: "TopologyConfig | Mapping[str, Any] | None" = None,
     shard: "ShardConfig | Mapping[str, Any] | None" = None,
+    run: "RunConfig | Mapping[str, Any] | None" = None,
     **protocol_kwargs: Any,
 ) -> DpwaConfig:
     """Programmatic config for tests/benchmarks: n local peers on 127.0.0.1.
@@ -1596,6 +1705,8 @@ def make_local_config(
         obs = ObsConfig(**obs)
     if isinstance(shard, Mapping):
         shard = ShardConfig(**shard)
+    if isinstance(run, Mapping):
+        run = RunConfig(**run)
     if isinstance(topology, Mapping):
         topology = dict(topology)
         if topology.get("islands") is not None:
@@ -1622,4 +1733,5 @@ def make_local_config(
         obs=obs if obs is not None else ObsConfig(),
         topology=topology if topology is not None else TopologyConfig(),
         shard=shard if shard is not None else ShardConfig(),
+        run=run if run is not None else RunConfig(),
     )

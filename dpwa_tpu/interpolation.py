@@ -103,14 +103,22 @@ def _clamped(
     the same keep-training posture as a failed fetch).
 
     "Sick" means non-finite metadata (NaN/inf clock or loss), and — when
-    ``max_abs_loss`` is given (the ``recovery:`` block's ``max_loss``
-    sanity bound, threaded through :func:`make_interpolation`) — also a
-    finite loss beyond that bound.  A replica at loss 1e30 has diverged
-    in every sense that matters; without the bound it took the ordinary
-    clipped path (e.g. ``loss_weighted``'s ratio capped at
-    ``min(factor, 1)``) and never got the full α = 1 rescue its state
-    needs.  With no bound configured, finite-but-huge keeps the ordinary
-    path — only actually-poisoned metadata rescues.
+    ``max_abs_loss`` is given (``RecoveryConfig.rescue_bound()``,
+    threaded through :func:`make_interpolation`) — also a finite loss
+    beyond that bound.  A replica at loss 1e30 has diverged in every
+    sense that matters; without the bound it took the ordinary clipped
+    path (e.g. ``loss_weighted``'s ratio capped at ``min(factor, 1)``)
+    and never got the full α = 1 rescue its state needs.  With no bound
+    configured, finite-but-huge keeps the ordinary path — only
+    actually-poisoned metadata rescues.
+
+    The bound passed here is deliberately the RESCUE bound, not the
+    guard's ``recovery.max_loss`` reject bound: real training runs tune
+    ``max_loss`` down to their loss scale so diverged peers are caught
+    early, and a normal early-training loss spike can brush against it.
+    Tripping the guard costs one rejected frame or one rollback — both
+    recoverable — but α = 1 REPLACES the local replica, so it arms only
+    ``rescue_bound()`` (default ``16 * max_loss``) past the guard.
 
     ``trust_scale`` — the content-trust plane's merge damping
     (:meth:`dpwa_tpu.trust.TrustManager.alpha_scale`, threaded by the
@@ -129,6 +137,11 @@ def _clamped(
             remote_ok = remote_ok & (jnp.abs(remote.loss) <= bound)
         rescue = jnp.where(~local_ok & remote_ok, 1.0, 0.0)
         a = jnp.where(jnp.isfinite(a) & local_ok, a, rescue)
+        # A sick REMOTE never merges: the TCP path's guard already
+        # rejects such frames at the (stricter) ``recovery.max_loss``
+        # bound, but the ICI/stacked substrates have no per-frame guard
+        # — this is their only screen against a diverged neighbor.
+        a = jnp.where(remote_ok, a, 0.0)
         a = jnp.clip(a, 0.0, 1.0)
         if trust_scale is not None:
             a = a * jnp.clip(jnp.float32(trust_scale()), 0.0, 1.0)
@@ -145,8 +158,8 @@ def make_interpolation(
     """Factory from the YAML ``interpolation:`` section.
 
     Every returned strategy is clamped to α ∈ [0, 1] (see ``_clamped``).
-    ``max_abs_loss`` — normally ``recovery.max_loss``, passed by the
-    transports when recovery is enabled — additionally treats a
+    ``max_abs_loss`` — normally ``recovery.rescue_bound()``, passed by
+    the transports when recovery is enabled — additionally treats a
     finite-but-huge local loss as sick metadata deserving the full α = 1
     rescue.  ``trust_scale`` — the trust plane's per-exchange merge
     damping, multiplied in after the clamp (see ``_clamped``)."""

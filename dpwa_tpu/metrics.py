@@ -440,6 +440,73 @@ class MetricsLogger:
             **extra,
         )
 
+    def log_loss(
+        self,
+        step: int,
+        loss: float,
+        me: int,
+        epoch: Optional[int] = None,
+        alpha: Optional[float] = None,
+        partner: Optional[int] = None,
+        outcome: Optional[str] = None,
+        test_loss: Optional[float] = None,
+        test_acc: Optional[float] = None,
+        _t: Optional[float] = None,
+    ) -> None:
+        """One ``record: "loss"`` row — the training harness's per-step
+        loss stream (docs/training.md).
+
+        The schema is CLOSED (tools/schema_check.py): only the merge
+        metadata that the loss/incident join consumes rides along, so
+        the record stays diffable across runs and planes.  Obeys
+        ``every`` like ordinary records; the harness additionally
+        applies ``run.loss_every`` before calling.  ``_t`` overrides the
+        time stamp — the harness passes its VirtualClock so seeded
+        reruns produce byte-identical rows."""
+        fields: dict[str, Any] = {"record": "loss", "me": int(me)}
+        fields["loss"] = float(loss)
+        if epoch is not None:
+            fields["epoch"] = int(epoch)
+        if alpha is not None:
+            fields["alpha"] = float(alpha)
+        if partner is not None:
+            fields["partner"] = int(partner)
+        if outcome is not None:
+            fields["outcome"] = str(outcome)
+        if test_loss is not None:
+            fields["test_loss"] = float(test_loss)
+        if test_acc is not None:
+            fields["test_acc"] = float(test_acc)
+        self.log(step, _t=_t, **fields)
+
+    def log_run(
+        self, step: int, me: int, leg: str, status: str, peers: int,
+        seed: int, _t: Optional[float] = None, **fields: Any,
+    ) -> None:
+        """One ``record: "run"`` envelope row (docs/training.md).
+
+        ``status: "start"`` opens a node's stream with the leg shape;
+        exactly one terminal ``"done"``/``"crashed"`` row carries the
+        outcome fields ``tools/run_report.py`` and the bench train leg
+        consume.  Bypasses ``every``: an envelope row dropped to a
+        sampling interval would orphan the whole stream."""
+        self.flush()
+        rec: dict[str, Any] = {
+            "step": int(step),
+            "t": round(
+                (time.perf_counter() - self._t0) if _t is None else _t, 4
+            ),
+            "record": "run",
+            "me": int(me),
+            "leg": str(leg),
+            "status": str(status),
+            "peers": int(peers),
+            "seed": int(seed),
+        }
+        for k, v in fields.items():
+            rec[k] = _jsonable(v)
+        self._write(json.dumps(rec))
+
     # dpwalint: thread_root(rx)
     def log_event(self, step: int, event: str, **fields: Any) -> None:
         """One recovery/control-plane event record, written immediately.

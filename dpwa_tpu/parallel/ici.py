@@ -199,7 +199,7 @@ class IciTransport:
         self.interp = make_interpolation(
             config.interpolation,
             max_abs_loss=(
-                config.recovery.max_loss if config.recovery.enabled else None
+                config.recovery.rescue_bound() if config.recovery.enabled else None
             ),
         )
         self.axis_name = axis_name

@@ -17,9 +17,12 @@ before ``sendall``, and the codec decoders round-tripped through
 - :class:`BufferRing` — a preallocated, size-classed pool of receive
   buffers.  Fetchers lease a buffer per frame, decode views directly out
   of it, and either *release* it back to the ring (payload fully
-  consumed, e.g. int8 dequantize materialized a fresh f32 array) or
+  consumed, e.g. int8 dequantize materialized a fresh f32 array),
   *detach* it (decoded views escape to the caller; ownership transfers
-  to the views and the refcount keeps the buffer alive).
+  to the views and the refcount keeps the buffer alive), or *recycle*
+  it onto one owning escaping object (detach semantics now, automatic
+  return to the pool when the owner dies — the dense-frame path, where
+  a plain detach would pin the ring's hit rate at zero).
 - :func:`sendall_segments` — scatter-gather egress.  ``socket.sendmsg``
   over ``[header, payload, digest, obs]`` so headers are never
   concatenated onto multi-MB payloads, with partial-send completion and
@@ -43,6 +46,7 @@ import errno
 import socket
 import threading
 import time
+import weakref
 from typing import List, Optional, Sequence, Union
 
 Buffer = Union[bytearray, memoryview]
@@ -141,6 +145,35 @@ class Lease:
         self._done = True
         self._ring._forget(self._buf)
 
+    def recycle(self, owner: object) -> None:
+        """Transfer ownership to ``owner`` AND return the buffer to the
+        ring once ``owner`` is garbage-collected (``weakref.finalize``).
+
+        The pooled alternative to :meth:`detach` for the dense frame
+        path, where every escaping view hangs off one ndarray's
+        ``.base`` chain: a plain detach means every small gossip frame
+        costs a fresh allocation (the ring's hit rate pins at zero —
+        the small-class waste the copy leg's KiB cells expose), while
+        recycle makes the next lease of that class a pool hit.
+
+        ONLY safe when ``owner`` transitively owns every escaping view
+        of the buffer (an ``np.frombuffer`` result does: derived slices
+        keep it alive through ``.base``).  Payload objects whose member
+        views can be extracted and outlive them (top-k / shard frames)
+        must keep using :meth:`detach` — pooling while a stray view
+        aliases the bytes would hand the next frame the same storage
+        and corrupt a decoded vector in place.  Idempotent."""
+        if self._done:
+            return
+        self._done = True
+        # The lease view is NOT released here: the owner's views export
+        # it (frombuffer holds a buffer export; releasing would raise
+        # BufferError).  It dies with the owner.
+        ring, buf = self._ring, self._buf
+        # The buffer stays accounted as leased until the owner dies;
+        # _recycle then both decrements and (capacity permitting) pools.
+        weakref.finalize(owner, ring._recycle, buf)
+
 
 class BufferRing:
     """Size-classed pool of receive buffers (powers of two ≥ 4 KiB).
@@ -165,6 +198,7 @@ class BufferRing:
         self._leased_bytes = 0
         self._hits = 0
         self._misses = 0
+        self._recycled = 0
 
     def _class_for(self, n: int) -> int:
         size = self._min_class
@@ -203,6 +237,13 @@ class BufferRing:
         with self._lock:
             self._leased_bytes -= len(buf) - LEASE_ALIGN
 
+    def _recycle(self, buf: bytearray) -> None:
+        """Finalizer target for :meth:`Lease.recycle`: the recycled
+        lease's owner died, so the buffer comes home to the pool."""
+        with self._lock:
+            self._recycled += 1
+        self._put(buf)
+
     def stats(self) -> dict:
         with self._lock:
             pooled = sum(
@@ -218,6 +259,7 @@ class BufferRing:
                 "occupancy": (leased / total) if total else 0.0,
                 "hits": self._hits,
                 "misses": self._misses,
+                "recycled": self._recycled,
             }
 
 

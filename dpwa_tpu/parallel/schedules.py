@@ -292,6 +292,24 @@ def async_drain_draw(seed, step, peer) -> float:
     )
 
 
+def data_shuffle_draw(seed, epoch, me, n_samples: int) -> np.ndarray:
+    """Node ``me``'s data-shard permutation for one training epoch
+    (tag 36 — the training-harness data-order stream).
+
+    Pure function of ``(seed, epoch, me)``: the harness's per-node batch
+    sequence is fully determined by the config seed, so a seeded rerun
+    replays byte-identical loss curves, and a crashed node restarting
+    from a checkpoint's ``(epoch, cursor)`` resumes the EXACT stream it
+    left — no RNG state rides the checkpoint.  A stream independent of
+    every control draw: data order must not correlate with partner
+    choice or fault injection."""
+    return np.asarray(
+        jax.random.permutation(
+            _pair_key(seed, epoch, me, _tags.TAG_DATA_SHUFFLE), n_samples
+        )
+    )
+
+
 @functools.partial(jax.jit, static_argnums=(3,))
 def _view_perm(seed, clock, me, n_candidates: int):
     # Jitted: this is the one control draw on the per-frame publish path

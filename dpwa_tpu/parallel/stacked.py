@@ -134,7 +134,7 @@ class StackedTransport:
         self.interp = make_interpolation(
             config.interpolation,
             max_abs_loss=(
-                config.recovery.max_loss if config.recovery.enabled else None
+                config.recovery.rescue_bound() if config.recovery.enabled else None
             ),
         )
         schedule, interp = self.schedule, self.interp

@@ -1029,14 +1029,23 @@ def fetch_blob_full(
                 digest = obs = None
             # Buffer ownership handoff (docs/transport.md): the caller
             # takes the lease explicitly (lease_box), or the views keep
-            # the detached buffer alive, or — payload fully consumed —
-            # the buffer goes straight back to the ring.
+            # the escaped buffer alive, or — payload fully consumed —
+            # the buffer goes straight back to the ring.  Dense frames
+            # escape as ONE ndarray whose .base chain owns every derived
+            # view, so their lease is *recycled* (pooled again when the
+            # vector dies) instead of detached — otherwise every frame
+            # in the small-frame regime (LoRA adapters) costs a fresh
+            # allocation and the ring's hit rate pins at zero.  Top-k /
+            # shard payload objects stay detached: their member views
+            # can be extracted and outlive the payload wrapper.
             if lease_box is not None:
                 lease_box.append(lease)
-            elif escapes:
+            elif not escapes:
+                lease.release()
+            elif code in (_TOPK_DELTA, _SHARD):
                 lease.detach()
             else:
-                lease.release()
+                lease.recycle(vec)
             lease = None
             _ingest.note_rx_frame(copies)
             return (
@@ -1584,7 +1593,7 @@ class TcpTransport:
         self.interp = make_interpolation(
             config.interpolation,
             max_abs_loss=(
-                config.recovery.max_loss if config.recovery.enabled else None
+                config.recovery.rescue_bound() if config.recovery.enabled else None
             ),
             trust_scale=(
                 self._trust_alpha_scale if self.trust is not None else None

@@ -1,0 +1,38 @@
+"""Chaos-certified end-to-end training harness (docs/training.md).
+
+Everything before this package proved the planes in isolation — health,
+recovery, trust, flowctl, obs — against synthetic vectors.  This package
+drives the REAL stack (``DpwaTcpAdapter`` over ``TcpTransport``, both Rx
+servers, async rounds on or off) through real optimizer steps on the CPU
+backend, and certifies robustness in the only currency that matters for
+a training system: **time-to-quality on a loss curve**.
+
+- :mod:`dpwa_tpu.run.task` — the model/dataset zoo (an MNIST-class
+  ConvNet, a fast blobs head for tests, a LoRA-style adapter-only task);
+- :mod:`dpwa_tpu.run.harness` — per-node train loop + lock-step
+  in-process driver, with checkpointing and frozen-schema ``run`` /
+  ``loss`` JSONL emission;
+- :mod:`dpwa_tpu.run.legs` — the four acceptance legs (clean /
+  byzantine / crash / straggler) plus the LoRA small-frame leg;
+- :mod:`dpwa_tpu.run.worker` — the subprocess entry the crash leg's
+  supervisor restarts.
+"""
+
+from dpwa_tpu.run.harness import (  # noqa: F401
+    RunState,
+    TrainNode,
+    VirtualClock,
+    batch_for_step,
+    restore_node_checkpoint,
+    run_single,
+    run_training,
+)
+from dpwa_tpu.run.legs import (  # noqa: F401
+    LegResult,
+    byzantine_leg,
+    clean_leg,
+    crash_leg,
+    lora_leg,
+    straggler_leg,
+)
+from dpwa_tpu.run.task import TrainTask, make_task, make_train_step  # noqa: F401

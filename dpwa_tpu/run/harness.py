@@ -1,0 +1,507 @@
+"""Per-node training loop + in-process lock-step driver (docs/training.md).
+
+One :class:`TrainNode` is a real gossip worker: a jitted SGD step on its
+own data shard, then one ``DpwaTcpAdapter.update`` — guard, rollback,
+exchange over the real TCP wire (hier × shard × topk composed, async on
+or off), trust screening, obs — per optimizer step.  The node emits the
+frozen-schema ``run`` / ``loss`` JSONL records (tools/schema_check.py)
+that ``tools/run_report.py`` joins with the obs/incident planes.
+
+Determinism is structural, not best-effort:
+
+- **data order** is a threefry draw
+  (:func:`~dpwa_tpu.parallel.schedules.data_shuffle_draw`) keyed on
+  ``(seed, epoch, node)`` — a pure function of the step, with no RNG
+  stream to checkpoint and nothing for a crash to lose;
+- **time stamps** on harness records come from a :class:`VirtualClock`
+  (one tick per round), so a seeded rerun's loss JSONL is
+  **byte-identical**, not merely statistically equal;
+- **replica trajectory** is pinned by the transport's own seeded
+  draws (schedules, chaos, trust) under the lock-step round loop.
+
+Checkpointing (``run.checkpoint_every``) writes a :class:`RunState`
+through :func:`dpwa_tpu.checkpoint.save_checkpoint`; a restarted worker
+restores the newest structurally-valid one
+(:func:`~dpwa_tpu.checkpoint.restore_latest_valid`) and THEN refines via
+the PR 2 peer STATE transfer — disk gives a warm local start, the wire
+gives the cohort's current consensus."""
+
+from __future__ import annotations
+
+import os
+import shutil
+import time
+from typing import Any, Callable, NamedTuple, Optional, Tuple
+
+import numpy as np
+
+from dpwa_tpu.config import DpwaConfig
+from dpwa_tpu.metrics import MetricsLogger
+from dpwa_tpu.run.task import TrainTask, make_train_step
+
+PyTree = Any
+
+# Loss-curve smoothing for time-to-quality verdicts: per-step minibatch
+# loss is noisy at batch 32; the EWMA is what crosses ``target_loss``.
+EWMA_BETA = 0.2
+
+
+class VirtualClock:
+    """Deterministic time source for harness records: one tick per
+    round.  Not wall time — exists so seeded reruns stamp identical
+    ``t`` fields and the loss JSONL diffs byte-for-byte."""
+
+    def __init__(self, dt: float = 1.0):
+        self.t = 0.0
+        self.dt = float(dt)
+
+    def now(self) -> float:
+        return self.t
+
+    def tick(self) -> None:
+        self.t += self.dt
+
+
+class RunState(NamedTuple):
+    """Checkpointed per-node training state (Orbax, via
+    dpwa_tpu/checkpoint.py).  ``step`` doubles as the data-order cursor:
+    the threefry shuffle makes the batch sequence a pure function of it,
+    so no data-stream sidecar is needed."""
+
+    params: PyTree
+    opt_state: PyTree
+    step: Any
+    clock: Any
+    loss: Any
+
+
+def epoch_perm(seed: int, epoch: int, me: int, n: int) -> np.ndarray:
+    """This node's shard permutation for ``epoch`` (threefry; pure)."""
+    from dpwa_tpu.parallel.schedules import data_shuffle_draw
+
+    return data_shuffle_draw(seed, epoch, me, n)
+
+
+def batch_for_step(
+    n_shard: int, batch_size: int, step: int
+) -> Tuple[int, int, int]:
+    """Map a global step to ``(epoch, lo, hi)`` positions within the
+    epoch permutation.  Pure arithmetic — a rejoiner at step k replays
+    node k's exact data order from its step alone."""
+    per_epoch = max(1, n_shard // batch_size)
+    epoch, pos = divmod(int(step), per_epoch)
+    lo = pos * batch_size
+    return epoch, lo, min(lo + batch_size, n_shard)
+
+
+def _checkpoint_candidates(ckpt_dir: str) -> list:
+    """Oldest→newest checkpoint paths under ``ckpt_dir``."""
+    if not os.path.isdir(ckpt_dir):
+        return []
+    names = sorted(
+        n for n in os.listdir(ckpt_dir)
+        if n.startswith("ckpt-") and not n.endswith(".json")
+    )
+    return [os.path.join(ckpt_dir, n) for n in names]
+
+
+def _state_like(params: PyTree, opt_state: PyTree) -> RunState:
+    return RunState(
+        params=params,
+        opt_state=opt_state,
+        step=np.asarray(0),
+        clock=np.asarray(0.0),
+        loss=np.asarray(0.0),
+    )
+
+
+def save_node_checkpoint(
+    ckpt_dir: str,
+    params: PyTree,
+    opt_state: PyTree,
+    step: int,
+    clock: float,
+    loss: float,
+    keep: int = 3,
+) -> str:
+    """Write ``ckpt_dir/ckpt-<step>`` and prune to the newest ``keep``."""
+    from dpwa_tpu.checkpoint import save_checkpoint
+
+    os.makedirs(ckpt_dir, exist_ok=True)
+    path = os.path.join(ckpt_dir, f"ckpt-{int(step):08d}")
+    save_checkpoint(
+        path,
+        RunState(
+            params=params,
+            opt_state=opt_state,
+            step=np.asarray(int(step)),
+            clock=np.asarray(float(clock)),
+            loss=np.asarray(float(loss)),
+        ),
+    )
+    stale = _checkpoint_candidates(ckpt_dir)[: -max(1, int(keep))]
+    for old in stale:
+        shutil.rmtree(old, ignore_errors=True)
+        for sidecar in (old + "-meta.json", old + "-data.json"):
+            if os.path.exists(sidecar):
+                os.remove(sidecar)
+    return path
+
+
+def restore_node_checkpoint(
+    ckpt_dir: str, params: PyTree, opt_state: PyTree
+):
+    """Restore the newest valid checkpoint, or ``None`` when nothing
+    survives (cold start / pure peer bootstrap).  Corrupt newest
+    checkpoints fall back to older ones — the satellite acceptance."""
+    from dpwa_tpu.checkpoint import restore_latest_valid
+
+    paths = _checkpoint_candidates(ckpt_dir)
+    if not paths:
+        return None
+    try:
+        return restore_latest_valid(
+            paths, like=_state_like(params, opt_state)
+        )
+    except FileNotFoundError:
+        return None
+
+
+def _outcome_str(outcome: Any) -> Optional[str]:
+    if outcome is None:
+        return None
+    value = getattr(outcome, "value", outcome)
+    return str(value)
+
+
+class TrainNode:
+    """One training node over the real stack (or solo when
+    ``gossip=False`` — the single-process SGD control arm)."""
+
+    def __init__(
+        self,
+        me: int,
+        n_peers: int,
+        config: DpwaConfig,
+        task: TrainTask,
+        workdir: str,
+        leg: str,
+        *,
+        gossip: bool = True,
+        train_step: Optional[Callable] = None,
+        tx: Any = None,
+        bootstrap: Optional[bool] = None,
+        restore: bool = False,
+    ):
+        from dpwa_tpu.data import peer_split
+
+        self.me = int(me)
+        self.n_peers = int(n_peers)
+        self.config = config
+        self.task = task
+        self.leg = leg
+        run = config.run
+        self.run_cfg = run
+        seed = config.protocol.seed
+        self.seed = seed
+        xs, ys = peer_split(task.x_train, task.y_train, n_peers, seed=seed)
+        self.shard_x, self.shard_y = xs[self.me], ys[self.me]
+        if tx is None or train_step is None:
+            tx, train_step = make_train_step(task, run.lr, run.momentum)
+        self.train_step = train_step
+        self.params = task.init(seed)
+        self.opt_state = tx.init(self.params)
+        self.ckpt_dir = (
+            os.path.join(run.checkpoint_dir, f"node{self.me}")
+            if run.checkpoint_dir
+            else None
+        )
+        self.restored_step = 0
+        if restore and self.ckpt_dir:
+            state = restore_node_checkpoint(
+                self.ckpt_dir, self.params, self.opt_state
+            )
+            if state is not None:
+                self.params = state.params
+                self.opt_state = state.opt_state
+                self.restored_step = int(np.asarray(state.step))
+        os.makedirs(workdir, exist_ok=True)
+        self.metrics = MetricsLogger(
+            path=os.path.join(workdir, f"node{self.me}.jsonl")
+        )
+        self.adapter = None
+        if gossip:
+            from dpwa_tpu.adapters.tcp_adapter import DpwaTcpAdapter
+
+            # The adapter gets its OWN events file: bootstrap / rollback /
+            # trust / membership events carry wall-clock stamps, and the
+            # harness's node{me}.jsonl must stay byte-identical across
+            # seeded reruns.
+            self.adapter = DpwaTcpAdapter(
+                self.params,
+                f"node{self.me}",
+                config,
+                metrics=os.path.join(workdir, f"node{self.me}.events.jsonl"),
+                bootstrap=bootstrap,
+                state_extra=lambda: {"leg": self.leg},
+            )
+            self.params = self.adapter.params
+            if self.adapter.last_bootstrap is not None:
+                # Landing on the donor's step: keep the checkpoint's
+                # optimizer state (momentum is node-local) but take the
+                # cohort's replica and schedule position.
+                self._solo_step = int(self.adapter.step)
+            else:
+                # Cold or checkpoint-only start: hand the restored step
+                # to the adapter so the schedule resumes where the
+                # checkpoint left off.
+                self.adapter._step = self.restored_step
+                self.adapter._clock = float(self.restored_step)
+                self._solo_step = self.restored_step
+        else:
+            self._solo_step = self.restored_step
+        self._perm_epoch = -1
+        self._perm: Optional[np.ndarray] = None
+        self.ewma: Optional[float] = None
+        self.best_loss: Optional[float] = None
+        self.final_loss: Optional[float] = None
+        self.steps_to_target: Optional[int] = None
+        self.time_to_target_s: Optional[float] = None
+        self.wall_s = 0.0
+        self.epoch = 0
+
+    # ------------------------------------------------------------------
+
+    @property
+    def step(self) -> int:
+        return self.adapter.step if self.adapter is not None else self._solo_step
+
+    def log_start(self, vt: Optional[VirtualClock] = None) -> None:
+        run = self.run_cfg
+        fields = {
+            "model": self.task.name,
+            "dataset": self.task.dataset,
+            "d": int(self.task.d),
+            "steps": int(run.steps),
+            "batch_size": int(run.batch_size),
+            "lr": float(run.lr),
+            "target_loss": float(run.target_loss),
+            "async_rounds": bool(self.config.protocol.async_rounds.enabled),
+            "rx_server": str(self.config.protocol.rx_server),
+        }
+        if self.restored_step:
+            fields["checkpoint_restored_step"] = self.restored_step
+        self.metrics.log_run(
+            self.step, self.me, self.leg, "start",
+            peers=self.n_peers, seed=self.seed,
+            _t=vt.now() if vt is not None else None, **fields,
+        )
+
+    def log_crashed(self, vt: Optional[VirtualClock] = None) -> None:
+        """Record the PREDECESSOR incarnation's death (a SIGKILL'd
+        process writes nothing; its replacement writes the obituary)."""
+        self.metrics.log_run(
+            self.restored_step, self.me, self.leg, "crashed",
+            peers=self.n_peers, seed=self.seed,
+            _t=vt.now() if vt is not None else None,
+        )
+
+    def _batch(self, step: int) -> Tuple[np.ndarray, np.ndarray]:
+        epoch, lo, hi = batch_for_step(
+            len(self.shard_x), self.run_cfg.batch_size, step
+        )
+        if epoch != self._perm_epoch:
+            self._perm = epoch_perm(
+                self.seed, epoch, self.me, len(self.shard_x)
+            )
+            self._perm_epoch = epoch
+        self.epoch = epoch
+        idx = self._perm[lo:hi]
+        return self.shard_x[idx], self.shard_y[idx]
+
+    def run_step(self, vt: Optional[VirtualClock] = None) -> float:
+        """One optimizer step + one gossip round; returns the loss."""
+        step = self.step
+        x, y = self._batch(step)
+        t0 = time.perf_counter()
+        self.params, self.opt_state, loss = self.train_step(
+            self.params, self.opt_state, x, y
+        )
+        loss_f = float(loss)
+        alpha: Optional[float] = None
+        partner: Optional[int] = None
+        outcome: Optional[str] = None
+        if self.adapter is not None:
+            self.params = self.adapter.update(loss_f, self.params)
+            alpha = float(self.adapter.last_alpha)
+            info = self.adapter.transport.last_round
+            partner = info.get("partner")
+            outcome = _outcome_str(info.get("outcome"))
+        else:
+            self._solo_step = step + 1
+        wall = time.perf_counter() - t0
+        self.wall_s += wall
+        self.ewma = (
+            loss_f
+            if self.ewma is None
+            else (1.0 - EWMA_BETA) * self.ewma + EWMA_BETA * loss_f
+        )
+        if self.best_loss is None or self.ewma < self.best_loss:
+            self.best_loss = self.ewma
+        self.final_loss = self.ewma
+        target = self.run_cfg.target_loss
+        if (
+            target > 0.0
+            and self.steps_to_target is None
+            and self.ewma <= target
+        ):
+            self.steps_to_target = step + 1
+            self.time_to_target_s = self.wall_s
+        if step % self.run_cfg.loss_every == 0:
+            self.metrics.log_loss(
+                step, loss_f, self.me,
+                epoch=self.epoch, alpha=alpha, partner=partner,
+                outcome=outcome,
+                _t=vt.now() if vt is not None else None,
+            )
+        every = self.run_cfg.checkpoint_every
+        if self.ckpt_dir and every and (step + 1) % every == 0:
+            save_node_checkpoint(
+                self.ckpt_dir, self.params, self.opt_state,
+                step + 1, float(step + 1), loss_f,
+                keep=self.run_cfg.checkpoint_keep,
+            )
+        return loss_f
+
+    def log_done(self, vt: Optional[VirtualClock] = None) -> None:
+        fields = {
+            "wall_s": round(self.wall_s, 4),
+            "steps_to_target": self.steps_to_target,
+            "time_to_target_s": (
+                round(self.time_to_target_s, 4)
+                if self.time_to_target_s is not None else None
+            ),
+        }
+        if self.final_loss is not None:
+            fields["final_loss"] = round(self.final_loss, 6)
+        if self.best_loss is not None:
+            fields["best_loss"] = round(self.best_loss, 6)
+        self.metrics.log_run(
+            self.step, self.me, self.leg, "done",
+            peers=self.n_peers, seed=self.seed,
+            _t=vt.now() if vt is not None else None, **fields,
+        )
+
+    def summary(self) -> dict:
+        out = {
+            "me": self.me,
+            "final_loss": self.final_loss,
+            "best_loss": self.best_loss,
+            "steps_to_target": self.steps_to_target,
+            "time_to_target_s": self.time_to_target_s,
+            "wall_s": round(self.wall_s, 4),
+            "restored_step": self.restored_step,
+        }
+        if self.adapter is not None:
+            out["health"] = self.adapter.health_snapshot()
+        return out
+
+    def test_loss(self, limit: int = 512) -> Optional[float]:
+        """Held-out loss on (up to) ``limit`` test samples."""
+        x, y = self.task.x_test[:limit], self.task.y_test[:limit]
+        if len(x) == 0:
+            return None
+        return float(self.task.loss_fn(self.params, x, y))
+
+    def close(self) -> None:
+        if self.adapter is not None:
+            self.adapter.close()
+        self.metrics.close()
+
+
+def run_training(
+    config: DpwaConfig,
+    task: TrainTask,
+    workdir: str,
+    *,
+    leg: str = "clean",
+    virtual_time: bool = True,
+    eval_test: bool = True,
+    round_hook: Optional[Callable[[int, list], None]] = None,
+) -> dict:
+    """Lock-step in-process drive of ``n`` :class:`TrainNode` s.
+
+    Every node takes one SGD step then one gossip exchange per round,
+    in node order — the deterministic round loop the bit-identity
+    acceptance pins.  ``round_hook(step, nodes)`` runs after each round
+    (legs use it to snapshot trust state mid-run)."""
+    n = len(config.nodes)
+    vt = VirtualClock() if virtual_time else None
+    tx, train_step = make_train_step(
+        task, config.run.lr, config.run.momentum
+    )
+    nodes = [
+        TrainNode(
+            i, n, config, task, workdir, leg,
+            train_step=train_step, tx=tx,
+        )
+        for i in range(n)
+    ]
+    try:
+        for node in nodes:
+            node.log_start(vt)
+        for step in range(config.run.steps):
+            for node in nodes:
+                node.run_step(vt)
+            if round_hook is not None:
+                round_hook(step, nodes)
+            if vt is not None:
+                vt.tick()
+        test = nodes[0].test_loss() if eval_test else None
+        for node in nodes:
+            node.log_done(vt)
+        return {
+            "leg": leg,
+            "peers": n,
+            "seed": config.protocol.seed,
+            "steps": config.run.steps,
+            "workdir": os.path.abspath(workdir),
+            "observer_test_loss": test,
+            "nodes": [node.summary() for node in nodes],
+        }
+    finally:
+        for node in nodes:
+            node.close()
+
+
+def run_single(
+    config: DpwaConfig,
+    task: TrainTask,
+    workdir: str,
+    *,
+    leg: str = "single",
+    virtual_time: bool = True,
+) -> dict:
+    """The control arm: single-process SGD, no transport, equal total
+    optimizer steps — what the clean leg's time-to-loss is judged
+    against."""
+    vt = VirtualClock() if virtual_time else None
+    node = TrainNode(0, 1, config, task, workdir, leg, gossip=False)
+    try:
+        node.log_start(vt)
+        for _ in range(config.run.steps):
+            node.run_step(vt)
+            if vt is not None:
+                vt.tick()
+        node.log_done(vt)
+        return {
+            "leg": leg,
+            "peers": 1,
+            "seed": config.protocol.seed,
+            "steps": config.run.steps,
+            "workdir": os.path.abspath(workdir),
+            "observer_test_loss": node.test_loss(),
+            "nodes": [node.summary()],
+        }
+    finally:
+        node.close()

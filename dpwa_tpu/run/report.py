@@ -1,0 +1,320 @@
+"""Join a training run's loss curves with the obs/incident planes.
+
+The chaos-certified question is never "did an incident fire" alone —
+it is *did the incident plane bracket the actual loss damage, and which
+plane saw it first?*  This module answers it from a harness workdir's
+artifacts, all frozen-schema JSONL (tools/schema_check.py):
+
+- ``node<i>.jsonl`` — ``run`` envelopes + per-step ``loss`` records
+  (with merge metadata: alpha / partner / outcome columns);
+- ``node<i>.events.jsonl`` — the adapter's event stream (bootstrap,
+  rollback, trust/membership events) + periodic ``health`` snapshots;
+- ``incidents-<i>.jsonl`` — the obs plane's alert/incident stream.
+
+``tools/run_report.py`` is the CLI shim over :func:`build_report` /
+:func:`render_report` (the lint_emitters.py pattern: logic lives in the
+package, the tool stays a runnable veneer)."""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Dict, List, Optional
+
+from dpwa_tpu.run.harness import EWMA_BETA
+
+_NODE_RE = re.compile(r"node(\d+)\.jsonl$")
+_INCIDENT_RE = re.compile(r"incidents-(\d+)\.jsonl")
+
+# A dent is an EWMA excursion at least this far above the running
+# minimum (relative); the window closes when the curve comes back
+# within half the excursion threshold.
+DENT_REL = 0.25
+
+
+def load_jsonl(path: str) -> List[dict]:
+    """Parse one JSONL file, skipping unparseable lines (a crashed
+    writer's final partial line must not sink the report)."""
+    rows: List[dict] = []
+    if not os.path.exists(path):
+        return rows
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                row = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(row, dict):
+                rows.append(row)
+    return rows
+
+
+def load_run_dir(workdir: str) -> dict:
+    """All planes of one harness workdir, keyed by node index."""
+    nodes: Dict[int, dict] = {}
+    incidents: Dict[int, List[dict]] = {}
+    for name in sorted(os.listdir(workdir)):
+        path = os.path.join(workdir, name)
+        m = _NODE_RE.match(name)
+        if m is not None:
+            rows = load_jsonl(path)
+            nodes[int(m.group(1))] = {
+                "loss": [r for r in rows if r.get("record") == "loss"],
+                "runs": [r for r in rows if r.get("record") == "run"],
+            }
+            continue
+        if name.endswith(".events.jsonl"):
+            idx = int(re.search(r"node(\d+)", name).group(1))
+            nodes.setdefault(idx, {}).setdefault(
+                "events", load_jsonl(path)
+            )
+            continue
+        m = _INCIDENT_RE.match(name)
+        if m is not None:
+            incidents[int(m.group(1))] = load_jsonl(path)
+    for idx in sorted(nodes):
+        nodes[idx].setdefault("loss", [])
+        nodes[idx].setdefault("runs", [])
+        nodes[idx].setdefault("events", [])
+    return {"workdir": os.path.abspath(workdir), "nodes": nodes,
+            "incidents": incidents}
+
+
+def ewma_series(
+    loss_rows: List[dict], beta: float = EWMA_BETA
+) -> List[tuple]:
+    """``[(step, ewma), ...]`` over a node's loss records (step order)."""
+    out: List[tuple] = []
+    ewma: Optional[float] = None
+    for row in sorted(loss_rows, key=lambda r: int(r.get("step", 0))):
+        loss = row.get("loss")
+        if not isinstance(loss, (int, float)):
+            continue
+        ewma = (
+            float(loss)
+            if ewma is None
+            else (1.0 - beta) * ewma + beta * float(loss)
+        )
+        out.append((int(row["step"]), ewma))
+    return out
+
+
+def dent_window(
+    series: List[tuple], rel: float = DENT_REL
+) -> Optional[dict]:
+    """The loss dent: the first window where the EWMA rises ``rel``
+    above its running minimum, until it comes back within ``rel/2``.
+    ``None`` when the curve never dents (a clean run)."""
+    running_min: Optional[float] = None
+    start: Optional[int] = None
+    base: Optional[float] = None
+    peak = 0.0
+    peak_step: Optional[int] = None
+    end: Optional[int] = None
+    for step, val in series:
+        if running_min is None or val < running_min:
+            if start is None:
+                running_min = val
+        if start is None:
+            if val > running_min * (1.0 + rel) + 1e-12:
+                start, base = step, running_min
+                peak, peak_step = val, step
+        else:
+            if val > peak:
+                peak, peak_step = val, step
+            if val <= base * (1.0 + rel / 2.0) + 1e-12:
+                end = step
+                break
+    if start is None:
+        return None
+    last_step = series[-1][0] if series else start
+    return {
+        "start": start,
+        "end": end if end is not None else last_step,
+        "recovered": end is not None,
+        "baseline": round(base, 6),
+        "peak": round(peak, 6),
+        "peak_step": peak_step,
+        "excursion": round(peak / base, 4) if base else None,
+    }
+
+
+def incident_clusters(records: List[dict]) -> List[dict]:
+    """Fold one node's incident stream into per-incident clusters
+    (open → updates → resolved), keyed by the incident ``id``."""
+    clusters: Dict[str, dict] = {}
+    order: List[str] = []
+    for rec in records:
+        if rec.get("record") != "incident":
+            continue
+        cid = rec.get("id")
+        if cid not in clusters:
+            clusters[cid] = {
+                "id": cid,
+                "kind": rec.get("kind"),
+                "severity": rec.get("severity"),
+                "opened_step": rec.get("opened_step", rec.get("step")),
+                "resolved_step": None,
+                "alerts": 0,
+                "peers": [],
+            }
+            order.append(cid)
+        c = clusters[cid]
+        c["kind"] = rec.get("kind", c["kind"])
+        c["severity"] = rec.get("severity", c["severity"])
+        c["alerts"] = max(c["alerts"], int(rec.get("alerts", 0)))
+        for p in rec.get("peers", ()):
+            if p not in c["peers"]:
+                c["peers"].append(p)
+        if rec.get("status") == "resolved":
+            c["resolved_step"] = rec.get(
+                "resolved_step", rec.get("step")
+            )
+    return [clusters[cid] for cid in order]
+
+
+def cluster_brackets(cluster: dict, dent: dict, slack: int = 8) -> bool:
+    """Does the incident cluster bracket the loss dent?  Open no later
+    than ``slack`` steps after the dent starts, resolved (or still open)
+    no earlier than the dent's recovery."""
+    opened = cluster.get("opened_step")
+    if opened is None or opened > dent["start"] + slack:
+        return False
+    resolved = cluster.get("resolved_step")
+    if resolved is None:
+        return True  # still open at end of run: covers the dent's tail
+    return resolved + slack >= dent["end"]
+
+
+def first_signal(
+    node: dict, incidents: List[dict]
+) -> Optional[dict]:
+    """The earliest fault signal any plane raised on this node, and
+    which plane raised it — trust (an ``untrusted`` merge column),
+    health (a non-success outcome), or the obs incident plane."""
+    candidates: List[dict] = []
+    for row in node.get("loss", []):
+        out = row.get("outcome")
+        if out == "untrusted":
+            candidates.append(
+                {"plane": "trust", "step": int(row["step"]),
+                 "detail": "untrusted merge"}
+            )
+            break
+    for row in node.get("loss", []):
+        out = row.get("outcome")
+        if out is not None and out not in ("success", "untrusted"):
+            candidates.append(
+                {"plane": "health", "step": int(row["step"]),
+                 "detail": f"outcome {out}"}
+            )
+            break
+    for rec in incidents:
+        if rec.get("record") == "incident" and rec.get("status") == "open":
+            candidates.append(
+                {"plane": "incidents", "step": int(rec["step"]),
+                 "detail": f"incident {rec.get('kind')}"}
+            )
+            break
+    if not candidates:
+        return None
+    return min(candidates, key=lambda c: c["step"])
+
+
+def build_report(workdir: str, observer: int = 0) -> dict:
+    """The full loss/incident join for one harness workdir."""
+    data = load_run_dir(workdir)
+    nodes_out = {}
+    for idx in sorted(data["nodes"]):
+        node = data["nodes"][idx]
+        series = ewma_series(node["loss"])
+        done = [r for r in node["runs"] if r.get("status") == "done"]
+        crashed = [r for r in node["runs"] if r.get("status") == "crashed"]
+        starts = [r for r in node["runs"] if r.get("status") == "start"]
+        inc = data["incidents"].get(idx, [])
+        dent = dent_window(series)
+        clusters = incident_clusters(inc)
+        nodes_out[idx] = {
+            "steps_logged": len(node["loss"]),
+            "final_ewma": round(series[-1][1], 6) if series else None,
+            "done": done[-1] if done else None,
+            "crashes": len(crashed),
+            "restarts": max(0, len(starts) - 1),
+            "restored_step": max(
+                (r.get("checkpoint_restored_step", 0) for r in starts),
+                default=0,
+            ),
+            "dent": dent,
+            "incident_clusters": clusters,
+            "bracketed": (
+                [cluster_brackets(c, dent) for c in clusters]
+                if dent is not None
+                else []
+            ),
+            "first_signal": first_signal(node, inc),
+        }
+    return {
+        "workdir": data["workdir"],
+        "observer": observer,
+        "nodes": nodes_out,
+    }
+
+
+def render_report(report: dict) -> str:
+    """Human-readable summary of :func:`build_report` output."""
+    lines = [f"run report: {report['workdir']}"]
+    for idx in sorted(report["nodes"]):
+        node = report["nodes"][idx]
+        done = node["done"] or {}
+        lines.append(
+            f"  node{idx}: {node['steps_logged']} loss records, "
+            f"final ewma {node['final_ewma']}, "
+            f"crashes {node['crashes']}, restarts {node['restarts']}"
+            + (
+                f" (restored step {node['restored_step']})"
+                if node["restored_step"]
+                else ""
+            )
+        )
+        if done:
+            lines.append(
+                f"    done: steps_to_target {done.get('steps_to_target')}, "
+                f"final_loss {done.get('final_loss')}, "
+                f"wall {done.get('wall_s')}s"
+            )
+        dent = node["dent"]
+        if dent is not None:
+            lines.append(
+                f"    loss dent: steps [{dent['start']}, {dent['end']}] "
+                f"peak {dent['peak']} ({dent['excursion']}x baseline, "
+                f"{'recovered' if dent['recovered'] else 'NOT recovered'})"
+            )
+        for c, br in zip(
+            node["incident_clusters"],
+            node["bracketed"] or [None] * len(node["incident_clusters"]),
+        ):
+            span = (
+                f"[{c['opened_step']}, {c['resolved_step']}]"
+                if c["resolved_step"] is not None
+                else f"[{c['opened_step']}, open)"
+            )
+            lines.append(
+                f"    incident {c['kind']} ({c['severity']}) {span} "
+                f"peers {c['peers']} alerts {c['alerts']}"
+                + (
+                    f" — {'brackets' if br else 'MISSES'} the dent"
+                    if br is not None
+                    else ""
+                )
+            )
+        sig = node["first_signal"]
+        if sig is not None:
+            lines.append(
+                f"    first signal: {sig['plane']} at step {sig['step']} "
+                f"({sig['detail']})"
+            )
+    return "\n".join(lines)

@@ -1,0 +1,233 @@
+"""Training tasks for the chaos-certified harness (docs/training.md).
+
+A :class:`TrainTask` bundles what one gossip node needs to train for
+real: a dataset (offline — this box has zero network egress), a pure
+``loss_fn(params, x, y)``, and a seeded ``init``.  Three registered
+tasks cover the BASELINE.json regimes the harness certifies on CPU:
+
+- ``digits`` — the MNIST-class image task: :class:`SmallNet` on
+  sklearn's bundled 8×8 digits (the offline stand-in the repo's test
+  suite already trains).
+- ``blobs`` — a logistic-regression head on Gaussian blobs; converges
+  in tens of steps, so the tier-1 legs stay fast.
+- ``lora`` — the LoRA-style adapter-only exchange: a FROZEN random
+  feature backbone (never gossiped, the 25M-param stand-in) with a
+  trainable low-rank head ``A @ B`` of ~100K params.  Only the adapter
+  pytree rides the wire, so every frame is ~400 KB — the small-frame
+  regime the zero-copy ring's sub-megabyte classes serve.
+
+Init is a function of the SEED only, so every peer cold-starts on the
+same replica (pairwise averaging assumes one consensus trajectory, not
+an ensemble).  Per-peer data order comes from the harness's threefry
+draw (:func:`dpwa_tpu.parallel.schedules.data_shuffle_draw`), never from
+the task.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Tuple
+
+import numpy as np
+
+PyTree = Any
+Array = np.ndarray
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainTask:
+    """One trainable workload: dataset + pure loss + seeded init.
+
+    ``d`` is the number of EXCHANGED floats (the gossip frame size in
+    f32 elements) — for adapter-only tasks this is far below the full
+    model's parameter count."""
+
+    name: str
+    dataset: str
+    x_train: Array
+    y_train: Array
+    x_test: Array
+    y_test: Array
+    init: Callable[[int], PyTree]
+    loss_fn: Callable[[PyTree, Array, Array], Any]
+    d: int
+
+
+def _cross_entropy(logits, y):
+    import jax.numpy as jnp
+
+    logp = logits - jnp.max(logits, axis=-1, keepdims=True)
+    logp = logp - jnp.log(jnp.sum(jnp.exp(logp), axis=-1, keepdims=True))
+    return -jnp.mean(jnp.take_along_axis(logp, y[:, None], axis=-1))
+
+
+def _param_count(params: PyTree) -> int:
+    from dpwa_tpu.utils.pytree import ravel
+
+    return int(np.asarray(ravel(params)[0]).size)
+
+
+def digits_task(seed: int = 0) -> TrainTask:
+    """MNIST-class image classification: SmallNet on 8×8 digits."""
+    import jax
+
+    from dpwa_tpu.data import load_digits_dataset
+    from dpwa_tpu.models.mnist import SmallNet
+
+    x_tr, y_tr, x_te, y_te = load_digits_dataset(seed=seed)
+    model = SmallNet()
+
+    def init(s: int) -> PyTree:
+        return model.init(jax.random.key(s), x_tr[:1])["params"]
+
+    def loss_fn(params, x, y):
+        return _cross_entropy(model.apply({"params": params}, x), y)
+
+    return TrainTask(
+        name="digits",
+        dataset="digits",
+        x_train=x_tr,
+        y_train=y_tr,
+        x_test=x_te,
+        y_test=y_te,
+        init=init,
+        loss_fn=loss_fn,
+        d=_param_count(init(seed)),
+    )
+
+
+def blobs_task(
+    seed: int = 0, n_classes: int = 4, dim: int = 16, n_per_class: int = 256
+) -> TrainTask:
+    """Fast logistic-regression task for tier-1 legs (converges in tens
+    of steps on CPU; d = dim*classes + classes)."""
+    import jax
+    import jax.numpy as jnp
+
+    from dpwa_tpu.data import gaussian_blobs
+
+    x, y = gaussian_blobs(
+        n_classes=n_classes, dim=dim, n_per_class=n_per_class, seed=seed
+    )
+    n_test = len(x) // 5
+    x_tr, y_tr, x_te, y_te = x[n_test:], y[n_test:], x[:n_test], y[:n_test]
+
+    def init(s: int) -> PyTree:
+        k = jax.random.key(s)
+        return {
+            "w": 0.01 * jax.random.normal(k, (dim, n_classes), jnp.float32),
+            "b": jnp.zeros((n_classes,), jnp.float32),
+        }
+
+    def loss_fn(params, xb, yb):
+        return _cross_entropy(xb @ params["w"] + params["b"], yb)
+
+    return TrainTask(
+        name="blobs",
+        dataset="blobs",
+        x_train=x_tr,
+        y_train=y_tr,
+        x_test=x_te,
+        y_test=y_te,
+        init=init,
+        loss_fn=loss_fn,
+        d=dim * n_classes + n_classes,
+    )
+
+
+# LoRA-leg geometry: a frozen feature lift to ``hidden`` dims stands in
+# for the full backbone, and the trainable low-rank head A[h,r] @ B[r,c]
+# (+ bias) is the ONLY pytree the adapter gossips — d = h*r + r*c + c.
+# rank 190 at 512×16 lands on 100,336 exchanged floats ≈ 392 KiB/frame,
+# the d≈100K small-frame regime of the Llama-LoRA BASELINE config.
+LORA_HIDDEN = 512
+LORA_RANK = 190
+LORA_CLASSES = 16
+LORA_INPUT_DIM = 64
+
+
+def lora_task(seed: int = 0, n_per_class: int = 128) -> TrainTask:
+    """Adapter-only exchange: frozen random-feature backbone + trainable
+    low-rank head.  Only the head (~100K floats) is gossiped."""
+    import jax
+    import jax.numpy as jnp
+
+    from dpwa_tpu.data import gaussian_blobs
+
+    x, y = gaussian_blobs(
+        n_classes=LORA_CLASSES,
+        dim=LORA_INPUT_DIM,
+        n_per_class=n_per_class,
+        seed=seed,
+    )
+    n_test = len(x) // 5
+    x_tr, y_tr, x_te, y_te = x[n_test:], y[n_test:], x[:n_test], y[:n_test]
+    # The backbone is a function of the seed alone: every peer (and a
+    # crash-restarted rejoiner) reconstructs the identical frozen lift,
+    # so it never has to ride a frame or a checkpoint.
+    backbone = jax.random.normal(
+        jax.random.key(seed + 1), (LORA_INPUT_DIM, LORA_HIDDEN), jnp.float32
+    ) / np.sqrt(LORA_INPUT_DIM)
+
+    def init(s: int) -> PyTree:
+        k = jax.random.key(s)
+        ka, _ = jax.random.split(k)
+        return {
+            "a": 0.01 * jax.random.normal(
+                ka, (LORA_HIDDEN, LORA_RANK), jnp.float32
+            ),
+            # B starts at zero (the standard LoRA init): the head's
+            # initial output is exactly zero, so all early signal flows
+            # through the gradient, not a random projection.
+            "b": jnp.zeros((LORA_RANK, LORA_CLASSES), jnp.float32),
+            "bias": jnp.zeros((LORA_CLASSES,), jnp.float32),
+        }
+
+    def loss_fn(params, xb, yb):
+        feats = jnp.tanh(xb @ backbone)
+        logits = feats @ (params["a"] @ params["b"]) + params["bias"]
+        return _cross_entropy(logits, yb)
+
+    return TrainTask(
+        name="lora",
+        dataset="blobs16",
+        x_train=x_tr,
+        y_train=y_tr,
+        x_test=x_te,
+        y_test=y_te,
+        init=init,
+        loss_fn=loss_fn,
+        d=LORA_HIDDEN * LORA_RANK + LORA_RANK * LORA_CLASSES + LORA_CLASSES,
+    )
+
+
+_TASKS = {
+    "digits": digits_task,
+    "blobs": blobs_task,
+    "lora": lora_task,
+}
+
+
+def make_task(name: str, seed: int = 0) -> TrainTask:
+    """Build a registered task (``digits`` / ``blobs`` / ``lora``)."""
+    if name not in _TASKS:
+        raise ValueError(
+            f"unknown task {name!r}; registered: {sorted(_TASKS)}"
+        )
+    return _TASKS[name](seed=seed)
+
+
+def make_train_step(
+    task: TrainTask, lr: float, momentum: float = 0.0
+) -> Tuple[Any, Callable]:
+    """A jitted SGD step for ``task``: returns ``(optimizer, step_fn)``
+    where ``step_fn(params, opt_state, x, y) -> (params, opt_state,
+    loss)``.  One compilation serves every node — all replicas share
+    shapes.  The step itself is :func:`dpwa_tpu.train.make_host_train_
+    step` — the same definition the examples' ``--certify`` arms use."""
+    import optax
+
+    from dpwa_tpu.train import make_host_train_step
+
+    tx = optax.sgd(lr, momentum=momentum if momentum > 0.0 else None)
+    return tx, make_host_train_step(task.loss_fn, tx)

@@ -1,0 +1,111 @@
+"""Subprocess training worker — the crash leg's unit of failure.
+
+One OS process per gossip node, free-running (no lock-step barrier —
+the deployment shape of the reference).  The crash leg's driver spawns
+``n`` of these under ``tools/supervisor.py``; the victim SIGKILLs
+itself at a scripted step (an abrupt death: no flush, no goodbye), the
+supervisor restarts it with ``DPWA_BOOTSTRAP=1``, and the replacement
+
+1. restores the newest structurally-valid local checkpoint
+   (:func:`dpwa_tpu.run.harness.restore_node_checkpoint` — warm params
+   and optimizer state, ``run.checkpoint_every`` cadence),
+2. refines via the PR 2 STATE transfer (the ``DpwaTcpAdapter``
+   constructor's bootstrap path — the cohort's CURRENT replica and
+   schedule step), and
+3. writes the predecessor's ``status: "crashed"`` run record before its
+   own ``"start"`` (a SIGKILL'd process writes no obituary; its
+   replacement does).
+
+Run spec is a JSON file (written by :func:`dpwa_tpu.run.legs.crash_leg`)
+so the whole config — run block, recovery cadence, chaos, protocol
+knobs — crosses the process boundary without a YAML round-trip::
+
+    python -m dpwa_tpu.run.worker --spec run.json --index 2
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import time
+from typing import Optional, Sequence
+
+
+def build_config(spec: dict):
+    """``make_local_config`` from a worker spec dict."""
+    from dpwa_tpu.config import make_local_config
+
+    return make_local_config(
+        int(spec["n"]),
+        schedule=spec.get("schedule", "ring"),
+        interpolation=spec.get("interpolation", "constant"),
+        factor=float(spec.get("factor", 0.5)),
+        seed=int(spec.get("seed", 0)),
+        base_port=int(spec.get("base_port", 45000)),
+        health=spec.get("health"),
+        chaos=spec.get("chaos"),
+        recovery=spec.get("recovery"),
+        membership=spec.get("membership"),
+        trust=spec.get("trust"),
+        flowctl=spec.get("flowctl"),
+        obs=spec.get("obs"),
+        run=spec.get("run"),
+        **dict(spec.get("protocol") or {}),
+    )
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--spec", required=True, help="run spec JSON path")
+    ap.add_argument("--index", type=int, required=True, help="node index")
+    args = ap.parse_args(argv)
+    with open(args.spec, encoding="utf-8") as f:
+        spec = json.load(f)
+
+    from dpwa_tpu.run.harness import TrainNode
+    from dpwa_tpu.run.task import make_task
+
+    me = int(args.index)
+    restarted = os.environ.get("DPWA_BOOTSTRAP", "0") == "1"
+    config = build_config(spec)
+    task = make_task(spec.get("task", "blobs"), seed=config.protocol.seed)
+    node = TrainNode(
+        me,
+        int(spec["n"]),
+        config,
+        task,
+        spec["workdir"],
+        spec.get("leg", "crash"),
+        restore=restarted,
+    )
+    crash_at = (spec.get("crash_at_step") or {}).get(str(me))
+    step_sleep = float(spec.get("step_sleep_s", 0.0))
+    try:
+        if restarted:
+            node.log_crashed()
+        node.log_start()
+        steps = config.run.steps
+        while node.step < steps:
+            if (
+                crash_at is not None
+                and not restarted
+                and node.step == int(crash_at)
+            ):
+                # Abrupt death, mid-training: SIGKILL ourselves so no
+                # atexit/finally path gets to flush or say goodbye —
+                # exactly what the recovery planes must survive.
+                os.kill(os.getpid(), signal.SIGKILL)
+            node.run_step()
+            if step_sleep > 0.0:
+                time.sleep(step_sleep)
+        node.log_done()
+    finally:
+        node.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -388,6 +388,32 @@ def make_gossip_eval_fn(
     return jax.jit(mapped)
 
 
+def make_host_train_step(
+    loss_fn: Callable[[PyTree, Any, Any], Any],
+    optimizer: optax.GradientTransformation,
+):
+    """Jitted single-replica host step: ``step_fn(params, opt_state, x,
+    y) -> (params, opt_state, loss)``.
+
+    The multi-PROCESS twin of :func:`make_gossip_train_step`: where the
+    SPMD loop fuses every peer's fwd/bwd/optimizer and the exchange into
+    one ``shard_map`` program, the chaos-certified harness
+    (:mod:`dpwa_tpu.run`, docs/training.md) runs one OS process per
+    peer — each takes this local step, then hands the result to
+    ``DpwaTcpAdapter.update`` for the TCP exchange (the reference's
+    ``loss.backward(); optimizer.step(); adapter.update(loss)`` shape).
+    One definition serves the harness and the examples' ``--certify``
+    arms, so the certified loop and the benched loop cannot drift."""
+
+    @jax.jit
+    def step_fn(params, opt_state, x, y):
+        loss, grads = jax.value_and_grad(loss_fn)(params, x, y)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    return step_fn
+
+
 def consensus_params(stacked_params: PyTree) -> PyTree:
     """Mean over the peer axis — the 'deployed' model after training.
 

@@ -147,6 +147,13 @@ TAG_VIEW_SAMPLE = _register("view_sample_draw", CONTROL_TAG_BASE_2 + 2)
 # replacement choices.
 TAG_PASSIVE_SHUFFLE = _register("passive_shuffle_draw", CONTROL_TAG_BASE_2 + 3)
 
+# Training-harness data order (run/harness.py +
+# schedules.data_shuffle_draw): each node's per-epoch shard permutation.
+# Keyed on ``(seed, epoch, node)``, so a seeded rerun replays the exact
+# batch sequence with no stream state to checkpoint, and a rejoining
+# node lands on the same data order as the run it crashed out of.
+TAG_DATA_SHUFFLE = _register("data_shuffle_draw", CONTROL_TAG_BASE_2 + 4)
+
 
 def registered_tags() -> Dict[int, str]:
     """A copy of the full tag → name allocation map (chaos included)."""

@@ -13,6 +13,7 @@ meaningful, wall-clock numbers are real."""
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
 import time
@@ -22,6 +23,29 @@ import numpy as np
 sys.path.insert(
     0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 )
+
+
+def certify(args) -> int:
+    """Chaos-certify the image-class training regime over the REAL
+    multi-process TCP stack (docs/training.md): the harness's clean leg
+    trains the MNIST-class digits model per peer and judges gossip
+    time-to-loss against a single-process SGD control arm at equal
+    total steps, with the incident plane required silent."""
+    import tempfile
+
+    from dpwa_tpu.run.legs import clean_leg
+    from dpwa_tpu.run.report import render_report
+
+    workdir = tempfile.mkdtemp(prefix="dpwa-bert-certify-")
+    res = clean_leg(
+        workdir, n_peers=args.certify_peers, base_port=args.certify_port
+    )
+    print(render_report(res.report))
+    print(
+        f"clean certify: {'ok' if res.ok else 'FAILED'} "
+        + json.dumps(res.verdict, default=str)
+    )
+    return 0 if res.ok else 1
 
 
 def main() -> None:
@@ -37,10 +61,21 @@ def main() -> None:
     ap.add_argument("--bf16", action="store_true", help="bfloat16 compute "
                     "(the MFU-honest dtype on TPU; BASELINE.md footnote 1)")
     ap.add_argument("--log-every", type=int, default=20)
+    ap.add_argument("--certify", action="store_true",
+                    help="run the chaos-certification clean leg "
+                    "(dpwa_tpu/run/, gossip vs single-process SGD "
+                    "time-to-loss over the real TCP stack) instead of "
+                    "the SPMD timing loop")
+    ap.add_argument("--certify-peers", type=int, default=8,
+                    help="peer count for --certify")
+    ap.add_argument("--certify-port", type=int, default=47200,
+                    help="base TCP port for --certify")
     from dpwa_tpu.utils.launch import add_transport_args, build_transport
 
     add_transport_args(ap)
     args = ap.parse_args()
+    if args.certify:
+        sys.exit(certify(args))
 
     from dpwa_tpu.config import make_local_config
 

@@ -14,6 +14,7 @@ exchange semantics.  Training data is a synthetic deterministic language
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
 import time
@@ -23,6 +24,29 @@ import numpy as np
 sys.path.insert(
     0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 )
+
+
+def certify(args) -> int:
+    """Chaos-certify this config's exchange regime over the REAL
+    multi-process TCP stack (docs/training.md): the harness's LoRA leg
+    trains an adapter-only pytree at d≈100K — the same ~400 KB frame
+    class this example gossips — through transport, trust, and obs,
+    and judges convergence, exchange, and incident silence."""
+    import tempfile
+
+    from dpwa_tpu.run.legs import lora_leg
+    from dpwa_tpu.run.report import render_report
+
+    workdir = tempfile.mkdtemp(prefix="dpwa-lora-certify-")
+    res = lora_leg(
+        workdir, n_peers=args.certify_peers, base_port=args.certify_port
+    )
+    print(render_report(res.report))
+    print(
+        f"lora certify: {'ok' if res.ok else 'FAILED'} "
+        + json.dumps(res.verdict, default=str)
+    )
+    return 0 if res.ok else 1
 
 
 def main() -> None:
@@ -36,10 +60,20 @@ def main() -> None:
     ap.add_argument("--full-size", action="store_true",
                     help="real Llama-3-8B dims (needs real HBM)")
     ap.add_argument("--log-every", type=int, default=20)
+    ap.add_argument("--certify", action="store_true",
+                    help="run the chaos-certification LoRA leg "
+                    "(dpwa_tpu/run/, adapter-only exchange over the "
+                    "real TCP stack) instead of the SPMD timing loop")
+    ap.add_argument("--certify-peers", type=int, default=4,
+                    help="peer count for --certify")
+    ap.add_argument("--certify-port", type=int, default=47300,
+                    help="base TCP port for --certify")
     from dpwa_tpu.utils.launch import add_transport_args, build_transport
 
     add_transport_args(ap)
     args = ap.parse_args()
+    if args.certify:
+        sys.exit(certify(args))
 
     from dpwa_tpu.config import make_local_config
 

@@ -51,6 +51,8 @@ _SOCKET_TEST_MODULES = (
     "test_wire_int8",
     "test_async_freerun",
     "test_flowctl",
+    "test_run_harness",
+    "test_run_legs",
 )
 _SOCKET_DEFAULT_TIMEOUT_S = 30.0
 _SOCKET_TEST_DEADLINE_S = 120.0

@@ -2,7 +2,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from dpwa_tpu.config import InterpolationConfig
+from dpwa_tpu.config import InterpolationConfig, RecoveryConfig
 from dpwa_tpu.interpolation import (
     PeerMeta,
     clock_weighted,
@@ -108,3 +108,31 @@ def test_factory_resolves_nonfinite_alpha_by_sick_side(
     f = make_interpolation(InterpolationConfig(type="loss", factor=1.0))
     a = float(f(meta(3, local_loss), meta(7, remote_loss)))
     assert np.isfinite(a) and a == expected
+
+
+def test_finite_spike_below_rescue_bound_keeps_ordinary_path():
+    # ``max_abs_loss`` is the RESCUE bound: a finite local loss below it
+    # — even a spike well past a workload's guard-scale ``max_loss`` —
+    # must take the ordinary clamped alpha, never the wholesale alpha=1
+    # adoption.  Only beyond the rescue bound does adoption fire, and a
+    # sick REMOTE with a healthy local keeps the replica (alpha=0).
+    f = make_interpolation(
+        InterpolationConfig(type="constant", factor=0.5),
+        max_abs_loss=160.0,
+    )
+    assert float(f(meta(3, 100.0), meta(7, 1.0))) == 0.5
+    assert float(f(meta(3, 200.0), meta(7, 1.0))) == 1.0
+    assert float(f(meta(3, 1.0), meta(7, 200.0))) == 0.0
+
+
+def test_recovery_rescue_bound_sits_above_guard():
+    # Default: 16x headroom over the guard's reject bound, so the guard
+    # can be tuned to the real loss scale without arming the rescue on
+    # normal early-training spikes.
+    assert RecoveryConfig(max_loss=10.0).rescue_bound() == 160.0
+    assert (
+        RecoveryConfig(max_loss=10.0, rescue_loss=50.0).rescue_bound()
+        == 50.0
+    )
+    with pytest.raises(ValueError, match="rescue_loss"):
+        RecoveryConfig(max_loss=10.0, rescue_loss=5.0)

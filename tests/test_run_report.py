@@ -1,0 +1,180 @@
+"""Unit coverage for the run_report loss/incident join (ISSUE 19).
+
+The join logic lives in :mod:`dpwa_tpu.run.report`; ``tools/
+run_report.py`` is the CLI shim over it.  These tests drive the pure
+pieces — EWMA series, dent windows, incident clustering, bracket
+checks, first-signal attribution — on synthetic data, then the full
+:func:`build_report` on a hand-written workdir, so the chaos legs'
+verdicts rest on arithmetic that is pinned here, not only exercised
+end-to-end."""
+
+import json
+import os
+
+from dpwa_tpu.run.report import (
+    build_report,
+    cluster_brackets,
+    dent_window,
+    ewma_series,
+    first_signal,
+    incident_clusters,
+    load_jsonl,
+    render_report,
+)
+
+
+def _loss(step, loss, **kw):
+    return {"record": "loss", "step": step, "t": float(step), "me": 0,
+            "loss": loss, **kw}
+
+
+def test_load_jsonl_tolerates_partial_final_line(tmp_path):
+    """A crashed writer's truncated last line must not sink the report."""
+    path = os.path.join(tmp_path, "node0.jsonl")
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(json.dumps(_loss(0, 1.0)) + "\n")
+        f.write(json.dumps(_loss(1, 0.9)) + "\n")
+        f.write('{"record": "loss", "step": 2, "lo')  # SIGKILL mid-write
+    rows = load_jsonl(path)
+    assert [r["step"] for r in rows] == [0, 1]
+    assert load_jsonl(os.path.join(tmp_path, "missing.jsonl")) == []
+
+
+def test_ewma_series_sorts_by_step_and_smooths():
+    rows = [_loss(2, 4.0), _loss(0, 1.0), _loss(1, 1.0)]
+    series = ewma_series(rows, beta=0.5)
+    assert [s for s, _ in series] == [0, 1, 2]
+    # ewma: 1.0, 1.0, then 0.5*1.0 + 0.5*4.0 = 2.5
+    assert series[-1][1] == 2.5
+    # non-numeric losses are skipped, not crashed on
+    assert ewma_series([_loss(0, None), _loss(1, 2.0)]) == [(1, 2.0)]
+
+
+def test_dent_window_none_on_monotone_curve():
+    series = [(i, 2.0 - 0.1 * i) for i in range(10)]
+    assert dent_window(series) is None
+
+
+def test_dent_window_detects_peak_and_recovery():
+    series = (
+        [(i, 1.0) for i in range(5)]
+        + [(5, 1.6), (6, 2.0), (7, 1.5), (8, 1.05), (9, 1.0)]
+    )
+    dent = dent_window(series, rel=0.25)
+    assert dent is not None
+    assert dent["start"] == 5
+    assert dent["peak"] == 2.0 and dent["peak_step"] == 6
+    assert dent["end"] == 8 and dent["recovered"]
+    assert dent["baseline"] == 1.0
+    assert dent["excursion"] == 2.0
+
+
+def test_dent_window_unrecovered_runs_to_end():
+    series = [(i, 1.0) for i in range(4)] + [(4, 3.0), (5, 3.0)]
+    dent = dent_window(series, rel=0.25)
+    assert dent["start"] == 4
+    assert dent["end"] == 5 and not dent["recovered"]
+
+
+def _incident(status, step, cid="inc-1", **kw):
+    rec = {"record": "incident", "id": cid, "status": status,
+           "step": step, "kind": "byzantine", "severity": "warn"}
+    rec.update(kw)
+    return rec
+
+
+def test_incident_clusters_fold_open_update_resolved():
+    records = [
+        _incident("open", 5, opened_step=5, peers=[1], alerts=1),
+        _incident("update", 7, peers=[1], alerts=3),
+        _incident("resolved", 11, resolved_step=11, alerts=3),
+        _incident("open", 20, cid="inc-2", opened_step=20, peers=[2]),
+        {"record": "health", "step": 6},  # non-incident rows are ignored
+    ]
+    clusters = incident_clusters(records)
+    assert [c["id"] for c in clusters] == ["inc-1", "inc-2"]
+    first = clusters[0]
+    assert first["opened_step"] == 5
+    assert first["resolved_step"] == 11
+    assert first["alerts"] == 3
+    assert first["peers"] == [1]
+    assert clusters[1]["resolved_step"] is None  # still open at end
+
+
+def test_cluster_brackets_slack_and_open_tail():
+    dent = {"start": 10, "end": 20}
+    ok = {"opened_step": 12, "resolved_step": 19}
+    assert cluster_brackets(ok, dent, slack=8)
+    late_open = {"opened_step": 25, "resolved_step": 40}
+    assert not cluster_brackets(late_open, dent, slack=8)
+    early_close = {"opened_step": 10, "resolved_step": 5}
+    assert not cluster_brackets(early_close, dent, slack=2)
+    still_open = {"opened_step": 11, "resolved_step": None}
+    assert cluster_brackets(still_open, dent, slack=8)
+
+
+def test_first_signal_picks_earliest_plane():
+    node = {
+        "loss": [
+            _loss(0, 1.0, outcome="success"),
+            _loss(3, 1.0, outcome="timeout"),
+            _loss(6, 1.0, outcome="untrusted"),
+        ],
+    }
+    incidents = [_incident("open", 9)]
+    sig = first_signal(node, incidents)
+    assert sig == {
+        "plane": "health", "step": 3, "detail": "outcome timeout"
+    }
+    # trust wins when it fires first
+    node["loss"][1]["outcome"] = "success"
+    assert first_signal(node, incidents)["plane"] == "trust"
+    assert first_signal({"loss": []}, []) is None
+
+
+def _write_jsonl(path, rows):
+    with open(path, "w", encoding="utf-8") as f:
+        for row in rows:
+            f.write(json.dumps(row) + "\n")
+
+
+def test_build_report_on_synthetic_workdir(tmp_path):
+    run_common = {"record": "run", "me": 0, "leg": "byzantine",
+                  "peers": 2, "seed": 1}
+    # build_report smooths with the harness EWMA (beta 0.2), so the
+    # attack spike needs a recovery tail long enough for the smoothed
+    # curve to decay back inside the dent window's rel/2 band.
+    losses = (
+        [_loss(i, 1.0, outcome="success") for i in range(5)]
+        + [_loss(5, 3.0, outcome="untrusted"), _loss(6, 2.0)]
+        + [_loss(i, 1.0) for i in range(7, 15)]
+    )
+    _write_jsonl(
+        os.path.join(tmp_path, "node0.jsonl"),
+        [dict(run_common, status="start", step=0, t=0.0)]
+        + losses
+        + [dict(run_common, status="crashed", step=4, t=4.0),
+           dict(run_common, status="start", step=4, t=4.0,
+                checkpoint_restored_step=4),
+           dict(run_common, status="done", step=15, t=15.0, wall_s=1.0,
+                steps_to_target=3, final_loss=1.0)],
+    )
+    _write_jsonl(
+        os.path.join(tmp_path, "incidents-0.jsonl"),
+        [_incident("open", 5, opened_step=5),
+         _incident("resolved", 9, resolved_step=9)],
+    )
+    report = build_report(str(tmp_path))
+    node = report["nodes"][0]
+    assert node["steps_logged"] == 15
+    assert node["crashes"] == 1 and node["restarts"] == 1
+    assert node["restored_step"] == 4
+    assert node["done"]["steps_to_target"] == 3
+    dent = node["dent"]
+    assert dent is not None and dent["start"] == 5 and dent["recovered"]
+    assert len(node["incident_clusters"]) == 1
+    assert node["bracketed"] == [True]
+    assert node["first_signal"]["plane"] == "trust"
+    text = render_report(report)
+    assert "loss dent" in text and "brackets the dent" in text
+    assert "first signal: trust" in text

@@ -757,6 +757,7 @@ def test_threefry_tags_are_pinned():
         33: "async_drain_draw",
         34: "view_sample_draw",
         35: "passive_shuffle_draw",
+        36: "data_shuffle_draw",
     }
     assert tags.CHAOS_TAG_BASE == 16
     # Second control-plane block: 0..15 is full, 16..31 belongs to the
@@ -766,6 +767,7 @@ def test_threefry_tags_are_pinned():
     assert tags.TAG_ASYNC_DRAIN == 33
     assert tags.TAG_VIEW_SAMPLE == 34
     assert tags.TAG_PASSIVE_SHUFFLE == 35
+    assert tags.TAG_DATA_SHUFFLE == 36
 
 
 def test_tag_collision_raises():

@@ -1,0 +1,62 @@
+#!/usr/bin/env python
+"""Join a training run's loss curves with the obs/incident planes.
+
+CLI over :mod:`dpwa_tpu.run.report` (the lint_emitters.py pattern: the
+join logic lives in the package; this stays a runnable veneer).  Given a
+harness workdir — per-node ``node<i>.jsonl`` loss/run streams,
+``node<i>.events.jsonl`` adapter events, ``incidents-<i>.jsonl`` from
+the obs plane — it answers the chaos-certification questions:
+
+- where is each node's loss dent, and did the curve recover?
+- does an incident cluster bracket the dent, and is it the only one?
+- which plane saw the fault first — trust, health, or incidents?
+- did a crashed worker restore a checkpoint and rejoin the cohort?
+
+Usage::
+
+    $ python tools/run_report.py <workdir>           # human-readable
+    $ python tools/run_report.py <workdir> --json    # machine-readable
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Optional, Sequence
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:  # runnable as a script from any cwd
+    sys.path.insert(0, _REPO_ROOT)
+
+from dpwa_tpu.run.report import build_report, render_report  # noqa: E402
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0],
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter,
+    )
+    ap.add_argument("workdir", help="harness run directory (JSONL planes)")
+    ap.add_argument(
+        "--observer", type=int, default=0,
+        help="node whose curve anchors the dent/bracket analysis",
+    )
+    ap.add_argument(
+        "--json", action="store_true", help="emit the report as JSON"
+    )
+    args = ap.parse_args(argv)
+    if not os.path.isdir(args.workdir):
+        print(f"not a directory: {args.workdir}", file=sys.stderr)
+        return 2
+    report = build_report(args.workdir, observer=args.observer)
+    if args.json:
+        print(json.dumps(report, indent=2, sort_keys=True))
+    else:
+        print(render_report(report))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

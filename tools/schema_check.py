@@ -34,6 +34,13 @@ schemas:
   summary ``tools/fleet_report.py`` digests — all closed-world;
 - ``record: "island"`` — per-island convergence/leadership rows from
   the hierarchical planes (docs/hierarchy.md), closed-world;
+- ``record: "run"`` — the training harness's run envelope
+  (docs/training.md): one ``status: "start"`` record pinning the leg's
+  shape (model, d, peers, seed) and one terminal ``"done"``/
+  ``"crashed"`` record carrying the outcome, closed-world;
+- ``record: "loss"`` — the training harness's per-step loss stream
+  (``tools/run_report.py`` joins these against the incident plane),
+  closed-world;
 - records with no ``record`` key — per-step exchange/training records
   (``MetricsLogger.log`` / ``log_exchange``): ``step`` and ``t`` are
   pinned, the rest is adapter-defined.
@@ -397,13 +404,65 @@ _EXCHANGE_REQUIRED: Dict[str, tuple] = {
     "t": _NUM,
 }
 
+# Training-harness run envelope (dpwa_tpu/run, docs/training.md): a
+# ``status: "start"`` record opens every per-node stream with the leg's
+# full shape, and exactly one terminal record (``done`` or ``crashed``)
+# carries the outcome fields run_report/train_gate consume.
+_RUN_REQUIRED: Dict[str, tuple] = {
+    "record": (str,),
+    "step": (int,),
+    "t": _NUM,
+    "me": (int,),
+    "leg": (str,),
+    "status": (str,),
+    "peers": (int,),
+    "seed": (int,),
+}
+_RUN_OPTIONAL: Dict[str, tuple] = {
+    "model": (str,),
+    "dataset": (str,),
+    "d": (int,),
+    "steps": (int,),
+    "batch_size": (int,),
+    "lr": _NUM,
+    "target_loss": _NUM,
+    "async_rounds": (bool,),
+    "rx_server": (str,),
+    "final_loss": _NUM,
+    "best_loss": _NUM,
+    "time_to_target_s": _NUM + (type(None),),
+    "steps_to_target": (int, type(None)),
+    "wall_s": _NUM,
+    "checkpoint_restored_step": (int,),
+}
+
+# Training-harness loss stream: the per-step record run_report joins
+# against the incident plane.  ``loss`` is the node's own minibatch
+# loss; merge metadata (alpha/partner/outcome) rides along so the dent
+# analysis can see WHICH merges moved the curve.
+_LOSS_REQUIRED: Dict[str, tuple] = {
+    "record": (str,),
+    "step": (int,),
+    "t": _NUM,
+    "me": (int,),
+    "loss": _NUM,
+}
+_LOSS_OPTIONAL: Dict[str, tuple] = {
+    "epoch": (int,),
+    "alpha": _NUM,
+    "partner": (int, type(None)),
+    "outcome": (str, type(None)),
+    "test_loss": _NUM,
+    "test_acc": _NUM,
+}
+
 # The registry tools/lint_emitters.py checks emit sites against: every
 # ``record`` kind and every ``event`` kind the tree may write.  A new
 # emitter extends these IN THE SAME CHANGE that adds its schema above.
 RECORD_KINDS = frozenset(
     {
         "health", "trace", "event", "alert", "incident", "flight",
-        "bench", "fleet", "island",
+        "bench", "fleet", "island", "run", "loss",
     }
 )
 EVENT_KINDS = frozenset(
@@ -563,6 +622,18 @@ def check_record(rec: dict) -> List[str]:
     if kind == "island":
         return _check_fields(
             rec, _ISLAND_REQUIRED, _ISLAND_OPTIONAL, closed=True
+        )
+    if kind == "run":
+        errs = _check_fields(rec, _RUN_REQUIRED, _RUN_OPTIONAL, closed=True)
+        status = rec.get("status")
+        if isinstance(status, str) and status not in (
+            "start", "done", "crashed"
+        ):
+            errs.append(f"unknown run status {status!r}")
+        return errs
+    if kind == "loss":
+        return _check_fields(
+            rec, _LOSS_REQUIRED, _LOSS_OPTIONAL, closed=True
         )
     if kind is None:
         return _check_fields(rec, _EXCHANGE_REQUIRED)
