@@ -1240,6 +1240,63 @@ def async_gate(
     return gate
 
 
+TUNE_GATE_WINDOW = 8
+TUNE_GATE_REL_TOL = 0.5
+
+
+def tune_gate(
+    history: list,
+    current_speedup,
+    window: int = TUNE_GATE_WINDOW,
+    rel_tol: float = TUNE_GATE_REL_TOL,
+    methodology: int = BENCH_METHODOLOGY,
+) -> dict:
+    """Regression gate for the self-tuning wire's unthrottle ratio
+    (pure; the :func:`async_gate` mold, including the like-with-like
+    ``bench_methodology`` filter).  The ratio is the static-f32 leg's
+    settled-regime p50 round wall over the tuned leg's — how much of
+    the shaped links' throttle the per-link controller sheds by
+    walking the codec ladder instead of timing out.  A change that
+    stops evidence reaching the controller (the observe feed, the
+    publish-side plan, the error-feedback reset) collapses the ratio
+    toward 1x and shows up here as "regressed" against recent medians.
+    The band is wide (``rel_tol`` 0.5): the numerator is a
+    timeout-dominated wall, stable, but the denominator is a
+    scheduler-sensitive few-ms figure."""
+    samples = [
+        float(e["tune_unthrottle"])
+        for e in history
+        if isinstance(e, dict)
+        and e.get("record") == "bench"
+        and e.get("bench_methodology") == methodology
+        and isinstance(e.get("tune_unthrottle"), (int, float))
+        and not isinstance(e.get("tune_unthrottle"), bool)
+    ][-int(window):]
+    median = float(np.median(samples)) if samples else None
+    gate = {
+        "samples": len(samples),
+        "window": int(window),
+        "rel_tol": float(rel_tol),
+        "methodology": int(methodology),
+        "median_speedup": round(median, 3) if median is not None else None,
+        "current_speedup": (
+            round(float(current_speedup), 3)
+            if current_speedup is not None else None
+        ),
+    }
+    if current_speedup is None or len(samples) < 2:
+        gate["verdict"] = "no_data"
+        return gate
+    cur = float(current_speedup)
+    if cur < median * (1.0 - rel_tol):
+        gate["verdict"] = "regressed"
+    elif cur > median * (1.0 + rel_tol):
+        gate["verdict"] = "improved"
+    else:
+        gate["verdict"] = "ok"
+    return gate
+
+
 FLEET_GATE_WINDOW = 8
 FLEET_GATE_REL_TOL = 0.5
 # The leg's fixed view block: the O(sample) claim is about THESE bounds
@@ -1580,6 +1637,225 @@ def bench_async(
         "lockstep": lock_leg,
         "async": async_leg,
         "straggler_speedup": speedup,
+    }
+
+
+def bench_tune(
+    d: int = 4096,
+    iters: int = 48,
+    timeout_ms: int = 250,
+    trickle_bytes_per_s: float = 8192.0,
+    compute_ms: float = 5.0,
+) -> dict:
+    """Self-tuning wire vs the static codecs under mixed link shaping.
+
+    Three legs run the SAME 4-peer localhost ring and the SAME fault
+    schedule — a congested fabric with mixed link rates: peers 1 and 3
+    trickle-shaped for the whole run (``trickle_bytes_per_s`` is far
+    too slow to land a ``d``-float f32 frame inside ``timeout_ms``),
+    peers 0 and 2 bandwidth-flapping (chaos ``bandwidth_windows``:
+    each 6-round block independently draws clear — full-speed serving
+    — or a shaped rate between "int8 fits" and "f32 almost fits").
+    The legs differ only in the wire config: static f32 (the floor),
+    static int8 (the best single static codec for this budget), and
+    the per-link controller (``tune.enabled`` with a short window so
+    the ladder walk fits the run).
+
+    The shaping is fabric-symmetric on purpose.  The controller's
+    evidence is fetch-side and its lever is publish-side, so a link
+    heals when BOTH ends sit behind shaped egress: each observes slow
+    fetches from the other and shrinks what it serves back.  A
+    one-sided throttle (only the server shaped, the fetcher's own
+    egress clear) leaves the shaped side blind — the anonymous fetch
+    request carries no requester id, so failed serves cannot be
+    attributed to a link — and that direction stays at the static
+    config.  ``compute_ms`` is the per-round compute stand-in (the
+    bench_async pattern), slept identically in every leg and excluded
+    from the walls.
+
+    Unlike bench_async, rounds here are BARRIERED: free-running
+    threads let the shaped peers fall behind, after which cross-speed
+    pairs fast-fail as STALE — milliseconds of wall, zero merges —
+    and the static legs look fast while averaging nothing.  The
+    barrier keeps every leg's clocks aligned so a shaped fetch pays
+    its honest price (the timeout for an oversized frame, the real
+    trickle transfer for one the ladder shrank to fit), and the
+    settled walls compare wire behaviour, not clock skew.
+
+    Reported per leg: p50/p99 round walls over the whole run and over
+    the settled regime (the last third of rounds, after the ladder
+    walk), merge count (rounds that actually folded a partner frame),
+    and the disagreement trajectory (``rel_half_round`` — first round
+    at half the starting rel — plus the endpoint).  ``tune_unthrottle``
+    — the static-f32 settled p50 over the tuned settled p50 — is the
+    gated headline; ``tune_vs_best_static`` is the same ratio against
+    the int8 leg.  The rel columns keep the fidelity price visible: a
+    static codec that lands averages at full density, while the
+    controller's coarse rungs trade terminal precision for keeping
+    every link merging — the walls and merge counts are the claim, the
+    rel trajectory is the cost."""
+    from dpwa_tpu.config import make_local_config
+    from dpwa_tpu.parallel.tcp import TcpTransport
+
+    peers = 4
+    chaos = {
+        "enabled": True,
+        "trickle_windows": ((1, 0, iters), (3, 0, iters)),
+        "trickle_bytes_per_s": float(trickle_bytes_per_s),
+        "bandwidth_windows": ((0, 0, iters), (2, 0, iters)),
+        "bandwidth_flap_probability": 0.75,
+        "bandwidth_block_rounds": 6,
+        "bandwidth_bps_min": 8192.0,
+        "bandwidth_bps_max": 131072.0,
+    }
+
+    def ring(**kw):
+        cfg = make_local_config(
+            peers, base_port=0, schedule="ring",
+            timeout_ms=timeout_ms, chaos=chaos,
+            obs={"sketch": True, "sketch_k": 32}, **kw
+        )
+        ts = [TcpTransport(cfg, f"node{i}") for i in range(peers)]
+        for t in ts:
+            for i, other in enumerate(ts):
+                t.set_peer_port(i, other.port)
+        return ts
+
+    rng = np.random.default_rng(0)
+    base = [rng.standard_normal(d).astype(np.float32) for _ in range(peers)]
+
+    def drive(ts):
+        walls: list = [[] for _ in range(peers)]
+        merges = [0] * peers
+        vecs = [b.copy() for b in base]
+        rel_curve: list = []
+        # publish-barrier: everyone's round-N frame is up before anyone
+        # fetches; done-barrier: all replicas settled so node 0 can
+        # sample the round's disagreement; exit-barrier: nobody
+        # overwrites the served frame with round N+1 while a trickled
+        # serve is still feeding it out.
+        enter = threading.Barrier(peers)
+        done = threading.Barrier(peers)
+        exit_ = threading.Barrier(peers)
+
+        def rel_of(vs) -> float:
+            stack = np.stack(vs)
+            mean = stack.mean(axis=0)
+            return float(
+                np.sqrt(np.mean((stack - mean) ** 2))
+                / (np.sqrt(np.mean(mean ** 2)) + 1e-12)
+            )
+
+        def run_node(i, t):
+            for it in range(iters):
+                t.publish(vecs[i], float(it), 0.0)
+                enter.wait(timeout=60.0)
+                if compute_ms:
+                    time.sleep(compute_ms / 1e3)
+                t0 = time.perf_counter()
+                merged, alpha, _ = t.exchange(vecs[i], float(it), 0.0, it)
+                walls[i].append(time.perf_counter() - t0)
+                if alpha != 0.0:
+                    merges[i] += 1
+                    vecs[i] = np.asarray(merged, np.float32)
+                done.wait(timeout=60.0)
+                if i == 0:
+                    rel_curve.append(round(rel_of(vecs), 6))
+                exit_.wait(timeout=60.0)
+
+        threads = [
+            threading.Thread(target=run_node, args=(i, t), daemon=True)
+            for i, t in enumerate(ts)
+        ]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        return walls, vecs, merges, rel_curve
+
+    settled_from = iters - iters // 3
+
+    def leg(**kw):
+        ts = ring(**kw)
+        try:
+            t0 = time.perf_counter()
+            walls, vecs, merges, rel_curve = drive(ts)
+            total_s = time.perf_counter() - t0
+            flat = [w for ws in walls for w in ws]
+            settled = [w for ws in walls for w in ws[settled_from:]]
+            stack = np.stack(vecs)
+            mean = stack.mean(axis=0)
+            rel_rms = float(
+                np.sqrt(np.mean((stack - mean) ** 2))
+                / (np.sqrt(np.mean(mean ** 2)) + 1e-12)
+            )
+            # First round at/below half the starting disagreement — a
+            # horizon-free rounds-to-rel read alongside the endpoint.
+            rel_half = None
+            if rel_curve:
+                target = rel_curve[0] / 2.0
+                for r_i, r_v in enumerate(rel_curve):
+                    if r_v <= target:
+                        rel_half = r_i
+                        break
+            out = {
+                "p50_ms": round(float(np.percentile(flat, 50)) * 1e3, 3),
+                "p99_ms": round(float(np.percentile(flat, 99)) * 1e3, 3),
+                "settled_p50_ms": round(
+                    float(np.percentile(settled, 50)) * 1e3, 3
+                ),
+                "settled_p99_ms": round(
+                    float(np.percentile(settled, 99)) * 1e3, 3
+                ),
+                "merges": int(sum(merges)),
+                "total_s": round(total_s, 3),
+                "final_rel_rms": round(rel_rms, 6),
+                "rel_half_round": rel_half,
+            }
+            snaps = [
+                (t.health_snapshot() or {}).get("tune") for t in ts
+            ]
+            if any(s is not None for s in snaps):
+                snaps = [s or {} for s in snaps]
+                for key in (
+                    "escalations", "backoffs", "sheds", "dwell_violations"
+                ):
+                    out[key] = sum(int(s.get(key) or 0) for s in snaps)
+                out["final_rungs"] = sorted(
+                    f"{i}->{p}:{st.get('codec')}"
+                    for i, s in enumerate(snaps)
+                    for p, st in sorted((s.get("links") or {}).items())
+                )
+            return out
+        finally:
+            for t in ts:
+                t.close()
+
+    f32_leg = leg()
+    int8_leg = leg(wire_dtype="int8")
+    tuned_leg = leg(tune={
+        "enabled": True, "window": 2, "min_dwell_rounds": 1,
+        "cooldown_rounds": 6, "jitter_rounds": 0,
+    })
+    unthrottle = round(
+        f32_leg["settled_p50_ms"] / max(tuned_leg["settled_p50_ms"], 1e-6), 3
+    )
+    vs_best = round(
+        int8_leg["settled_p50_ms"] / max(tuned_leg["settled_p50_ms"], 1e-6), 3
+    )
+    return {
+        "d": int(d),
+        "iters": int(iters),
+        "peers": int(peers),
+        "timeout_ms": int(timeout_ms),
+        "trickle_bytes_per_s": float(trickle_bytes_per_s),
+        "compute_ms": float(compute_ms),
+        "fleet": {"trickled": [1, 3], "flapping": [0, 2]},
+        "static_f32": f32_leg,
+        "static_int8": int8_leg,
+        "tuned": tuned_leg,
+        "tune_unthrottle": unthrottle,
+        "tune_vs_best_static": vs_best,
     }
 
 
@@ -2300,6 +2576,29 @@ def main() -> None:
         help="straggler serving rate (bytes/s) for the async leg",
     )
     ap.add_argument(
+        "--tune-leg", action="store_true",
+        help="run ONLY the self-tuning-wire leg: static f32 vs static "
+        "int8 vs the per-link controller over a congested-fabric "
+        "4-peer fleet (two trickled peers, two bandwidth-flapping "
+        "with full-speed clear blocks) — settled-regime round walls, "
+        "merge counts, and the fidelity-shed unthrottle ratio; "
+        "appends its own bench_history.jsonl record carrying a "
+        "tune_gate verdict",
+    )
+    ap.add_argument(
+        "--tune-size", type=int, default=4096,
+        help="replica size (floats) for the tune leg",
+    )
+    ap.add_argument(
+        "--tune-iters", type=int, default=48,
+        help="rounds per tune-leg drive (the ladder walk needs the "
+        "first two-thirds; walls settle over the last third)",
+    )
+    ap.add_argument(
+        "--tune-trickle-bytes", type=float, default=8192.0,
+        help="trickled peers' serving rate (bytes/s) for the tune leg",
+    )
+    ap.add_argument(
         "--fleet-leg", action="store_true",
         help="run ONLY the fleet partial-view leg: orchestrator soaks "
         "at --fleet-peers under a fixed membership.view block, "
@@ -2536,6 +2835,57 @@ def main() -> None:
             "async_gate": gate,
         }
         print("ASYNC_LEG " + json.dumps(sweep), flush=True)
+        print(json.dumps(out), flush=True)
+        try:
+            os.makedirs(os.path.dirname(history_path), exist_ok=True)
+            with open(history_path, "a", encoding="utf-8") as f:
+                f.write(
+                    json.dumps({"record": "bench", "t": time.time(), **out})
+                    + "\n"
+                )
+        except OSError:
+            pass
+        return
+    if args.tune_leg:
+        # Standalone mode (the --async-leg pattern): transports
+        # in-process on the CPU backend.  Appends its own record="bench"
+        # history line carrying the tune_gate verdict.
+        log(
+            f"tune leg: 4 peers (flapping/trickled/flapping/trickled), "
+            f"d={args.tune_size}, x{args.tune_iters} rounds, trickle "
+            f"{args.tune_trickle_bytes:.0f} B/s ..."
+        )
+        sweep = bench_tune(
+            args.tune_size, args.tune_iters,
+            trickle_bytes_per_s=args.tune_trickle_bytes,
+        )
+        log(
+            f"tune leg: settled p50 "
+            f"{sweep['static_f32']['settled_p50_ms']} ms static f32 -> "
+            f"{sweep['tuned']['settled_p50_ms']} ms tuned "
+            f"({sweep['tune_unthrottle']}x unthrottled, "
+            f"{sweep['tune_vs_best_static']}x vs int8), merges "
+            f"{sweep['static_f32']['merges']} -> "
+            f"{sweep['tuned']['merges']}, escalations "
+            f"{sweep['tuned'].get('escalations')}, dwell violations "
+            f"{sweep['tuned'].get('dwell_violations')}"
+        )
+        history_path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "artifacts", "bench_history.jsonl",
+        )
+        gate = tune_gate(
+            read_bench_history(history_path), sweep["tune_unthrottle"]
+        )
+        log(f"tune leg: gate {gate['verdict']}")
+        out = {
+            "metric": "tune_fidelity_shed_unthrottle",
+            "bench_methodology": BENCH_METHODOLOGY,
+            "tune_leg": sweep,
+            "tune_unthrottle": sweep["tune_unthrottle"],
+            "tune_gate": gate,
+        }
+        print("TUNE_LEG " + json.dumps(sweep), flush=True)
         print(json.dumps(out), flush=True)
         try:
             os.makedirs(os.path.dirname(history_path), exist_ok=True)

@@ -245,6 +245,12 @@ class DpwaTcpAdapter:
         for ev in self.transport.pop_trust_events():
             fields = dict(ev)
             self._event(fields.pop("event"), **fields)
+        # Self-tuning wire: surface the controller's ladder decisions
+        # (escalate/backoff/shed) as ``tune`` records — drained even
+        # without a logger so the buffer stays bounded.
+        for dec in self.transport.pop_tune_decisions():
+            if self.metrics is not None:
+                self.metrics.log_tune(step, dec)
         heal = self.transport.pop_heal_advice()
         if (
             heal is not None

@@ -35,6 +35,7 @@ _DECISION_MARKERS = (
     "parallel/interpolation.py",
     "parallel/async_loop.py",
     "run/",
+    "tune/",
 )
 
 # consumers for which iteration order genuinely does not matter

@@ -121,6 +121,18 @@ Schema::
       accept_delay_windows: []  # [{peer, start, stop}]: sleep before
                                 #   reading the request (accept-path lag)
       accept_delay_ms: 100.0
+      bandwidth_windows: []     # [{peer, start, stop}]: link-quality
+                                #   flapping — time slices into blocks of
+                                #   bandwidth_block_rounds rounds; each
+                                #   block draws shaped-or-not (chaos kind
+                                #   13) and, when shaped, a serving rate
+                                #   in [bandwidth_bps_min, bps_max] (kind
+                                #   14); composes with trickle windows by
+                                #   taking the slower rate
+      bandwidth_flap_probability: 1.0  # per-block chance the link flaps
+      bandwidth_block_rounds: 4 # rounds per flap block (square-wave width)
+      bandwidth_bps_min: 4096.0 # drawn shaped-rate range (bytes/s)
+      bandwidth_bps_max: 65536.0
     recovery:                   # crash recovery & divergence guard
       enabled: true             # peer bootstrap serving + payload guard
       max_param_norm: 1.0e12    # reject/roll back when ||vec||_2 exceeds
@@ -297,6 +309,41 @@ Schema::
       checkpoint_keep: 3        # newest checkpoints kept per node
       target_loss: 0.0          # time-to-loss threshold the acceptance
                                 #   legs measure against (0 = off)
+    tune:                       # self-tuning wire (docs/tune.md); absent
+                                #   block or enabled: false keeps frames
+                                #   byte-identical to a static-config build
+      enabled: false            # per-link degradation controller: walks
+                                #   the frozen codec ladder (f32 -> bf16 ->
+                                #   int8 -> topk 0.1 -> 0.03 -> 0.01) from
+                                #   the obs planes' QUANTIZED observations
+      window: 8                 # observation rounds per link behind each
+                                #   decision
+      min_dwell_rounds: 6       # rounds a link holds a rung before it may
+                                #   escalate again (hysteresis)
+      cooldown_rounds: 12       # rounds after a back-off during which the
+                                #   link may not re-escalate
+      wire_bound_frac: 0.5      # quantized wire-span fraction of the round
+                                #   wall at/above which a round counts as
+                                #   wire-bound
+      escalate_frac: 0.5        # fraction of the window's rounds that must
+                                #   be wire-bound (or busy/slow/stale) to
+                                #   escalate one rung
+      stall_eps: 0.02           # minimum fractional rel_rms improvement
+                                #   across the window; below it the sketch
+                                #   plane reads "stalling" -> back off one
+                                #   rung
+      shed_rungs: 2             # extra rungs shed while the scheduled
+                                #   partner is scoreboard-DEGRADED —
+                                #   fidelity is shed, the round is NOT
+                                #   dropped (replaces the degrade_shed
+                                #   remap while enabled)
+      quant: 16                 # quantization buckets for observed span
+                                #   fractions and trends (decisions never
+                                #   branch on raw wall-clock, so seeded
+                                #   reruns replay bit-identically)
+      jitter_rounds: 2          # threefry dwell jitter (tag 37): drawn
+                                #   extra dwell in [0, j] desynchronizes
+                                #   fleet-wide escalations
 """
 
 from __future__ import annotations
@@ -649,6 +696,21 @@ class ChaosConfig:
     stall_ms_max: float = 200.0
     accept_delay_windows: tuple[tuple[int, int, int], ...] = ()
     accept_delay_ms: float = 100.0
+    # Link-quality flapping (self-tuning-wire chaos, docs/tune.md).
+    # ``bandwidth_windows`` marks [start, stop) round intervals where a
+    # peer's serving rate FLAPS: time is sliced into blocks of
+    # ``bandwidth_block_rounds`` rounds, each block independently draws
+    # whether it is shaped (chaos kind 13, vs bandwidth_flap_probability)
+    # and — when shaped — a rate lerped across
+    # [bandwidth_bps_min, bandwidth_bps_max] (kind 14).  Shaping composes
+    # with trickle windows by taking the slower of the two, so a flapping
+    # link looks like a square-wave trickle the tune controller must ride
+    # without thrashing its ladder.
+    bandwidth_windows: tuple[tuple[int, int, int], ...] = ()
+    bandwidth_flap_probability: float = 1.0
+    bandwidth_block_rounds: int = 4
+    bandwidth_bps_min: float = 4096.0
+    bandwidth_bps_max: float = 65536.0
 
     def __post_init__(self) -> None:
         for name in (
@@ -663,6 +725,7 @@ class ChaosConfig:
             "byzantine_replay_probability",
             "byzantine_zero_probability",
             "stall_probability",
+            "bandwidth_flap_probability",
         ):
             v = getattr(self, name)
             if not 0.0 <= v <= 1.0:
@@ -711,8 +774,23 @@ class ChaosConfig:
             raise ValueError(
                 f"accept_delay_ms must be >= 0, got {self.accept_delay_ms}"
             )
+        if self.bandwidth_block_rounds < 1:
+            raise ValueError(
+                f"bandwidth_block_rounds must be >= 1, "
+                f"got {self.bandwidth_block_rounds}"
+            )
+        if self.bandwidth_bps_min <= 0:
+            raise ValueError(
+                f"bandwidth_bps_min must be > 0, "
+                f"got {self.bandwidth_bps_min}"
+            )
+        if self.bandwidth_bps_max < self.bandwidth_bps_min:
+            raise ValueError(
+                f"bandwidth_bps_max must be >= bandwidth_bps_min, "
+                f"got {self.bandwidth_bps_max} < {self.bandwidth_bps_min}"
+            )
         for field in ("down_windows", "trickle_windows",
-                      "accept_delay_windows"):
+                      "accept_delay_windows", "bandwidth_windows"):
             windows = []
             for w in getattr(self, field):
                 if isinstance(w, Mapping):
@@ -1524,6 +1602,88 @@ class RunConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class TuneConfig:
+    """``tune:`` block — the self-tuning wire (docs/tune.md).
+
+    Off (the default, and the absent-block case) the transport publishes
+    exactly what the static ``protocol.wire_*`` knobs say — frames stay
+    byte-identical to a pre-tune build.  On, a per-link
+    :class:`~dpwa_tpu.tune.controller.LinkTuner` (the DeadlineEstimator
+    mold) walks each link up and down the frozen codec ladder from the
+    observations the obs planes already collect: escalate compression on
+    wire-bound links, back off when the sketch plane shows convergence
+    stalling, and shed fidelity — never rounds — while the scheduled
+    partner is scoreboard-DEGRADED.  Every decision derives from
+    QUANTIZED observations plus one registered threefry stream (tag 37,
+    dwell jitter), so seeded soaks replay their decision logs
+    bit-identically."""
+
+    enabled: bool = False
+    # Observation rounds per link behind each decision.
+    window: int = 8
+    # Hysteresis: a link holds a rung at least this many rounds before
+    # it may escalate again, and may not re-escalate for
+    # ``cooldown_rounds`` after a back-off — a square-wave (flapping)
+    # link settles instead of thrashing the ladder.
+    min_dwell_rounds: int = 6
+    cooldown_rounds: int = 12
+    # A round is "wire-bound" when its quantized wire-span fraction of
+    # the round wall is at/above this.
+    wire_bound_frac: float = 0.5
+    # Escalate one rung when at least this fraction of the window's
+    # rounds are wire-bound (busy/slow/stale outcomes count as
+    # wire-bound evidence — the link is failing to move bytes in time).
+    escalate_frac: float = 0.5
+    # Back off one rung when the window's fractional rel_rms improvement
+    # falls below this (the sketch plane says compression is starving
+    # convergence).  Only meaningful with >= 2 rel samples in-window.
+    stall_eps: float = 0.02
+    # Extra rungs (clamped to the ladder top) shed while the scheduled
+    # partner is DEGRADED — fidelity shed replaces the degrade_shed
+    # round-drop remap while the controller is enabled.
+    shed_rungs: int = 2
+    # Quantization buckets for observed span fractions and rel trends;
+    # decisions never branch on raw wall-clock readings.
+    quant: int = 16
+    # Dwell jitter (threefry tag 37) in [0, jitter_rounds] added to each
+    # link's dwell expiry so fleet-wide escalations desynchronize.
+    jitter_rounds: int = 2
+
+    def __post_init__(self) -> None:
+        if self.window < 2:
+            raise ValueError(f"tune.window must be >= 2, got {self.window}")
+        if self.min_dwell_rounds < 1:
+            raise ValueError(
+                f"tune.min_dwell_rounds must be >= 1, "
+                f"got {self.min_dwell_rounds}"
+            )
+        if self.cooldown_rounds < 0:
+            raise ValueError(
+                f"tune.cooldown_rounds must be >= 0, "
+                f"got {self.cooldown_rounds}"
+            )
+        for name in ("wire_bound_frac", "escalate_frac"):
+            v = getattr(self, name)
+            if not 0.0 < v <= 1.0:
+                raise ValueError(f"tune.{name} must be in (0, 1], got {v}")
+        if self.stall_eps < 0:
+            raise ValueError(
+                f"tune.stall_eps must be >= 0, got {self.stall_eps}"
+            )
+        if self.shed_rungs < 0:
+            raise ValueError(
+                f"tune.shed_rungs must be >= 0, got {self.shed_rungs}"
+            )
+        if self.quant < 2:
+            raise ValueError(f"tune.quant must be >= 2, got {self.quant}")
+        if self.jitter_rounds < 0:
+            raise ValueError(
+                f"tune.jitter_rounds must be >= 0, "
+                f"got {self.jitter_rounds}"
+            )
+
+
+@dataclasses.dataclass(frozen=True)
 class DpwaConfig:
     nodes: tuple[NodeSpec, ...]
     protocol: ProtocolConfig = ProtocolConfig()
@@ -1538,6 +1698,7 @@ class DpwaConfig:
     obs: ObsConfig = ObsConfig()
     topology: TopologyConfig = TopologyConfig()
     run: RunConfig = RunConfig()
+    tune: TuneConfig = TuneConfig()
 
     def __post_init__(self) -> None:
         # Errors here name the offending island/node (satellite fix):
@@ -1629,11 +1790,13 @@ def config_from_dict(raw: Mapping[str, Any]) -> DpwaConfig:
     obs = dict(raw.get("obs") or {})
     topology = dict(raw.get("topology") or {})
     run = dict(raw.get("run") or {})
+    tune = dict(raw.get("tune") or {})
     if topology.get("islands") is not None:
         topology["islands"] = _build_islands(topology["islands"])
     for key in (
         "down_windows", "partition_windows", "link_windows",
         "byzantine_peers", "trickle_windows", "accept_delay_windows",
+        "bandwidth_windows",
     ):
         if chaos.get(key) is not None:
             chaos[key] = tuple(chaos[key])
@@ -1651,6 +1814,7 @@ def config_from_dict(raw: Mapping[str, Any]) -> DpwaConfig:
         obs=ObsConfig(**obs),
         topology=TopologyConfig(**topology),
         run=RunConfig(**run),
+        tune=TuneConfig(**tune),
     )
 
 
@@ -1682,6 +1846,7 @@ def make_local_config(
     topology: "TopologyConfig | Mapping[str, Any] | None" = None,
     shard: "ShardConfig | Mapping[str, Any] | None" = None,
     run: "RunConfig | Mapping[str, Any] | None" = None,
+    tune: "TuneConfig | Mapping[str, Any] | None" = None,
     **protocol_kwargs: Any,
 ) -> DpwaConfig:
     """Programmatic config for tests/benchmarks: n local peers on 127.0.0.1.
@@ -1707,6 +1872,8 @@ def make_local_config(
         shard = ShardConfig(**shard)
     if isinstance(run, Mapping):
         run = RunConfig(**run)
+    if isinstance(tune, Mapping):
+        tune = TuneConfig(**tune)
     if isinstance(topology, Mapping):
         topology = dict(topology)
         if topology.get("islands") is not None:
@@ -1734,4 +1901,5 @@ def make_local_config(
         topology=topology if topology is not None else TopologyConfig(),
         shard=shard if shard is not None else ShardConfig(),
         run=run if run is not None else RunConfig(),
+        tune=tune if tune is not None else TuneConfig(),
     )

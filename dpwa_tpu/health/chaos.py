@@ -62,6 +62,8 @@ from dpwa_tpu.parallel.schedules import chaos_draw
 # Fault-kind indices onto the chaos_draw tag space (CHAOS_TAG_BASE + k)
 # are allocated in the central tag registry — collision = import error.
 from dpwa_tpu.utils.tags import (
+    CHAOS_KIND_BANDWIDTH_FLAP as _KIND_BANDWIDTH_FLAP,
+    CHAOS_KIND_BANDWIDTH_RATE as _KIND_BANDWIDTH_RATE,
     CHAOS_KIND_BYZ_REPLAY as _KIND_BYZ_REPLAY,
     CHAOS_KIND_BYZ_SCALE as _KIND_BYZ_SCALE,
     CHAOS_KIND_BYZ_SIGN as _KIND_BYZ_SIGN,
@@ -196,6 +198,40 @@ class ChaosEngine:
             return float(cfg.trickle_bytes_per_s)
         return 0.0
 
+    def bandwidth_bps(self, round: int) -> float:
+        """Flapping link-quality shaping at ``round`` (docs/tune.md);
+        0.0 = unshaped.
+
+        Inside a ``bandwidth_windows`` entry, time slices into blocks of
+        ``bandwidth_block_rounds`` rounds.  Each block draws whether it
+        flaps at all (kind 13, vs ``bandwidth_flap_probability``) and —
+        when it does — a serving rate lerped across
+        ``[bandwidth_bps_min, bandwidth_bps_max]`` (kind 14).  Two
+        independent streams: the duty cycle cannot skew how deep the
+        shaping goes.  Block-granular by construction, so the shaped
+        link looks like a square wave — exactly the thrash bait the
+        tune controller's dwell/cooldown hysteresis is proven against.
+        """
+        cfg = self.config
+        if not any(
+            p == self.peer and start <= round < stop
+            for p, start, stop in cfg.bandwidth_windows
+        ):
+            return 0.0
+        block = round // cfg.bandwidth_block_rounds
+        if (
+            chaos_draw(cfg.seed, block, self.peer, _KIND_BANDWIDTH_FLAP)
+            >= cfg.bandwidth_flap_probability
+        ):
+            return 0.0
+        frac = chaos_draw(
+            cfg.seed, block, self.peer, _KIND_BANDWIDTH_RATE
+        )
+        return float(
+            cfg.bandwidth_bps_min
+            + frac * (cfg.bandwidth_bps_max - cfg.bandwidth_bps_min)
+        )
+
     def accept_delay_s(self, round: int) -> float:
         """Pre-request accept stall at ``round`` (0.0 outside every
         configured ``accept_delay_windows`` entry for this peer)."""
@@ -246,6 +282,16 @@ class ChaosEngine:
                 * cfg.stall_ms_max
                 / 1000.0
             )
+        # Bandwidth flapping composes with trickle windows by taking the
+        # SLOWER of the two nonzero rates — both ride the same
+        # trickle_bps serving path, so neither Rx server needs to know
+        # which chaos knob shaped the link.
+        trickle = self.trickle_bps(round)
+        bandwidth = self.bandwidth_bps(round)
+        if bandwidth > 0.0:
+            trickle = bandwidth if trickle <= 0.0 else min(
+                trickle, bandwidth
+            )
         plan = FaultPlan(
             kind=wire_kind,
             delay_s=cfg.delay_ms / 1000.0,
@@ -253,7 +299,7 @@ class ChaosEngine:
             byzantine=byz,
             byz_scale=cfg.byzantine_scale_factor,
             byz_replay_age=cfg.byzantine_replay_age,
-            trickle_bps=self.trickle_bps(round),
+            trickle_bps=trickle,
             stall_s=stall_s,
             accept_delay_s=self.accept_delay_s(round),
         )

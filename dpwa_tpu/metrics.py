@@ -398,6 +398,28 @@ class MetricsLogger:
                     disagreement_rel=conv.get("rel_rms"),
                     sketch_peers=conv.get("peers_seen"),
                 )
+        tune = snapshot.get("tune")
+        if tune is not None and order:
+            # Self-tuning wire columns (absent without tune.enabled,
+            # keeping static-wire records byte-identical): the EFFECTIVE
+            # ladder rung/codec each tracked link publishes at (None for
+            # peers the controller has not yet observed), the DEGRADED
+            # shed flags, and the ladder's lifetime traffic counters —
+            # dwell_violations is the hysteresis invariant (always 0).
+            links = tune.get("links") or {}
+            tcol = lambda key: [  # noqa: E731
+                links.get(p, {}).get(key) for p in order
+            ]
+            extra = dict(
+                extra,
+                tune_rung=tcol("effective_rung"),
+                tune_codec=tcol("codec"),
+                tune_shed=tcol("shed_active"),
+                tune_escalations=tune.get("escalations"),
+                tune_backoffs=tune.get("backoffs"),
+                tune_sheds=tune.get("sheds"),
+                tune_dwell_violations=tune.get("dwell_violations"),
+            )
         async_snap = snapshot.get("async")
         if async_snap is not None and order:
             # Async round-loop columns (absent under lock-step rounds,
@@ -525,6 +547,26 @@ class MetricsLogger:
             "event": str(event),
         }
         for k, v in fields.items():
+            rec[k] = _jsonable(v)
+        self._write(json.dumps(rec))
+
+    def log_tune(self, step: int, decision: Mapping[str, Any]) -> None:
+        """One self-tuning-wire ladder decision (``record: "tune"``),
+        written immediately.
+
+        Decisions are rare and load-bearing like events (an escalation
+        explains every compressed frame after it; a dwell-window replay
+        is the determinism test's fixture) so they bypass ``every``.
+        The schema is CLOSED (tools/schema_check.py): exactly the
+        fields LinkTuner._record emits, so seeded reruns diff to empty
+        on the whole decision log."""
+        self.flush()
+        rec: dict[str, Any] = {
+            "step": int(step),
+            "t": round(time.perf_counter() - self._t0, 4),
+            "record": "tune",
+        }
+        for k, v in decision.items():
             rec[k] = _jsonable(v)
         self._write(json.dumps(rec))
 

@@ -428,6 +428,19 @@ class TopkEncoder:
     def reset(self) -> None:
         self.base = None
 
+    def retune(self, fraction: float) -> None:
+        """Swap the shipped fraction AND drop the error-feedback base.
+
+        ``base`` records what the ring was told under the OLD rung; a
+        codec change invalidates that record (the receivers that decode
+        the next frame may have merged dense/bf16 frames meanwhile, and
+        a stale residual would re-ship coordinates the new rung already
+        covers — the "stale topk memory" failure the tune plane's
+        reset-on-rung-change rule exists to prevent).  The next encode
+        rebuilds ``base`` from zeros, exactly like a fresh encoder."""
+        self.fraction = float(fraction)
+        self.reset()
+
     def encode(
         self, vec: np.ndarray, seed: int, clock: float, sender: int
     ) -> np.ndarray:

@@ -310,6 +310,28 @@ def data_shuffle_draw(seed, epoch, me, n_samples: int) -> np.ndarray:
     )
 
 
+def tune_jitter_draw(seed, clock, link, jitter_rounds: int) -> int:
+    """Dwell-jitter offset in ``[0, jitter_rounds]`` for one link's
+    escalation decision (tag 37 — the self-tuning-wire stream).
+
+    When a link's observation window says "wire-bound" the controller
+    does not escalate the instant the dwell expires: it adds this drawn
+    offset so that many links shaped by the same event do not all step
+    their codec on the same round (the backoff_jitter_draw argument,
+    applied to the ladder).  Keyed on ``(seed, publish clock, link)``
+    like :func:`shard_draw`, so a seeded rerun replays the identical
+    escalation rounds and both ends of a link agree without
+    negotiation."""
+    if jitter_rounds <= 0:
+        return 0
+    return int(
+        jax.random.randint(
+            _pair_key(seed, clock, link, _tags.TAG_TUNE_JITTER),
+            (), 0, jitter_rounds + 1,
+        )
+    )
+
+
 @functools.partial(jax.jit, static_argnums=(3,))
 def _view_perm(seed, clock, me, n_candidates: int):
     # Jitted: this is the one control draw on the per-frame publish path
@@ -386,6 +408,7 @@ def warm_control_draws(seed: int = 0, me: int = 0) -> None:
     float(async_drain_draw(seed, 0, me))
     view_sample_draw(seed, 0, me, 2)
     int(passive_shuffle_draw(seed, 0, me, 2))
+    tune_jitter_draw(seed, 0, me, 1)
     _CONTROL_DRAWS_WARM = True
 
 

@@ -86,6 +86,16 @@ _DTYPE_CODES = {v: k for k, v in _DTYPES.items()}
 _INT8_CHUNKED = _pc.PAYLOAD_INT8_CHUNKED
 _TOPK_DELTA = _pc.PAYLOAD_TOPK_DELTA
 _SHARD = _pc.PAYLOAD_SHARD
+
+# Outcomes the self-tuning wire counts as wire-bound evidence
+# regardless of measured spans: the link (or the peer behind it) could
+# not deliver a timely frame, which is exactly what escalating
+# compression relieves.  Hard-failure outcomes (refused/corrupt/
+# poisoned/untrusted) stay OUT — fewer bytes do not fix a dead or
+# byzantine peer, and the scoreboard owns those.
+_TUNE_SOFT_OUTCOMES = frozenset(
+    (Outcome.BUSY, Outcome.SLOW, Outcome.STALE, Outcome.TIMEOUT)
+)
 _PAYLOAD_CODES = _pc.CODEC_PAYLOAD_CODES
 _MAX_BLOB = _pc.MAX_BLOB_BYTES
 
@@ -1886,6 +1896,38 @@ class TcpTransport:
             # probes — the quarantine path for a persistently-suspect
             # peer no single rejection condemns.
             self.trust.attach_scoreboard(self.scoreboard)
+        # Self-tuning wire (docs/tune.md): the per-link degradation
+        # controller that walks the frozen codec ladder — escalating
+        # compression on wire-bound links, backing off when the sketch
+        # plane shows convergence stalling, and shedding FIDELITY (not
+        # rounds) at a DEGRADED partner.  None when tune.enabled is off:
+        # every publish then takes the original static-codec branches
+        # and the frames stay byte-identical.
+        self._tuner = None
+        # Per-(link, shard) top-k error-feedback encoders and the last
+        # effective rung served per link — a rung change drops the
+        # accumulated residual base (ops/quantize.TopkEncoder.retune),
+        # because it was measured against what the OLD codec told the
+        # ring.  Training-thread-only state, like _topk_encoder.
+        self._tune_topk_encoders: Dict[Tuple[int, int], object] = {}
+        self._tune_last_rung: Dict[int, int] = {}
+        self._tune_plan_cache: Optional[tuple] = None
+        if config.tune.enabled:
+            from dpwa_tpu.tune import LinkTuner, start_rung_for
+
+            self._tuner = LinkTuner(config.tune, seed=self.schedule.seed)
+            # Anchor at the static YAML rung: a link that never shows
+            # evidence publishes exactly what the config asked for.
+            self._tuner.set_start_rung(start_rung_for(
+                "topk" if self._wire_topk else "dense",
+                config.protocol.wire_dtype,
+                config.protocol.topk_fraction,
+            ))
+            if self.membership is not None:
+                # Same churn-hardening contract as trust/flowctl: an
+                # evicted peer's ladder state dies with it; a rejoiner
+                # re-enters at the static start rung.
+                self.membership.add_evict_listener(self._tuner.evict_peer)
         self.healthz = None
         if config.health.enabled and config.health.healthz_port is not None:
             from dpwa_tpu.health.endpoint import HealthzServer
@@ -2028,6 +2070,10 @@ class TcpTransport:
             self.trust is not None
             or self._wire_topk
             or self._shard_on
+            # The tuner can move any link onto a top-k rung at runtime,
+            # and a fetched top-k frame can only densify against the
+            # stashed replica — so the self-tuning wire always stashes.
+            or self._tuner is not None
             or (
                 self.config.recovery.enabled
                 and self.config.recovery.min_param_norm_ratio > 0.0
@@ -2066,13 +2112,26 @@ class TcpTransport:
             else None
         )
         tid = self._trace_id if obs is not None else None
+        # Self-tuning wire: one controller decision per publish clock
+        # for the scheduled partner's link; None when the tuner is off
+        # (the static branches below then run untouched).
+        tune_sel = (
+            self._tune_plan(int(clock))
+            if self._tuner is not None and vec.dtype == np.float32
+            else None
+        )
         if self._shard_on and vec.dtype == np.float32:
             # Sharded wire (code 6): the obs trailer above was built
             # from the FULL replica — the sketch plane's rel_rms stays
             # full-vector so convergence accounting is honest even
             # though the frame below carries one slice.
             self._publish_shard(vec, f32_vec, clock, loss, digest, obs,
-                                tid)
+                                tid, tune_sel)
+            return
+        if tune_sel is not None:
+            self._publish_tuned(
+                vec, f32_vec, clock, loss, digest, obs, tid, tune_sel
+            )
             return
         if self._wire_topk and vec.dtype == np.float32:
             payload = self._topk_encoder.encode(
@@ -2103,6 +2162,134 @@ class TcpTransport:
         self.server.publish(vec, clock, loss, digest=digest, obs=obs,
                             trace_id=tid)
 
+    def _tune_plan(self, step: int):
+        """One ladder decision per publish clock: resolve the scheduled
+        partner (the link this frame is FOR under pairwise gossip),
+        overlay the DEGRADED fidelity shed, and return ``(link, rung)``
+        — or None when this clock pairs the node with itself.
+
+        Memoized per clock like the obs trailer: the round protocol
+        republishes the same replica under the same clock (driver
+        publish, then the publish inside ``_round``), and the dwell
+        clock must advance once per ROUND, not once per frame."""
+        cached = self._tune_plan_cache
+        if cached is not None and cached[0] == step:
+            return cached[1]
+        link = self.schedule.partner(step, self.me)
+        sel = None
+        if link != self.me:
+            sb = self.scoreboard
+            degraded = bool(
+                sb is not None and sb.is_degraded(link, step)
+            )
+            rung = self._tuner.plan(link, step, degraded=degraded)
+            eff = self._tuner.effective_rung(link)
+            last = self._tune_last_rung.get(link)
+            if last is not None and last != eff:
+                # Rung change: drop the error-feedback base of every
+                # top-k encoder serving this link — the accumulated
+                # residual was measured against what the OLD codec told
+                # the ring, and replaying it through the new one would
+                # double-ship (or re-ship stale) coordinates.
+                for key, enc in self._tune_topk_encoders.items():
+                    if key[0] != link:
+                        continue
+                    if rung.codec == "topk":
+                        enc.retune(rung.topk_fraction)
+                    else:
+                        enc.reset()
+            self._tune_last_rung[link] = eff
+            sel = (link, rung)
+        self._tune_plan_cache = (step, sel)
+        return sel
+
+    def _tune_topk_encoder(self, link: int, fraction: float, shard: int):
+        """The (link, shard) error-feedback encoder at ``fraction``,
+        created on first use and retuned (fraction swap + base reset)
+        when the ladder moved it to a different top-k rung."""
+        key = (link, shard)
+        enc = self._tune_topk_encoders.get(key)
+        if enc is None:
+            from dpwa_tpu.ops.quantize import TopkEncoder
+
+            enc = TopkEncoder(
+                fraction, self.config.protocol.topk_values
+            )
+            self._tune_topk_encoders[key] = enc
+        elif enc.fraction != fraction:
+            enc.retune(fraction)
+        return enc
+
+    def _publish_tuned(
+        self, vec: np.ndarray, f32_vec: Optional[np.ndarray],
+        clock: float, loss: float, digest, obs, tid, sel,
+    ) -> None:
+        """Publish one frame at the link's current ladder rung.  Frames
+        stay self-describing (code byte), so the fetching side decodes
+        whatever rung this side chose without negotiation."""
+        link, rung = sel
+        flat = (
+            f32_vec
+            if f32_vec is not None
+            else np.ascontiguousarray(vec, dtype=np.float32)
+        ).reshape(-1)
+        if rung.codec == "topk":
+            enc = self._tune_topk_encoder(link, rung.topk_fraction, -1)
+            payload = enc.encode(
+                flat, self.schedule.seed, clock, self.me
+            )
+            self._note_published(int(payload.size), int(flat.size) * 4)
+            self.server.publish(
+                payload, clock, loss, code=_TOPK_DELTA, digest=digest,
+                obs=obs, trace_id=tid,
+            )
+            return
+        if rung.dtype == "int8":
+            from dpwa_tpu.ops.quantize import encode_int8_payload
+
+            payload = encode_int8_payload(
+                flat, self.schedule.seed, clock, self.me
+            )
+            self._note_published(int(payload.size), int(flat.size) * 4)
+            self.server.publish(
+                payload, clock, loss, code=_INT8_CHUNKED, digest=digest,
+                obs=obs, trace_id=tid,
+            )
+            return
+        out = flat.astype(_DTYPES[3]) if rung.dtype == "bf16" else flat
+        self._note_published(int(out.nbytes), int(flat.size) * 4)
+        self.server.publish(out, clock, loss, digest=digest, obs=obs,
+                            trace_id=tid)
+
+    def _observed_wire_rung(self, sp, vec, nbytes: int) -> int:
+        """Ladder rung the partner encoded its last frame at, for
+        mirroring.  Sparse payloads are explicit about their codec;
+        dense frames are classified by the wire-bytes-per-element ratio
+        (the code byte is consumed inside fetch_blob_full, and
+        f32/bf16/int8 sit well apart at ~4/2/1 bytes per element).
+        Shard frames mirror the INNER codec — shard width is never on
+        the ladder."""
+        from dpwa_tpu.ops.shard import ShardPayload
+        from dpwa_tpu.tune import start_rung_for
+
+        if sp is not None:
+            if isinstance(sp, ShardPayload):
+                inner = sp.inner
+                if not isinstance(inner, np.ndarray):
+                    lo, hi = sp.bounds
+                    frac = float(inner.values.size) / max(1, hi - lo)
+                    return start_rung_for("topk", "f32", frac)
+                return {0: 0, 3: 1, 4: 2}.get(sp.inner_code, 0)
+            frac = float(sp.values.size) / max(1, int(sp.n))
+            return start_rung_for("topk", "f32", frac)
+        n = max(1, int(getattr(vec, "size", 1)))
+        ratio = float(nbytes) / n
+        if ratio < 1.5:
+            return 2
+        if ratio < 3.0:
+            return 1
+        return 0
+
     def _shard_index(self, step: int, k: int) -> int:
         """This publish clock's shard under the per-epoch permutation
         (schedules.shard_draw semantics), with the epoch's permutation
@@ -2119,12 +2306,16 @@ class TcpTransport:
     def _publish_shard(
         self, vec: np.ndarray, f32_vec: Optional[np.ndarray],
         clock: float, loss: float, digest, obs, tid,
+        tune_sel=None,
     ) -> None:
         """Serve this round's shard: slice -> inner wire_dtype /
         wire_codec encoding -> SHARD_HDR preamble -> code-6 frame.  The
         codecs compose per slice: top-k selects within the shard (one
         error-feedback encoder per shard), the int8 scale tables restart
-        at the slice boundary because chunking is per-payload."""
+        at the slice boundary because chunking is per-payload.  Shard k
+        itself is never tuned (both ends must agree on the round-robin
+        permutation); with the tuner on, the ladder rung selects the
+        INNER codec of the slice instead."""
         from dpwa_tpu.ops import shard as _shard_ops
 
         flat = (
@@ -2136,7 +2327,34 @@ class TcpTransport:
         idx = self._shard_index(int(clock), k)
         lo, hi = _shard_ops.shard_bounds(flat.size, k, idx)
         sl = np.ascontiguousarray(flat[lo:hi])
-        if self._wire_topk:
+        if tune_sel is not None:
+            link, rung = tune_sel
+            if rung.codec == "topk":
+                enc = self._tune_topk_encoder(
+                    link, rung.topk_fraction, idx
+                )
+                inner = enc.encode(
+                    sl, self.schedule.seed, clock, self.me
+                )
+                inner_code = _TOPK_DELTA
+            elif rung.dtype == "int8":
+                from dpwa_tpu.ops.quantize import encode_int8_payload
+
+                inner = encode_int8_payload(
+                    sl, self.schedule.seed, clock, self.me
+                )
+                inner_code = _INT8_CHUNKED
+            elif rung.dtype == "bf16":
+                inner = sl.astype(_DTYPES[3]).view(np.uint8)
+                inner_code = _pc.PAYLOAD_BF16
+            else:
+                arr = (
+                    sl if sl.dtype == np.dtype("<f4")
+                    else sl.astype("<f4")
+                )
+                inner = arr.view(np.uint8)
+                inner_code = _pc.PAYLOAD_F32
+        elif self._wire_topk:
             enc = self._shard_topk_encoders.get(idx)
             if enc is None:
                 from dpwa_tpu.ops.quantize import TopkEncoder
@@ -2305,6 +2523,7 @@ class TcpTransport:
             got = None
             outcome = Outcome.STALE
         codec = None
+        wire_sp = None        # decoded sparse payload (rung mirroring)
         sparse_guard = None   # (values, local_selected) for the guard
         sparse_trust = None   # (indices, values) for trust screening
         trust_codec = None    # baseline family key (inner codec for shard)
@@ -2328,6 +2547,7 @@ class TcpTransport:
             from dpwa_tpu.ops.shard import ShardPayload
 
             sp = got[0]
+            wire_sp = sp
             lv = self._local_vec
             if isinstance(sp, ShardPayload):
                 if lv is None or int(lv.size) != int(sp.d):
@@ -2398,6 +2618,20 @@ class TcpTransport:
                 sparse_trust = (sp.indices, sp.values)
             if timing:
                 tr.mark("decode", time.monotonic() - t_stage)
+        if (
+            self._tuner is not None
+            and got is not None
+            and peer_index != self.me
+        ):
+            # Rung mirroring: the frame just decoded tells us what rung
+            # the partner encoded this link at — floor our own effective
+            # rung with it so a one-sided throttle (where only the
+            # partner's fetches observe slowness) still slims BOTH
+            # directions of the pair.
+            self._tuner.note_partner_rung(
+                peer_index,
+                self._observed_wire_rung(wire_sp, got[0], int(nbytes)),
+            )
         reason = None
         if got is not None and self.config.recovery.enabled:
             # Divergence/poison guard: a frame can be perfectly formed
@@ -2862,6 +3096,11 @@ class TcpTransport:
             elif (
                 self.config.flowctl.enabled
                 and self.config.flowctl.degrade_shed_fraction > 0.0
+                # With the self-tuning wire running, a DEGRADED partner
+                # sheds FIDELITY at publish (the ladder overlay) instead
+                # of rounds — the round-drop remap below is bypassed so
+                # the honest-peer round rate never dips under load.
+                and self._tuner is None
                 and sb.is_degraded(sched, step)
             ):
                 # Scoreboard soft-degrade: a DEGRADED partner (load, not
@@ -2972,6 +3211,10 @@ class TcpTransport:
             # this transport (protocol.async_rounds), so lock-step runs
             # keep their health records byte-identical.
             snap["async"] = self.async_engine.snapshot()
+        if self._tuner is not None:
+            # Present exactly when the self-tuning wire is on, so
+            # static-wire runs keep their health records byte-identical.
+            snap["tune"] = self._tuner.snapshot()
         return snap
 
     # dpwalint: thread_root(healthz)
@@ -3228,6 +3471,10 @@ class TcpTransport:
             )
 
             _reg_inc(registry, self.incidents)
+        if self._tuner is not None:
+            from dpwa_tpu.tune import register_metrics as _reg_tune
+
+            _reg_tune(registry, self._tuner)
 
     def _trust_alpha_scale(self) -> float:
         """The CURRENT exchange's trust damping (interpolation hook)."""
@@ -3239,6 +3486,15 @@ class TcpTransport:
         size the overlapped-join backstop.  Mirrors :meth:`publish`'s
         encoding choice exactly."""
         n = int(vec.size)
+        if self._tuner is not None and vec.dtype == np.float32:
+            # Self-tuning wire: the partner's rung can sit anywhere on
+            # the ladder by the time it fetches — size the backstop for
+            # the f32 floor, the ladder's largest frame (a conservative
+            # bound is the contract here).
+            if self._shard_on:
+                m = -(-n // self._shard_k)
+                return _pc.SHARD_HDR.size + 4 * m
+            return 4 * n
         if self._shard_on and vec.dtype == np.float32:
             # Sharded frame: SHARD_HDR preamble + the inner encoding
             # over the LONGEST slice (ceil(n/k)) — a conservative upper
@@ -3381,6 +3637,13 @@ class TcpTransport:
             self.membership.end_round(step)
         if self.incidents is not None or self.flight is not None:
             self._obs_round_end(step)
+        elif self._tuner is not None:
+            # Tuner without the incident plane: feed the controller its
+            # round evidence on the same every-exit-path boundary, but
+            # WITHOUT draining membership/trust events (that drain is
+            # the incident plane's contract — pop_*_events would lose
+            # the buffered copies otherwise).
+            self._tune_round_end(step)
 
     def _obs_round_end(self, step: int) -> None:
         """Incident-plane + flight-recorder round boundary — runs right
@@ -3426,6 +3689,14 @@ class TcpTransport:
         rel = None
         if self.sketchboard is not None:
             _, rel = self.sketchboard.disagreement()
+        if self._tuner is not None and peer is not None and peer != self.me:
+            self._tuner.observe(
+                peer,
+                wall_s=wall,
+                wire_s=lf.get("latency_s"),
+                soft=outcome in _TUNE_SOFT_OUTCOMES,
+                rel=rel,
+            )
         stale_peers: Sequence[int] = ()
         if self.async_engine is not None:
             # Peers whose frames the bounded-staleness rule dropped this
@@ -3469,6 +3740,41 @@ class TcpTransport:
                 # Incident open is a dump trigger: preserve the run-up
                 # before the ring scrolls past it.
                 self.flight.dump("incident", step)
+
+    def _tune_round_end(self, step: int) -> None:
+        """Controller-only round boundary (incident plane off): the
+        same entry-to-entry wall + last-fetch spans the obs boundary
+        gathers, quantized inside LinkTuner.observe before any decision
+        can branch on them."""
+        now = time.monotonic()
+        wall = None
+        if self._obs_round_entry_t is not None:
+            wall = now - self._obs_round_entry_t
+        self._obs_round_entry_t = now
+        lr = self.last_round
+        this_round = lr.get("step") == step
+        peer = lr.get("partner") if this_round else None
+        if peer is None or peer == self.me:
+            return
+        lf = self.last_fetch if this_round else {}
+        outcome = lr.get("outcome") if this_round else None
+        rel = None
+        if self.sketchboard is not None:
+            _, rel = self.sketchboard.disagreement()
+        self._tuner.observe(
+            peer,
+            wall_s=wall,
+            wire_s=lf.get("latency_s"),
+            soft=outcome in _TUNE_SOFT_OUTCOMES,
+            rel=rel,
+        )
+
+    def pop_tune_decisions(self) -> list:
+        """Drain the controller's buffered ladder decisions (the JSONL
+        ``tune`` record kind); [] when the tuner is off."""
+        if self._tuner is None:
+            return []
+        return self._tuner.pop_decisions()
 
     def _flight_dump_route(self) -> dict:
         """``/flightdump`` healthz route: dump the ring on demand."""

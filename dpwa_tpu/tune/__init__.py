@@ -1,0 +1,10 @@
+"""Self-tuning wire: the per-link degradation controller (docs/tune.md)."""
+
+from dpwa_tpu.tune.controller import (  # noqa: F401
+    LADDER,
+    LinkTuner,
+    Rung,
+    register_metrics,
+    rung_label,
+    start_rung_for,
+)

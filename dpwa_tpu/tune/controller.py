@@ -1,0 +1,440 @@
+"""Per-link wire-degradation controller (docs/tune.md).
+
+The obs planes already measure exactly what the static wire knobs trade
+off — per-stage spans (wire vs merge), busy/slow/stale outcome rates,
+and the sketch plane's ring-disagreement ``rel_rms`` — but nothing
+closed the loop: codec, top-k fraction, and value precision were
+hand-tuned YAML shared by every link.  The :class:`LinkTuner` closes it
+in the DeadlineEstimator mold: per tracked link it keeps a small bounded
+observation window and walks a FROZEN escalation ladder:
+
+- **escalate** one rung (coarser codec, fewer bytes) when the window
+  shows wire-bound rounds — the quantized wire-span fraction of the
+  round wall at/above ``wire_bound_frac``, with busy/slow/stale
+  outcomes counting as wire-bound evidence;
+- **back off** one rung when the sketch plane shows convergence
+  stalling (fractional ``rel_rms`` improvement across the window below
+  ``stall_eps``) AND the window shows wire headroom (not majority
+  wire-bound) — compression is starving the gossip average and the
+  link can afford finer frames; without the headroom gate a stall on a
+  congested link would walk it back into codecs that only time out;
+- **shed** ``shed_rungs`` extra rungs while the scheduled partner is
+  scoreboard-DEGRADED: the robustness core — a loaded peer gets fewer
+  bytes at lower fidelity, NOT dropped rounds (the
+  ``degrade_shed_fraction`` remap is bypassed while the tuner runs) and
+  never trust/quarantine evidence;
+- **mirror** the partner's rung, read off the self-describing frames it
+  serves: the effective rung is floored one rung below the rung the
+  partner last encoded at (the slack keeps two mirrors from ratcheting
+  each other up forever).  Evidence is fetch-side but the lever is
+  publish-side, so
+  a one-sided throttle (only one end's egress shaped) would otherwise
+  never heal — the shaped end's own fetches stay fast and it keeps
+  serving fat frames the other side can never land; the partner's
+  escalations, visible in the frames themselves, are the missing
+  backchannel.
+
+Hysteresis makes a flapping link settle instead of thrash: a rung is
+held for ``min_dwell_rounds`` plus a threefry-drawn jitter (tag 37 —
+desynchronizes fleet-wide escalations) before the next escalation, and
+a back-off starts a ``cooldown_rounds`` window during which the link may
+not re-escalate.  Sheds are overlays: they do not advance the dwell
+clock or touch the base rung, so a DEGRADED window ends with the link
+exactly where it was.
+
+Determinism: every decision is a pure function of QUANTIZED
+observations (span fractions bucketed to ``quant`` levels, ``rel_rms``
+rounded to fixed precision, outcome booleans) plus the registered
+threefry jitter stream — the controller itself never reads a clock.
+Wall-derived spans arrive as arguments, exactly like the
+DeadlineEstimator's latencies, so a scripted observation feed replays
+its decision log bit-identically (tests/test_tune.py).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Deque, Dict, List, NamedTuple, Optional
+
+from dpwa_tpu.config import TuneConfig
+
+
+class Rung(NamedTuple):
+    """One frozen ladder entry: how the wire encodes at this fidelity."""
+
+    codec: str              # dense | topk
+    dtype: str              # f32 | bf16 | int8 (dense) / value block (topk)
+    topk_fraction: Optional[float]  # None for dense rungs
+
+
+# The frozen escalation ladder, finest (most bytes, exact) to coarsest.
+# Rung 0 is the floor: "never underperforms static f32" holds because a
+# back-off can always reach the reference codec.  Top-k rungs all ship
+# int8 value blocks — by the time a link is deep enough in the ladder to
+# shed coordinates, exact values for the survivors are not the
+# bottleneck.  Shard k is NOT on the ladder: both ends of every link
+# must agree on the shard permutation epoch, and a per-link k would
+# break the round-robin coverage invariant — when sharding is on, the
+# ladder selects the INNER codec of each shard frame instead.
+LADDER: tuple = (
+    Rung("dense", "f32", None),
+    Rung("dense", "bf16", None),
+    Rung("dense", "int8", None),
+    Rung("topk", "int8", 0.10),
+    Rung("topk", "int8", 0.03),
+    Rung("topk", "int8", 0.01),
+)
+
+
+def rung_label(rung: int) -> str:
+    """Human/metric label for a ladder rung ("f32", "topk0.03", ...)."""
+    r = LADDER[max(0, min(int(rung), len(LADDER) - 1))]
+    if r.codec == "topk":
+        return f"topk{r.topk_fraction:g}"
+    return r.dtype
+
+
+def start_rung_for(
+    wire_codec: str, wire_dtype: str, topk_fraction: float
+) -> int:
+    """The ladder rung matching the static wire config — the controller
+    starts every link exactly where the YAML put it ("static config as
+    configured"), so a link that never shows evidence never moves."""
+    if wire_codec == "topk":
+        best, best_d = 3, float("inf")
+        for i, r in enumerate(LADDER):
+            if r.codec != "topk":
+                continue
+            d = abs(r.topk_fraction - float(topk_fraction))
+            if d < best_d:
+                best, best_d = i, d
+        return best
+    if wire_dtype == "int8":
+        return 2
+    if wire_dtype == "bf16":
+        return 1
+    return 0
+
+
+class _LinkState:
+    __slots__ = (
+        "rung", "mirror", "dwell", "cooldown", "jitter", "shed_active",
+        "window", "rel_window", "escalations", "backoffs", "sheds",
+    )
+
+    def __init__(self, rung: int, window: int):
+        self.rung = int(rung)
+        # Partner's rung as read off the frames it serves us (frames
+        # are self-describing, so the pair needs no control channel).
+        # Floors the effective rung: "if you are shedding fidelity on
+        # this link, so am I."  Without it a one-sided throttle never
+        # heals — the shaped peer's own fetches stay fast, so it keeps
+        # serving fat frames the other side can never land.
+        self.mirror = 0
+        self.dwell = 0
+        self.cooldown = 0
+        self.jitter = 0
+        self.shed_active = False
+        # Per-round wire-bound booleans (already quantized upstream).
+        self.window: Deque[bool] = deque(maxlen=window)
+        # Quantized rel_rms samples for the stall trend.
+        self.rel_window: Deque[float] = deque(maxlen=window)
+        self.escalations = 0
+        self.backoffs = 0
+        self.sheds = 0
+
+
+class LinkTuner:
+    """Frozen-ladder wire controller, one state machine per link."""
+
+    def __init__(self, config: Optional[TuneConfig] = None, seed: int = 0):
+        self.config = config if config is not None else TuneConfig()
+        self.seed = int(seed)
+        self.start_rung = 0
+        self._lock = threading.Lock()
+        self._links: Dict[int, _LinkState] = {}
+        self._decisions: List[dict] = []
+        # Invariant counter, not a feature: a rung change that happened
+        # before the dwell clock allowed it.  Asserted == 0 by the
+        # health_report digest and tests — if it ever moves, the
+        # hysteresis contract is broken.
+        self._dwell_violations = 0
+
+    def set_start_rung(self, rung: int) -> None:
+        """Anchor new links at the static config's rung (clamped)."""
+        self.start_rung = max(0, min(int(rung), len(LADDER) - 1))
+
+    def _state(self, link: int) -> _LinkState:
+        st = self._links.get(link)
+        if st is None:
+            st = self._links[link] = _LinkState(
+                self.start_rung, self.config.window
+            )
+        return st
+
+    # ------------------------------------------------------------------
+    # Ingestion (the _obs_round_end feed)
+    # ------------------------------------------------------------------
+
+    def observe(
+        self,
+        link: int,
+        wall_s: Optional[float] = None,
+        wire_s: Optional[float] = None,
+        soft: bool = False,
+        rel: Optional[float] = None,
+    ) -> None:
+        """Feed one finished round on ``link``.
+
+        ``wall_s``/``wire_s`` are the round's entry-to-entry wall and
+        the fetch's wire span — quantized HERE into ``quant`` buckets
+        before anything downstream can branch on them, so two runs whose
+        raw timings differ inside a bucket make identical decisions.
+        ``soft`` marks a busy/slow/stale/timeout outcome (wire-bound
+        evidence regardless of spans); ``rel`` is the sketch plane's
+        current ring-disagreement estimate."""
+        q = self.config.quant
+        wire_bound = bool(soft)
+        if not wire_bound and wall_s is not None and wire_s is not None:
+            if wall_s > 0 and wire_s >= 0:
+                bucket = min(q, int((float(wire_s) / float(wall_s)) * q))
+                wire_bound = (bucket / q) >= self.config.wire_bound_frac
+        with self._lock:
+            st = self._state(link)
+            st.window.append(wire_bound)
+            if rel is not None and rel >= 0:
+                # 1e-4 buckets: fine enough for the stall trend, coarse
+                # enough that float noise cannot flip a decision.
+                st.rel_window.append(round(float(rel), 4))
+
+    def note_partner_rung(self, link: int, rung: int) -> None:
+        """Record the rung the partner encoded its last frame at (read
+        off the frame's code byte on the consume path).  Tracks the
+        partner both up AND down — the partner's own hysteresis is the
+        damping, so no extra state is kept here."""
+        with self._lock:
+            st = self._state(link)
+            st.mirror = max(0, min(int(rung), len(LADDER) - 1))
+
+    def evict_peer(self, link: int) -> None:
+        """Drop the link's controller state (membership eviction): a
+        rejoiner re-enters the ladder at the static start rung."""
+        with self._lock:
+            self._links.pop(link, None)
+
+    def tracked_peers(self) -> list:
+        with self._lock:
+            return sorted(self._links)
+
+    # ------------------------------------------------------------------
+    # The per-round decision (publish path)
+    # ------------------------------------------------------------------
+
+    def _stalling(self, st: _LinkState) -> bool:
+        rels = list(st.rel_window)
+        if len(rels) < self.config.window:
+            return False
+        half = len(rels) // 2
+        old = sum(rels[:half]) / half
+        new = sum(rels[half:]) / (len(rels) - half)
+        if old <= 0:
+            return False
+        return (old - new) / old < self.config.stall_eps
+
+    def plan(self, link: int, clock: int, degraded: bool = False) -> Rung:
+        """Advance ``link``'s state machine one round and return the
+        EFFECTIVE rung for the frame published at ``clock``.
+
+        Called once per publish for the scheduled partner.  The base
+        rung walks the ladder under hysteresis; ``degraded`` overlays
+        ``shed_rungs`` extra rungs (clamped to the ladder top) without
+        touching the base state — fidelity shed, never a dropped round.
+        """
+        cfg = self.config
+        with self._lock:
+            st = self._state(link)
+            st.dwell += 1
+            if st.cooldown > 0:
+                st.cooldown -= 1
+            prev = st.rung
+            action = None
+            reason = None
+            if (
+                st.rung > 0
+                and st.dwell >= cfg.min_dwell_rounds
+                and len(st.window) >= cfg.window
+                # Back-off needs wire headroom: while the window is
+                # still majority wire-bound, a finer codec can only
+                # turn a landing frame back into a timeout — the stall
+                # is congestion, not compression starvation.
+                and sum(st.window) < cfg.escalate_frac * len(st.window)
+                and self._stalling(st)
+            ):
+                st.rung -= 1
+                st.backoffs += 1
+                action, reason = "backoff", "stall"
+                if st.dwell < cfg.min_dwell_rounds:
+                    self._dwell_violations += 1
+                st.dwell = 0
+                st.cooldown = cfg.cooldown_rounds
+                st.rel_window.clear()
+                st.window.clear()
+            elif (
+                st.rung < len(LADDER) - 1
+                and st.cooldown == 0
+                and len(st.window) >= cfg.window
+                and sum(st.window) >= cfg.escalate_frac * len(st.window)
+                and st.dwell >= cfg.min_dwell_rounds + st.jitter
+            ):
+                st.rung += 1
+                st.escalations += 1
+                action, reason = "escalate", "wire_bound"
+                if st.dwell < cfg.min_dwell_rounds:
+                    self._dwell_violations += 1
+                st.dwell = 0
+                st.window.clear()
+                # Draw the NEXT escalation's extra dwell now, keyed on
+                # the clock the decision landed at — both ends of the
+                # link (and any rerun) draw the same offset.
+                from dpwa_tpu.parallel import schedules
+
+                st.jitter = schedules.tune_jitter_draw(
+                    self.seed, int(clock), int(link), cfg.jitter_rounds
+                )
+            shed = bool(degraded) and cfg.shed_rungs > 0
+            if shed != st.shed_active:
+                st.shed_active = shed
+                if shed:
+                    st.sheds += 1
+                self._decisions.append(self._record(
+                    link, clock, "shed_on" if shed else "shed_off",
+                    st, prev, "degraded",
+                ))
+            if action is not None:
+                self._decisions.append(
+                    self._record(link, clock, action, st, prev, reason)
+                )
+            return LADDER[self._eff(st)]
+
+    def _eff(self, st: _LinkState) -> int:
+        """Effective rung: own ladder walk, floored by ONE RUNG BELOW
+        the partner's mirrored rung, plus the DEGRADED shed overlay
+        (clamped).  The -1 breaks the mirror ratchet: frames carry the
+        partner's EFFECTIVE rung — which includes its mirror of us —
+        so flooring at the mirror itself would make the pair's rungs
+        monotone non-decreasing (each side re-serving the other's
+        reflection forever, back-offs never propagating).  With the
+        slack, the pair's fixed point is max(own_A, own_B): mirrors
+        decay one rung per exchange once real evidence recedes."""
+        eff = max(st.rung, st.mirror - 1)
+        if st.shed_active:
+            eff = min(len(LADDER) - 1, eff + self.config.shed_rungs)
+        return eff
+
+    def effective_rung(self, link: int) -> int:
+        with self._lock:
+            st = self._links.get(link)
+            if st is None:
+                return self.start_rung
+            return self._eff(st)
+
+    def _record(
+        self, link, clock, action, st: _LinkState, prev: int, reason
+    ) -> dict:
+        eff = self._eff(st)
+        return {
+            "link": int(link),
+            "round": int(clock),
+            "action": action,
+            "rung": int(eff),
+            "prev_rung": int(prev),
+            "codec": rung_label(eff),
+            "reason": reason,
+            "dwell": int(st.dwell),
+        }
+
+    def pop_decisions(self) -> List[dict]:
+        """Drain buffered decision records (the JSONL ``tune`` kind)."""
+        with self._lock:
+            out, self._decisions = self._decisions, []
+            return out
+
+    # ------------------------------------------------------------------
+    # Observability
+    # ------------------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """JSON-ready: per-link rung/codec + ladder traffic counters."""
+        with self._lock:
+            links = {}
+            esc = back = sheds = 0
+            for link in sorted(self._links):
+                st = self._links[link]
+                eff = self._eff(st)
+                links[link] = {
+                    "rung": st.rung,
+                    "mirror": st.mirror,
+                    "effective_rung": eff,
+                    "codec": rung_label(eff),
+                    "dwell": st.dwell,
+                    "cooldown": st.cooldown,
+                    "shed_active": st.shed_active,
+                    "escalations": st.escalations,
+                    "backoffs": st.backoffs,
+                    "sheds": st.sheds,
+                }
+                esc += st.escalations
+                back += st.backoffs
+                sheds += st.sheds
+            return {
+                "start_rung": self.start_rung,
+                "ladder": len(LADDER),
+                "escalations": esc,
+                "backoffs": back,
+                "sheds": sheds,
+                "dwell_violations": self._dwell_violations,
+                "links": links,
+            }
+
+
+def register_metrics(registry, tuner: "LinkTuner") -> None:
+    """Expose the ladder state on a MetricsRegistry (dpwa_tune_*)."""
+    from dpwa_tpu.obs.prometheus import Family
+
+    def collect():
+        snap = tuner.snapshot()
+        rung = Family(
+            "dpwa_tune_rung", "gauge",
+            "Effective ladder rung per link (0 = f32 floor)",
+        )
+        shed = Family(
+            "dpwa_tune_shed_active", "gauge",
+            "1 while the link sheds fidelity under a DEGRADED partner",
+        )
+        for link, info in sorted((snap.get("links") or {}).items()):
+            labels = {"link": link, "codec": info.get("codec")}
+            rung.sample(info.get("effective_rung"), labels)
+            shed.sample(1 if info.get("shed_active") else 0, {"link": link})
+        return [
+            rung,
+            shed,
+            Family(
+                "dpwa_tune_escalations_total", "counter",
+                "Ladder escalations (coarser codec) across links",
+            ).sample(snap.get("escalations")),
+            Family(
+                "dpwa_tune_backoffs_total", "counter",
+                "Ladder back-offs (finer codec) across links",
+            ).sample(snap.get("backoffs")),
+            Family(
+                "dpwa_tune_sheds_total", "counter",
+                "DEGRADED fidelity-shed windows entered",
+            ).sample(snap.get("sheds")),
+            Family(
+                "dpwa_tune_dwell_violations_total", "counter",
+                "Rung changes inside the dwell window (invariant: 0)",
+            ).sample(snap.get("dwell_violations")),
+        ]
+
+    registry.register(collect)

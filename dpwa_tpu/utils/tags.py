@@ -116,6 +116,14 @@ CHAOS_KIND_BYZ_ZERO = _register_chaos_kind("byz_zero", 10)
 # draws, so a trickled peer can ALSO stall, like a real overloaded box.
 CHAOS_KIND_STALL = _register_chaos_kind("stall", 11)
 CHAOS_KIND_STALL_LEN = _register_chaos_kind("stall_len", 12)
+# Link-quality flapping (health/chaos.py bandwidth_bps): BANDWIDTH_FLAP
+# gates whether a (round-block, peer) is inside a flap window at all,
+# BANDWIDTH_RATE draws where inside [bandwidth_bps_min, max] the shaped
+# throughput lands.  Two streams so the flap duty cycle cannot skew how
+# deep the shaping goes — the tune controller's escalate→backoff→dwell
+# path is exercised against both axes independently.
+CHAOS_KIND_BANDWIDTH_FLAP = _register_chaos_kind("bandwidth_flap", 13)
+CHAOS_KIND_BANDWIDTH_RATE = _register_chaos_kind("bandwidth_rate", 14)
 
 # Second control-plane block (0..15 filled; 16..31 belongs to chaos).
 CONTROL_TAG_BASE_2 = 32
@@ -153,6 +161,15 @@ TAG_PASSIVE_SHUFFLE = _register("passive_shuffle_draw", CONTROL_TAG_BASE_2 + 3)
 # batch sequence with no stream state to checkpoint, and a rejoining
 # node lands on the same data order as the run it crashed out of.
 TAG_DATA_SHUFFLE = _register("data_shuffle_draw", CONTROL_TAG_BASE_2 + 4)
+
+# Self-tuning wire (tune/controller.py + schedules.tune_jitter_draw):
+# the per-(link, clock) dwell-jitter offset that desynchronizes ladder
+# escalations across links.  Without it, every wire-bound link clears
+# its dwell on the same round and the whole fleet's codecs step in
+# lock-step — a thundering herd the per-link controller exists to avoid.
+# Keyed on the publish clock like shard_draw, so both ends of a link
+# (and a seeded rerun) draw the same offset with no negotiation.
+TAG_TUNE_JITTER = _register("tune_jitter_draw", CONTROL_TAG_BASE_2 + 5)
 
 
 def registered_tags() -> Dict[int, str]:

@@ -753,11 +753,14 @@ def test_threefry_tags_are_pinned():
         26: "chaos:byz_zero",
         27: "chaos:stall",
         28: "chaos:stall_len",
+        29: "chaos:bandwidth_flap",
+        30: "chaos:bandwidth_rate",
         32: "shard_draw",
         33: "async_drain_draw",
         34: "view_sample_draw",
         35: "passive_shuffle_draw",
         36: "data_shuffle_draw",
+        37: "tune_jitter_draw",
     }
     assert tags.CHAOS_TAG_BASE == 16
     # Second control-plane block: 0..15 is full, 16..31 belongs to the
@@ -768,6 +771,9 @@ def test_threefry_tags_are_pinned():
     assert tags.TAG_VIEW_SAMPLE == 34
     assert tags.TAG_PASSIVE_SHUFFLE == 35
     assert tags.TAG_DATA_SHUFFLE == 36
+    assert tags.TAG_TUNE_JITTER == 37
+    assert tags.CHAOS_KIND_BANDWIDTH_FLAP == 13
+    assert tags.CHAOS_KIND_BANDWIDTH_RATE == 14
 
 
 def test_tag_collision_raises():

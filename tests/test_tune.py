@@ -1,0 +1,559 @@
+"""Self-tuning wire (``tune:``, docs/tune.md): the frozen ladder, the
+per-link controller's hysteresis, rung mirroring, the DEGRADED
+fidelity-shed (never round-drop) contract, error-feedback reset on
+rung changes, chaos bandwidth flapping on both Rx servers, and the
+tune observability surfaces (JSONL + schema).
+
+The three contracts pinned hardest:
+
+- **determinism** — a scripted observation feed (and a seeded chaos
+  soak) replays its decision log bit-identically: every decision is a
+  pure function of quantized observations plus registered threefry
+  draws, never a raw clock;
+- **off == absent** — ``tune: enabled: false`` publishes frames
+  byte-identical to a config with no ``tune:`` block at all;
+- **fidelity, not rounds** — a scoreboard-DEGRADED partner keeps its
+  scheduled pairings (the ``degrade_shed_fraction`` remap is bypassed
+  while the tuner runs) and receives coarser frames instead.
+"""
+
+import json
+import types
+
+import numpy as np
+import pytest
+
+from dpwa_tpu.config import ChaosConfig, TuneConfig, make_local_config
+from dpwa_tpu.health.chaos import (
+    ChaosEngine,
+    ChaosPeerServer,
+    ChaosReactorPeerServer,
+)
+from dpwa_tpu.health.detector import Outcome
+from dpwa_tpu.metrics import MetricsLogger
+from dpwa_tpu.ops.quantize import TopkEncoder
+from dpwa_tpu.parallel.schedules import tune_jitter_draw
+from dpwa_tpu.parallel.tcp import TcpTransport, fetch_blob_ex
+from dpwa_tpu.tune import LADDER, LinkTuner, rung_label, start_rung_for
+
+
+def _ring(n, **cfg_kwargs):
+    cfg = make_local_config(n, base_port=0, **cfg_kwargs)
+    ts = [TcpTransport(cfg, f"node{i}") for i in range(n)]
+    for t in ts:
+        for i, other in enumerate(ts):
+            t.set_peer_port(i, other.port)
+    return ts
+
+
+def _close(ts):
+    for t in ts:
+        t.close()
+
+
+def _drive(ts, rounds, d=512, seed=1):
+    rng = np.random.RandomState(seed)
+    vecs = [
+        rng.standard_normal(d).astype(np.float32) for _ in range(len(ts))
+    ]
+    for step in range(rounds):
+        for i, t in enumerate(ts):
+            m, _, _ = t.exchange(vecs[i], step, 0.0, step)
+            vecs[i] = np.asarray(m, np.float32)
+    return vecs
+
+
+def _cfg(**kw):
+    base = dict(
+        enabled=True, window=4, min_dwell_rounds=3, cooldown_rounds=4,
+        jitter_rounds=0, escalate_frac=0.5, wire_bound_frac=0.5,
+        stall_eps=0.02, shed_rungs=2,
+    )
+    base.update(kw)
+    return TuneConfig(**base)
+
+
+# ---------------------------------------------------------------------------
+# The frozen ladder
+# ---------------------------------------------------------------------------
+
+
+def test_ladder_frozen_floor_and_labels():
+    # Rung 0 is the f32 floor ("never underperforms static f32" relies
+    # on a back-off always being able to reach the reference codec).
+    assert LADDER[0].codec == "dense" and LADDER[0].dtype == "f32"
+    # Monotone coarsening: dense rungs first, then shrinking top-k.
+    fracs = [r.topk_fraction for r in LADDER if r.codec == "topk"]
+    assert fracs == sorted(fracs, reverse=True)
+    assert rung_label(0) == "f32"
+    assert rung_label(len(LADDER) - 1).startswith("topk")
+    # Static config anchors: the controller starts every link exactly
+    # where the YAML put it.
+    assert start_rung_for("dense", "f32", 0.0) == 0
+    assert start_rung_for("dense", "bf16", 0.0) == 1
+    assert start_rung_for("dense", "int8", 0.0) == 2
+    assert LADDER[start_rung_for("topk", "f32", 0.01)].topk_fraction == 0.01
+
+
+def test_jitter_draw_deterministic_and_bounded():
+    draws = [tune_jitter_draw(7, c, 3, 4) for c in range(64)]
+    assert draws == [tune_jitter_draw(7, c, 3, 4) for c in range(64)]
+    assert all(0 <= d <= 4 for d in draws)
+    assert len(set(draws)) > 1  # actually jitters
+    assert tune_jitter_draw(7, 5, 3, 0) == 0
+
+
+# ---------------------------------------------------------------------------
+# Determinism: scripted feeds replay bit-identically
+# ---------------------------------------------------------------------------
+
+
+def _scripted_feed(tuner):
+    """A mixed two-link script: link 0 wire-bound, link 1 healthy with
+    a stalling rel trend, mirror notes and a DEGRADED window."""
+    for r in range(40):
+        tuner.observe(0, soft=True)
+        tuner.observe(1, wall_s=0.40, wire_s=0.01,
+                      rel=0.5 if r > 8 else 0.9 - 0.05 * r)
+        if r == 12:
+            tuner.note_partner_rung(1, 3)
+        if r == 20:
+            tuner.note_partner_rung(1, 0)
+        tuner.plan(0, r, degraded=10 <= r < 14)
+        tuner.plan(1, r)
+    return tuner.pop_decisions(), tuner.snapshot()
+
+
+def test_scripted_feed_replays_decision_log_bit_identically():
+    a = _scripted_feed(LinkTuner(_cfg(jitter_rounds=2), seed=11))
+    b = _scripted_feed(LinkTuner(_cfg(jitter_rounds=2), seed=11))
+    assert a == b
+    decisions, snap = a
+    assert decisions  # the script actually exercises the ladder
+    assert any(d["action"] == "escalate" for d in decisions)
+    assert any(d["action"] == "shed_on" for d in decisions)
+    assert snap["dwell_violations"] == 0
+    # A different seed may jitter different dwell expiries, but the
+    # decision schema and the hysteresis invariant hold regardless.
+    c = _scripted_feed(LinkTuner(_cfg(jitter_rounds=2), seed=12))
+    assert c[1]["dwell_violations"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Hysteresis
+# ---------------------------------------------------------------------------
+
+
+def test_escalation_respects_window_and_dwell():
+    tuner = LinkTuner(_cfg())
+    for r in range(24):
+        tuner.observe(0, soft=True)
+        tuner.plan(0, r)
+    decisions = tuner.pop_decisions()
+    assert decisions and all(d["action"] == "escalate" for d in decisions)
+    rounds = [d["round"] for d in decisions]
+    # First escalation needs a FULL window; each following one needs the
+    # window refilled after the post-decision clear AND the dwell met.
+    assert rounds[0] >= 3
+    assert all(b - a >= 4 for a, b in zip(rounds, rounds[1:]))
+    snap = tuner.snapshot()
+    assert snap["dwell_violations"] == 0
+    assert snap["links"][0]["rung"] == min(len(LADDER) - 1, len(rounds))
+
+
+def test_backoff_requires_wire_headroom():
+    # Stall evidence on a still-congested link must NOT back off: a
+    # finer codec there can only turn a landing frame into a timeout.
+    congested = LinkTuner(_cfg(min_dwell_rounds=1))
+    congested.set_start_rung(2)
+    for r in range(12):
+        congested.observe(0, soft=True, rel=0.5)  # flat rel: stalling
+        congested.plan(0, r)
+    assert all(
+        d["action"] != "backoff" for d in congested.pop_decisions()
+    )
+
+    # Same stall with wire headroom (clear window) DOES back off.
+    idle = LinkTuner(_cfg(min_dwell_rounds=1))
+    idle.set_start_rung(2)
+    for r in range(12):
+        idle.observe(0, wall_s=0.4, wire_s=0.001, rel=0.5)
+        idle.plan(0, r)
+    backoffs = [
+        d for d in idle.pop_decisions() if d["action"] == "backoff"
+    ]
+    assert backoffs and backoffs[0]["reason"] == "stall"
+    assert idle.snapshot()["links"][0]["rung"] < 2
+    assert idle.snapshot()["dwell_violations"] == 0
+
+
+def test_cooldown_blocks_reescalation_after_backoff():
+    # cooldown (6) > window (4): the window refills before the cooldown
+    # lapses, so the cooldown is what actually gates the re-escalation.
+    tuner = LinkTuner(_cfg(min_dwell_rounds=1, cooldown_rounds=6))
+    tuner.set_start_rung(2)
+    r = 0
+    # Walk one back-off (clear window + flat rel).
+    while not any(
+        d["action"] == "backoff" for d in tuner.pop_decisions()
+    ):
+        tuner.observe(0, wall_s=0.4, wire_s=0.001, rel=0.5)
+        tuner.plan(0, r)
+        r += 1
+        assert r < 20
+    backoff_round = r - 1
+    # Now flood wire-bound evidence: the cooldown must hold the rung.
+    for _ in range(14):
+        tuner.observe(0, soft=True)
+        tuner.plan(0, r)
+        r += 1
+    esc = [
+        d for d in tuner.pop_decisions() if d["action"] == "escalate"
+    ]
+    assert esc  # it does re-escalate eventually...
+    # ...but not one round before the cooldown lapses (the window alone
+    # would have re-escalated at backoff_round + 4).
+    assert esc[0]["round"] == backoff_round + 6
+    assert tuner.snapshot()["dwell_violations"] == 0
+
+
+def test_square_wave_link_settles_instead_of_thrashing():
+    tuner = LinkTuner(_cfg(window=4, min_dwell_rounds=2, cooldown_rounds=12))
+    for r in range(48):
+        # 4-on / 4-off square wave with a flat rel trend: the worst
+        # case for a naive controller (escalate, stall, back off, ...).
+        soft = (r // 4) % 2 == 0
+        if soft:
+            tuner.observe(0, soft=True, rel=0.5)
+        else:
+            tuner.observe(0, wall_s=0.4, wire_s=0.001, rel=0.5)
+        tuner.plan(0, r)
+    moves = [
+        d for d in tuner.pop_decisions()
+        if d["action"] in ("escalate", "backoff")
+    ]
+    rounds = [d["round"] for d in moves]
+    # Hysteresis bounds the thrash: every rung change is separated by at
+    # least the window refill, and 48 flapping rounds (12 flap edges)
+    # produce only a handful of moves rather than one per edge.
+    assert all(b - a >= 4 for a, b in zip(rounds, rounds[1:]))
+    assert len(moves) <= 6
+    assert tuner.snapshot()["dwell_violations"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Rung mirroring
+# ---------------------------------------------------------------------------
+
+
+def test_mirror_floors_effective_rung_with_slack():
+    tuner = LinkTuner(_cfg())
+    assert tuner.effective_rung(7) == 0
+    tuner.note_partner_rung(7, 5)
+    assert tuner.effective_rung(7) == 4  # mirror - 1 slack
+    tuner.note_partner_rung(7, 1)
+    assert tuner.effective_rung(7) == 0
+    tuner.note_partner_rung(7, 99)  # clamped to the ladder top
+    assert tuner.effective_rung(7) == len(LADDER) - 2
+
+
+def test_mirror_pair_reaches_fixed_point_and_decays():
+    # Two ends of one link exchanging self-describing frames: each
+    # mirrors the rung the other's last frame was encoded at.
+    a = LinkTuner(_cfg())
+    a.set_start_rung(3)  # A's own evidence holds it at rung 3
+    b = LinkTuner(_cfg())
+
+    def swap():
+        b.note_partner_rung(0, a.effective_rung(0))
+        a.note_partner_rung(0, b.effective_rung(0))
+        return a.effective_rung(0), b.effective_rung(0)
+
+    # Fixed point is max(own_A, own_B) = 3, NOT a ratchet: B follows A
+    # at one rung of slack and A does not re-absorb B's reflection.
+    for _ in range(6):
+        ea, eb = swap()
+    assert (ea, eb) == (3, 2)
+
+    # When A's own evidence recedes the pair decays back to the floor
+    # instead of re-serving each other's reflection forever.
+    a._links[0].rung = 0
+    effs = [swap() for _ in range(4)]
+    assert effs[-1] == (0, 0)
+    assert all(x[0] >= y[0] for x, y in zip(effs, effs[1:]))  # monotone
+
+
+# ---------------------------------------------------------------------------
+# DEGRADED: fidelity shed, never dropped rounds
+# ---------------------------------------------------------------------------
+
+
+def test_shed_is_an_overlay_not_a_rung_change():
+    tuner = LinkTuner(_cfg(shed_rungs=2, min_dwell_rounds=1))
+    tuner.set_start_rung(1)
+    r0 = tuner.plan(0, 0, degraded=True)
+    assert r0 == LADDER[3]  # base 1 + 2 shed rungs
+    tuner.plan(0, 1, degraded=True)  # held: no repeat decision
+    snap = tuner.snapshot()["links"][0]
+    assert snap["rung"] == 1  # base untouched
+    assert snap["shed_active"] and snap["effective_rung"] == 3
+    r2 = tuner.plan(0, 2, degraded=False)
+    assert r2 == LADDER[1]  # overlay gone, link exactly where it was
+    acts = [d["action"] for d in tuner.pop_decisions()]
+    assert acts == ["shed_on", "shed_off"]
+    assert tuner.snapshot()["sheds"] == 1
+    # Clamped at the ladder top.
+    tuner.set_start_rung(len(LADDER) - 1)
+    assert tuner.plan(9, 0, degraded=True) == LADDER[len(LADDER) - 1]
+
+
+def test_degraded_partner_keeps_pairings_when_tuner_on():
+    # With the tuner running, the flowctl degrade_shed round-drop remap
+    # is bypassed: a loaded peer gets coarser frames, not fewer rounds.
+    common = dict(
+        health={"enabled": True},
+        flowctl={"enabled": True, "degrade_shed_fraction": 1.0},
+    )
+
+    def resolve_all(t):
+        t.scoreboard.probe_due = lambda *a, **k: False
+        t.scoreboard.is_quarantined = lambda *a, **k: False
+        t.scoreboard.is_degraded = lambda *a, **k: True
+        out = []
+        for step in range(8):
+            sched, actual, remapped = t._resolve_partner(step)
+            if sched != t.me:
+                out.append((sched, actual, remapped))
+        return out
+
+    tuned = _ring(3, tune={"enabled": True}, **common)
+    try:
+        rows = resolve_all(tuned[0])
+        assert rows and all(not r[2] and r[0] == r[1] for r in rows)
+    finally:
+        _close(tuned)
+
+    static = _ring(3, **common)
+    try:
+        rows = resolve_all(static[0])
+        assert any(r[2] for r in rows)  # the remap the tuner replaces
+    finally:
+        _close(static)
+
+
+# ---------------------------------------------------------------------------
+# Error feedback across rung changes
+# ---------------------------------------------------------------------------
+
+
+def test_retune_drops_error_feedback_base():
+    rng = np.random.RandomState(3)
+    vec = rng.standard_normal(256).astype(np.float32)
+    enc = TopkEncoder(0.10)
+    enc.encode(vec, seed=1, clock=0.0, sender=0)
+    assert enc.base is not None  # residual record accumulated
+    enc.retune(0.03)
+    assert enc.fraction == 0.03 and enc.base is None
+    # Post-retune encode is bit-identical to a FRESH encoder at the new
+    # fraction: no stale residual from the old rung leaks onto the wire.
+    fresh = TopkEncoder(0.03)
+    a = enc.encode(vec, seed=1, clock=1.0, sender=0)
+    b = fresh.encode(vec, seed=1, clock=1.0, sender=0)
+    assert a.tobytes() == b.tobytes()
+
+
+# ---------------------------------------------------------------------------
+# Transport integration
+# ---------------------------------------------------------------------------
+
+
+def test_tune_disabled_matches_absent_config_bit_identical():
+    finals = []
+    frames = []
+    for kwargs in ({}, {"tune": {"enabled": False}}):
+        ts = _ring(2, **kwargs)
+        try:
+            finals.append(_drive(ts, 3, d=256))
+            with ts[0].server._lock:
+                frames.append(bytes(ts[0].server._payload))
+            assert "tune" not in ts[0].health_snapshot()
+            assert ts[0].pop_tune_decisions() == []
+        finally:
+            _close(ts)
+    assert frames[0] == frames[1]
+    for va, vb in zip(*finals):
+        assert np.array_equal(va, vb)
+
+
+def test_observed_wire_rung_classification():
+    ts = _ring(2, tune={"enabled": True})
+    try:
+        t = ts[0]
+        vec = np.zeros(256, np.float32)
+        # Dense frames classify by wire-bytes-per-element.
+        assert t._observed_wire_rung(None, vec, 1024) == 0  # f32
+        assert t._observed_wire_rung(None, vec, 512) == 1   # bf16
+        assert t._observed_wire_rung(None, vec, 300) == 2   # int8
+        # Sparse frames classify by shipped-coordinate fraction.
+        sp = types.SimpleNamespace(values=np.zeros(3, np.float32), n=100)
+        assert LADDER[t._observed_wire_rung(sp, None, 0)].topk_fraction \
+            == 0.03
+        sp = types.SimpleNamespace(values=np.zeros(10, np.float32), n=100)
+        assert LADDER[t._observed_wire_rung(sp, None, 0)].topk_fraction \
+            == 0.10
+    finally:
+        _close(ts)
+
+
+def _tuned_soak(rounds=14):
+    """A seeded 2-node soak with node1's egress trickled to a crawl:
+    node0's fetches all classify soft (every ladder rung is too fat for
+    64 B/s inside the 150 ms budget), so the decision log is a pure
+    function of the seed."""
+    ts = _ring(
+        2,
+        timeout_ms=150,
+        tune={
+            "enabled": True, "window": 2, "min_dwell_rounds": 1,
+            "cooldown_rounds": 2, "jitter_rounds": 2,
+        },
+        chaos={
+            "enabled": True, "seed": 9,
+            "trickle_windows": ((1, 0, rounds),),
+            "trickle_bytes_per_s": 64.0,
+        },
+    )
+    decisions = []
+    try:
+        _drive(ts, rounds, d=256)
+        for t in ts:
+            decisions.append(t.pop_tune_decisions())
+        snaps = [t.health_snapshot()["tune"] for t in ts]
+    finally:
+        _close(ts)
+    return decisions, snaps
+
+
+@pytest.mark.slow
+def test_soak_decision_log_is_seed_deterministic():
+    (dec_a, snap_a), (dec_b, snap_b) = _tuned_soak(), _tuned_soak()
+    assert dec_a == dec_b
+    # node0 walked the ladder against the trickled link...
+    assert any(
+        d["action"] == "escalate" for d in dec_a[0]
+    ) and not dec_a[1]
+    # ...and node1 mirrored the escalations off node0's frames (its own
+    # fetches from node0 stay fast, so mirroring is the only channel).
+    assert snap_a[1]["links"][0]["mirror"] >= 1
+    assert snap_a == snap_b
+    assert all(s["dwell_violations"] == 0 for s in snap_a)
+
+
+# ---------------------------------------------------------------------------
+# Chaos bandwidth flapping
+# ---------------------------------------------------------------------------
+
+
+def _flap_cfg(**kw):
+    base = dict(
+        enabled=True, seed=5,
+        bandwidth_windows=((1, 0, 10),),
+        bandwidth_flap_probability=1.0,
+        bandwidth_block_rounds=2,
+        bandwidth_bps_min=2048.0,
+        bandwidth_bps_max=2048.0,
+    )
+    base.update(kw)
+    return ChaosConfig(**base)
+
+
+def test_bandwidth_flap_deterministic_and_windowed():
+    cfg = _flap_cfg(
+        bandwidth_bps_min=4096.0, bandwidth_bps_max=65536.0,
+        bandwidth_flap_probability=0.5,
+    )
+    a, b = ChaosEngine(cfg, peer=1), ChaosEngine(cfg, peer=1)
+    rates = [a.bandwidth_bps(r) for r in range(20)]
+    assert rates == [b.bandwidth_bps(r) for r in range(20)]
+    for r, rate in enumerate(rates):
+        if r >= 10:
+            assert rate == 0.0  # outside the window
+        else:
+            assert rate == 0.0 or 4096.0 <= rate <= 65536.0
+    assert any(rate > 0.0 for rate in rates[:10])
+    # Blocks are square waves: both rounds of a block draw one rate.
+    assert rates[0] == rates[1] and rates[2] == rates[3]
+    # An un-windowed peer is never shaped.
+    other = ChaosEngine(cfg, peer=0)
+    assert all(other.bandwidth_bps(r) == 0.0 for r in range(20))
+
+
+def test_bandwidth_composes_with_trickle_as_min_of_nonzero():
+    eng = ChaosEngine(_flap_cfg(
+        trickle_windows=((1, 0, 10),), trickle_bytes_per_s=100000.0,
+    ), peer=1)
+    assert eng.plan(3).trickle_bps == 2048.0  # slower rate wins
+    fast_flap = ChaosEngine(_flap_cfg(
+        trickle_windows=((1, 0, 10),), trickle_bytes_per_s=512.0,
+    ), peer=1)
+    assert fast_flap.plan(3).trickle_bps == 512.0
+    flap_only = ChaosEngine(_flap_cfg(), peer=1)
+    assert flap_only.plan(3).trickle_bps == 2048.0
+    assert flap_only.plan(15).trickle_bps == 0.0  # outside the window
+
+
+@pytest.mark.parametrize("server_cls", [
+    ChaosPeerServer, ChaosReactorPeerServer,
+])
+def test_bandwidth_flap_shapes_both_rx_servers(server_cls):
+    srv = server_cls("127.0.0.1", 0, ChaosEngine(_flap_cfg(), peer=1))
+    try:
+        # 128 KiB at 2048 B/s cannot land inside a 400 ms budget: the
+        # flapped link classifies soft on both serving stacks.
+        srv.publish(np.ones(1 << 15, np.float32), 1, 0.5)
+        got, outcome, _, _ = fetch_blob_ex("127.0.0.1", srv.port, 400)
+        assert got is None
+        assert outcome in (Outcome.TIMEOUT, Outcome.SLOW)
+    finally:
+        srv.close()
+
+
+# ---------------------------------------------------------------------------
+# Observability: JSONL records pass the closed schema
+# ---------------------------------------------------------------------------
+
+
+def test_tune_records_pass_schema_check(tmp_path):
+    from tools import schema_check
+
+    path = str(tmp_path / "metrics.jsonl")
+    ts = _ring(
+        2,
+        timeout_ms=150,
+        tune={
+            "enabled": True, "window": 2, "min_dwell_rounds": 1,
+            "cooldown_rounds": 2, "jitter_rounds": 0,
+        },
+        chaos={
+            "enabled": True, "seed": 9,
+            "trickle_windows": ((1, 0, 8),),
+            "trickle_bytes_per_s": 64.0,
+        },
+    )
+    try:
+        _drive(ts, 8, d=256)
+        with MetricsLogger(path=path) as log:
+            for t in ts:
+                for dec in t.pop_tune_decisions():
+                    log.log_tune(0, dec)
+            log.log_health(8, ts[0].health_snapshot())
+    finally:
+        _close(ts)
+    with open(path, encoding="utf-8") as fh:
+        recs = [json.loads(line) for line in fh]
+    assert any(r.get("record") == "tune" for r in recs)
+    health = [r for r in recs if r.get("record") == "health"]
+    assert health and "tune_rung" in health[0]
+    assert health[0]["tune_dwell_violations"] == 0
+    n, bad = schema_check.check_file(path)
+    assert n == len(recs) and bad == []

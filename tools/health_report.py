@@ -220,6 +220,34 @@ def summarize(
             },
         )
 
+    tune: Dict[str, Any] = {
+        "seen": False,  # any tune record/column in the stream
+        "links": {},  # link -> rung history + finals
+        "decisions": 0,  # tune decision records folded
+        "escalations": 0,  # from decision records
+        "backoffs": 0,
+        "shed_windows": 0,
+        # lifetime counters from the last health record's tune group
+        "escalations_final": None,
+        "backoffs_final": None,
+        "sheds_final": None,
+        "dwell_violations_final": None,  # the invariant: must stay 0
+    }
+
+    def tune_slot(p: int) -> Dict[str, Any]:
+        return tune["links"].setdefault(
+            int(p),
+            {
+                "rung_history": [],  # [round, rung, codec, action] rows
+                "rung_final": None,
+                "codec_final": None,
+                "shed_final": None,
+                "escalations": 0,
+                "backoffs": 0,
+                "shed_windows": 0,
+            },
+        )
+
     membership: Dict[str, Any] = {
         "partitions_entered": 0,
         "partitions_healed": 0,
@@ -263,6 +291,27 @@ def summarize(
     poisoned = 0
     for rec in _iter_records(paths):
         last_step = rec.get("step", last_step)
+        if rec.get("record") == "tune":
+            # Self-tuning wire ladder decisions (docs/tune.md): the
+            # per-link rung walk, folded into the --tune digest.
+            tune["seen"] = True
+            tune["decisions"] += 1
+            tsl = tune_slot(rec.get("link", -1))
+            action = rec.get("action")
+            tsl["rung_history"].append(
+                [rec.get("round"), rec.get("rung"), rec.get("codec"),
+                 action]
+            )
+            if action == "escalate":
+                tune["escalations"] += 1
+                tsl["escalations"] += 1
+            elif action == "backoff":
+                tune["backoffs"] += 1
+                tsl["backoffs"] += 1
+            elif action == "shed_on":
+                tune["shed_windows"] += 1
+                tsl["shed_windows"] += 1
+            continue
         if rec.get("record") == "event":
             n_event += 1
             kind = rec.get("event")
@@ -516,6 +565,26 @@ def summarize(
                         asl["lag_max"] is None or lag > asl["lag_max"]
                     ):
                         asl["lag_max"] = lag
+            if rec.get("tune_rung") is not None:
+                tune["seen"] = True
+                tune["escalations_final"] = rec.get("tune_escalations")
+                tune["backoffs_final"] = rec.get("tune_backoffs")
+                tune["sheds_final"] = rec.get("tune_sheds")
+                tune["dwell_violations_final"] = rec.get(
+                    "tune_dwell_violations"
+                )
+                for i, p in enumerate(rec.get("peer", [])):
+                    r = rec["tune_rung"][i]
+                    if r is None:
+                        continue  # link not yet tracked by the tuner
+                    tsl = tune_slot(p)
+                    tsl["rung_final"] = r
+                    tsl["codec_final"] = rec.get(
+                        "tune_codec", [None] * (i + 1)
+                    )[i]
+                    tsl["shed_final"] = rec.get(
+                        "tune_shed", [None] * (i + 1)
+                    )[i]
             continue
         if "outcome" not in rec and "sched_partner" not in rec:
             continue  # not an exchange record (loss-only, etc.)
@@ -602,6 +671,7 @@ def summarize(
         "wire": wire,
         "reactor": reactor,
         "async": async_,
+        "tune": tune,
     }
 
 
@@ -822,6 +892,56 @@ def _print_async(summary: Dict[str, Any]) -> None:
         )
 
 
+def _print_tune(summary: Dict[str, Any]) -> None:
+    """The ``--tune`` digest: per-link ladder history (escalations,
+    back-offs, DEGRADED shed windows), the final rung/codec each link
+    settled at, and the hysteresis invariant — dwell violations MUST
+    read 0 (docs/tune.md)."""
+    tn = summary.get("tune", {})
+    print()
+    print("# self-tuning wire")
+    if not tn.get("seen"):
+        print("tune plane not present in these records")
+        return
+    print(
+        f"decisions={tn['decisions']} escalations={tn['escalations']} "
+        f"backoffs={tn['backoffs']} shed_windows={tn['shed_windows']}"
+    )
+    if tn.get("escalations_final") is not None:
+        print(
+            "lifetime (last health record): "
+            f"escalations={tn['escalations_final']} "
+            f"backoffs={tn['backoffs_final']} "
+            f"sheds={tn['sheds_final']}"
+        )
+    dv = tn.get("dwell_violations_final")
+    if dv is not None:
+        verdict = "OK" if dv == 0 else "HYSTERESIS BROKEN"
+        print(f"dwell violations: {dv} ({verdict})")
+    for link in sorted(tn.get("links", {})):
+        tsl = tn["links"][link]
+        parts = [f"link {link}:"]
+        if tsl["rung_final"] is not None:
+            shed = " shed" if tsl["shed_final"] else ""
+            parts.append(
+                f"rung={tsl['rung_final']} "
+                f"codec={tsl['codec_final']}{shed}"
+            )
+        parts.append(
+            f"esc={tsl['escalations']} back={tsl['backoffs']} "
+            f"sheds={tsl['shed_windows']}"
+        )
+        print("  " + " ".join(parts))
+        hist = tsl["rung_history"]
+        if hist:
+            walk = " -> ".join(
+                f"{codec}@r{rnd}" + ("!" if act == "backoff" else "")
+                for rnd, _rung, codec, act in hist[-8:]
+            )
+            more = "... " if len(hist) > 8 else ""
+            print(f"    {more}{walk}")
+
+
 def _print_table(summary: Dict[str, Any]) -> None:
     recs = summary["records"]
     print(
@@ -995,6 +1115,13 @@ def main(argv=None) -> int:
         "histogram, bounded-staleness drops, fold batching, per-peer "
         "un-throttled verdict; docs/async.md)",
     )
+    ap.add_argument(
+        "--tune",
+        action="store_true",
+        help="print the self-tuning wire digest (per-link ladder rung "
+        "history, escalations/backoffs/shed windows, dwell-violation "
+        "invariant; docs/tune.md)",
+    )
     args = ap.parse_args(argv)
     summary = summarize(args.paths, split_step=args.split_step)
     if args.json:
@@ -1014,6 +1141,8 @@ def main(argv=None) -> int:
             _print_reactor(summary)
         if args.async_digest:
             _print_async(summary)
+        if args.tune:
+            _print_tune(summary)
     return 0
 
 

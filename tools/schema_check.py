@@ -41,6 +41,9 @@ schemas:
 - ``record: "loss"`` — the training harness's per-step loss stream
   (``tools/run_report.py`` joins these against the incident plane),
   closed-world;
+- ``record: "tune"`` — the self-tuning wire's per-link ladder
+  decisions (docs/tune.md): escalate/backoff/shed_on/shed_off rows,
+  the determinism anchor for seeded controller reruns — closed-world;
 - records with no ``record`` key — per-step exchange/training records
   (``MetricsLogger.log`` / ``log_exchange``): ``step`` and ``t`` are
   pinned, the rest is adapter-defined.
@@ -191,6 +194,19 @@ _HEALTH_GROUPS: Dict[str, Dict[str, tuple]] = {
         "async_peer_stale": (list,),
         "async_peer_pending": (list,),
         "async_peer_lag": (list,),
+    },
+    # Self-tuning wire (docs/tune.md; present exactly when tune.enabled
+    # drives the transport): per-link EFFECTIVE rung/codec columns and
+    # the ladder's lifetime traffic counters.  ``tune_dwell_violations``
+    # is the hysteresis invariant — always 0 in a healthy run.
+    "tune": {
+        "tune_rung": (list,),
+        "tune_codec": (list,),
+        "tune_shed": (list,),
+        "tune_escalations": (int,),
+        "tune_backoffs": (int,),
+        "tune_sheds": (int,),
+        "tune_dwell_violations": (int,),
     },
 }
 
@@ -456,13 +472,34 @@ _LOSS_OPTIONAL: Dict[str, tuple] = {
     "test_acc": _NUM,
 }
 
+# Self-tuning wire ladder decisions (docs/tune.md): one row per
+# escalate/backoff/shed transition, written immediately like events.
+# CLOSED: the decision log is the controller determinism test's
+# bit-identity fixture — a free-form field would let noise in.
+_TUNE_REQUIRED: Dict[str, tuple] = {
+    "record": (str,),
+    "step": (int,),
+    "t": _NUM,
+    "link": (int,),
+    "round": (int,),
+    "action": (str,),
+    "rung": (int,),
+    "prev_rung": (int,),
+    "codec": (str,),
+    "reason": (str,),
+    "dwell": (int,),
+}
+_TUNE_ACTIONS = frozenset(
+    {"escalate", "backoff", "shed_on", "shed_off"}
+)
+
 # The registry tools/lint_emitters.py checks emit sites against: every
 # ``record`` kind and every ``event`` kind the tree may write.  A new
 # emitter extends these IN THE SAME CHANGE that adds its schema above.
 RECORD_KINDS = frozenset(
     {
         "health", "trace", "event", "alert", "incident", "flight",
-        "bench", "fleet", "island", "run", "loss",
+        "bench", "fleet", "island", "run", "loss", "tune",
     }
 )
 EVENT_KINDS = frozenset(
@@ -635,6 +672,12 @@ def check_record(rec: dict) -> List[str]:
         return _check_fields(
             rec, _LOSS_REQUIRED, _LOSS_OPTIONAL, closed=True
         )
+    if kind == "tune":
+        errs = _check_fields(rec, _TUNE_REQUIRED, closed=True)
+        action = rec.get("action")
+        if isinstance(action, str) and action not in _TUNE_ACTIONS:
+            errs.append(f"unknown tune action {action!r}")
+        return errs
     if kind is None:
         return _check_fields(rec, _EXCHANGE_REQUIRED)
     return [f"unknown record kind {kind!r}"]
